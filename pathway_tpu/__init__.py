@@ -1,0 +1,202 @@
+"""pathway_tpu — a TPU-native live-data framework.
+
+Drop-in style API modeled on the reference's `pw.*` namespace
+(python/pathway/__init__.py): declarative tables, expressions, incremental
+joins/groupbys/windows, streaming connectors, persistence, live indexes and an
+LLM/RAG xpack — executed by an incremental Z-set engine whose dense paths
+(expression micro-batches, embedding, ANN search, model forward passes) lower
+to JAX/XLA and run on TPU.
+"""
+
+from __future__ import annotations
+
+from .internals import dtype as _dt
+from .internals import reducers
+from .internals.dtype import DType
+from .internals.expression import (
+    ApplyExpression,
+    CastExpression,
+    CoalesceExpression,
+    ColumnExpression,
+    ColumnReference,
+    ConvertExpression,
+    FillErrorExpression,
+    IfElseExpression,
+    MakeTupleExpression,
+    RequireExpression,
+    unwrap_value,
+    wrap,
+)
+from .internals.run import run, run_all
+from .internals.schema import (
+    ColumnDefinition,
+    Schema,
+    column_definition,
+    schema_from_dict,
+    schema_from_pandas,
+    schema_from_types,
+)
+from .internals.table import GroupedTable, JoinResult, Table, Universe
+from .internals.thisclass import left, right, this
+from .internals.value import ERROR, PENDING, Json, Pointer
+
+# -- dtype aliases (pw.INT etc. as in reference engine types) ---------------
+INT = int
+FLOAT = float
+BOOL = bool
+STR = str
+BYTES = bytes
+DATE_TIME_NAIVE = _dt.DATE_TIME_NAIVE
+DATE_TIME_UTC = _dt.DATE_TIME_UTC
+DURATION = _dt.DURATION
+
+
+class JoinMode:
+    INNER = "inner"
+    LEFT = "left"
+    RIGHT = "right"
+    OUTER = "outer"
+
+
+# -- expression constructors -------------------------------------------------
+def apply(fun, *args, **kwargs) -> ApplyExpression:
+    """Apply a Python function per row (reference: pw.apply)."""
+    return ApplyExpression(fun, _dt.ANY, args, kwargs)
+
+
+def apply_with_type(fun, ret_type, *args, **kwargs) -> ApplyExpression:
+    return ApplyExpression(fun, ret_type, args, kwargs)
+
+
+def apply_async(fun, *args, **kwargs) -> ApplyExpression:
+    from .internals.udfs import async_apply_expression
+
+    return async_apply_expression(fun, args, kwargs)
+
+
+def if_else(if_clause, then_clause, else_clause) -> IfElseExpression:
+    return IfElseExpression(if_clause, then_clause, else_clause)
+
+
+def coalesce(*args) -> CoalesceExpression:
+    return CoalesceExpression(*args)
+
+
+def require(val, *deps) -> RequireExpression:
+    return RequireExpression(val, *deps)
+
+
+def make_tuple(*args) -> MakeTupleExpression:
+    return MakeTupleExpression(*args)
+
+
+def cast(target_type, expr) -> CastExpression:
+    return CastExpression(target_type, expr)
+
+
+def unwrap(expr) -> ConvertExpression:
+    return ConvertExpression(unwrap_value, wrap(expr))
+
+
+def fill_error(expr, replacement) -> FillErrorExpression:
+    return FillErrorExpression(expr, replacement)
+
+
+def declare_type(target_type, expr) -> ColumnExpression:
+    e = wrap(expr)
+    e._dtype = _dt.wrap(target_type)
+    return e
+
+
+def assert_table_has_schema(table: Table, schema, *, allow_superset: bool = True) -> None:
+    for name, cd in schema.columns().items():
+        if name not in table.column_names():
+            raise AssertionError(f"missing column {name!r}")
+
+
+# -- namespaces --------------------------------------------------------------
+from . import debug  # noqa: E402
+from . import demo  # noqa: E402
+from . import io  # noqa: E402
+from . import persistence  # noqa: E402
+from . import stdlib  # noqa: E402
+from .internals import udfs  # noqa: E402
+from .internals.udfs import UDF, udf  # noqa: E402
+from .stdlib import indexing, ml, ordered, stateful, statistical, temporal, utils  # noqa: E402
+from .stdlib.temporal import (  # noqa: E402
+    asof_join,
+    asof_join_left,
+    asof_join_outer,
+    asof_join_right,
+    asof_now_join,
+    asof_now_join_inner,
+    asof_now_join_left,
+    interval,
+    interval_join,
+    interval_join_inner,
+    interval_join_left,
+    interval_join_outer,
+    interval_join_right,
+    intervals_over,
+    session,
+    sliding,
+    tumbling,
+    window_join,
+    window_join_inner,
+    window_join_left,
+    window_join_outer,
+    window_join_right,
+)
+from .stdlib.utils.async_transformer import AsyncTransformer  # noqa: E402
+from .internals.iterate import iterate, iteration_limit  # noqa: E402
+from .internals.sql import sql  # noqa: E402
+from .internals.yaml_loader import load_yaml  # noqa: E402
+from .internals.config import set_license_key, set_monitoring_config  # noqa: E402
+from .internals.monitoring import MonitoringLevel  # noqa: E402
+
+# temporal join/window methods grafted onto Table (reference:
+# python/pathway/__init__.py:185-214)
+Table.windowby = temporal.windowby
+Table.interval_join = interval_join
+Table.interval_join_inner = interval_join_inner
+Table.interval_join_left = interval_join_left
+Table.interval_join_right = interval_join_right
+Table.interval_join_outer = interval_join_outer
+Table.window_join = window_join
+Table.window_join_inner = window_join_inner
+Table.window_join_left = window_join_left
+Table.window_join_right = window_join_right
+Table.window_join_outer = window_join_outer
+Table.asof_join = asof_join
+Table.asof_join_left = asof_join_left
+Table.asof_join_right = asof_join_right
+Table.asof_join_outer = asof_join_outer
+Table.asof_now_join = asof_now_join
+Table.asof_now_join_inner = asof_now_join_inner
+Table.asof_now_join_left = asof_now_join_left
+Table.diff = ordered.diff
+Table.interpolate = statistical.interpolate
+Table.show = utils.viz_show
+Table.plot = utils.viz_plot
+Table.sort = temporal.sort
+
+universes = type("universes", (), {})()
+universes.promise_are_pairwise_disjoint = staticmethod(lambda *tables: tables[0] if tables else None)
+universes.promise_are_equal = staticmethod(
+    lambda *tables: [t.promise_universes_are_equal(tables[0]) for t in tables[1:]] and None
+)
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "Table", "Schema", "Json", "Pointer", "DType", "JoinMode", "JoinResult",
+    "GroupedTable", "ColumnExpression", "ColumnReference", "this", "left",
+    "right", "reducers", "apply", "apply_with_type", "apply_async", "udf",
+    "UDF", "if_else", "coalesce", "require", "make_tuple", "cast", "unwrap",
+    "fill_error", "declare_type", "run", "run_all", "debug", "demo", "io",
+    "persistence", "temporal", "indexing", "ml", "statistical", "stateful",
+    "ordered", "utils", "udfs", "iterate", "sql", "load_yaml",
+    "column_definition", "schema_from_types", "schema_from_dict",
+    "schema_from_pandas", "AsyncTransformer", "ERROR", "PENDING",
+    "set_license_key", "MonitoringLevel",
+]

@@ -1,0 +1,71 @@
+"""pw.demo — synthetic stream generators (reference: demo/__init__.py:29)."""
+
+from __future__ import annotations
+
+import csv as _csv
+import time
+from typing import Any, Callable
+
+from ..internals import dtype as dt
+from ..internals.schema import SchemaMetaclass, schema_from_types
+from ..internals.table import Table
+from ..io import python as io_python
+
+
+def generate_custom_stream(
+    value_generators: dict[str, Callable[[int], Any]],
+    *,
+    schema: SchemaMetaclass,
+    nb_rows: int | None = None,
+    input_rate: float = 1.0,
+    autocommit_duration_ms: int = 1000,
+    persistent_id: str | None = None,
+) -> Table:
+    class Subject(io_python.ConnectorSubject):
+        def run(self):
+            i = 0
+            while nb_rows is None or i < nb_rows:
+                row = {name: gen(i) for name, gen in value_generators.items()}
+                self.next(**row)
+                i += 1
+                if input_rate > 0:
+                    time.sleep(1.0 / input_rate)
+
+    return io_python.read(Subject(), schema=schema,
+                          autocommit_duration_ms=autocommit_duration_ms)
+
+
+def range_stream(nb_rows: int | None = None, offset: int = 0,
+                 input_rate: float = 1.0, **kwargs) -> Table:
+    schema = schema_from_types(value=int)
+    return generate_custom_stream(
+        {"value": lambda i: i + offset}, schema=schema, nb_rows=nb_rows,
+        input_rate=input_rate,
+    )
+
+
+def noisy_linear_stream(nb_rows: int = 10, input_rate: float = 1.0, **kwargs) -> Table:
+    import random
+
+    schema = schema_from_types(x=float, y=float)
+    return generate_custom_stream(
+        {"x": lambda i: float(i), "y": lambda i: i + random.uniform(-1, 1)},
+        schema=schema, nb_rows=nb_rows, input_rate=input_rate,
+    )
+
+
+def replay_csv(path: str, *, schema: SchemaMetaclass, input_rate: float = 1.0) -> Table:
+    class Subject(io_python.ConnectorSubject):
+        def run(self):
+            with open(path, newline="", encoding="utf-8") as f:
+                for row in _csv.DictReader(f):
+                    self.next(**row)
+                    if input_rate > 0:
+                        time.sleep(1.0 / input_rate)
+
+    return io_python.read(Subject(), schema=schema)
+
+
+def replay_csv_with_time(path: str, *, schema: SchemaMetaclass, time_column: str,
+                         unit: str = "s", autocommit_ms: int = 100, speedup: float = 1) -> Table:
+    return replay_csv(path, schema=schema, input_rate=speedup)

@@ -1,0 +1,268 @@
+"""Engine operator base classes and the single-worker scheduler.
+
+Execution model (TPU-first re-design of the reference's differential-dataflow
+worker loop, /root/reference/src/engine/dataflow.rs:7292-7440): operators form
+a DAG; data moves as Z-set update batches stamped with a logical time.  The
+scheduler processes logical times strictly in order; within one time it walks
+operators in topological order, first draining each operator's pending input
+batches, then calling its `flush` hook.  Because emissions only flow downstream
+(to later topo positions) at the same or a later time, a single pass per time
+yields a consistent frontier: when time t finishes, every operator has seen
+*all* updates at t — this is the engine's progress-tracking invariant,
+replacing timely's distributed frontier gossip with a deterministic schedule.
+
+Sharded multi-worker execution (parallel/) runs one scheduler per shard and
+exchanges batches between shards at exchange boundaries (join/groupby re-key).
+"""
+
+from __future__ import annotations
+
+import heapq
+import itertools
+from collections import defaultdict
+from typing import Any, Callable, Iterable
+
+from .types import Key, Row, Time, Update, consolidate, rows_equal
+
+_op_counter = itertools.count()
+
+
+class Operator:
+    """Base engine operator."""
+
+    def __init__(self, name: str = ""):
+        self.id = next(_op_counter)
+        self.name = name or type(self).__name__
+        self.inputs: list["Operator"] = []
+        self.downstream: list[tuple["Operator", int]] = []
+        self.scheduler: "Scheduler | None" = None
+        # observability (reference: ProberStats, src/engine/dataflow/monitoring.rs)
+        self.rows_in = 0
+        self.rows_out = 0
+
+    def connect(self, *upstream: "Operator") -> "Operator":
+        for port, up in enumerate(upstream):
+            self.inputs.append(up)
+            up.downstream.append((self, port))
+        return self
+
+    # -- hooks -------------------------------------------------------------
+    def process(self, port: int, updates: list[Update], time: Time) -> None:
+        raise NotImplementedError
+
+    def flush(self, time: Time) -> None:
+        pass
+
+    def on_end(self) -> None:
+        """All input exhausted (batch mode) / graceful shutdown."""
+
+    # -- emission ----------------------------------------------------------
+    def emit(self, time: Time, updates: list[Update]) -> None:
+        if not updates:
+            return
+        self.rows_out += len(updates)
+        assert self.scheduler is not None
+        self.scheduler.route(self, time, updates)
+
+
+class Scheduler:
+    def __init__(self) -> None:
+        self.operators: list[Operator] = []
+        self._topo: list[Operator] | None = None
+        self._topo_pos: dict[int, int] = {}
+        # pending[time][op_id] = list[(port, updates)]
+        self.pending: dict[Time, dict[int, list[tuple[int, list[Update]]]]] = defaultdict(
+            lambda: defaultdict(list)
+        )
+        self._times_heap: list[Time] = []
+        self._times_set: set[Time] = set()
+        self.current_time: Time | None = None
+        self.frontier: Time = -1
+
+    def register(self, op: Operator) -> Operator:
+        op.scheduler = self
+        self.operators.append(op)
+        self._topo = None
+        return op
+
+    # -- graph order -------------------------------------------------------
+    def topo_order(self) -> list[Operator]:
+        if self._topo is None:
+            indeg: dict[int, int] = {op.id: 0 for op in self.operators}
+            for op in self.operators:
+                for down, _ in op.downstream:
+                    indeg[down.id] += 1
+            ready = [op for op in self.operators if indeg[op.id] == 0]
+            order: list[Operator] = []
+            while ready:
+                op = ready.pop()
+                order.append(op)
+                for down, _ in op.downstream:
+                    indeg[down.id] -= 1
+                    if indeg[down.id] == 0:
+                        ready.append(down)
+            if len(order) != len(self.operators):
+                raise RuntimeError("cycle in engine graph (use iterate for loops)")
+            self._topo = order
+            self._topo_pos = {op.id: i for i, op in enumerate(order)}
+        return self._topo
+
+    # -- data movement -----------------------------------------------------
+    def _note_time(self, time: Time) -> None:
+        if time not in self._times_set:
+            self._times_set.add(time)
+            heapq.heappush(self._times_heap, time)
+
+    def push_input(self, op: Operator, time: Time, updates: list[Update]) -> None:
+        """External entry point: feed an input operator."""
+        if time <= self.frontier:
+            raise RuntimeError(
+                f"input at time {time} but frontier already at {self.frontier}"
+            )
+        self.pending[time][op.id].append((0, updates))
+        self._note_time(time)
+
+    def route(self, source: Operator, time: Time, updates: list[Update]) -> None:
+        if self.current_time is not None and time < self.current_time:
+            raise RuntimeError(
+                f"operator {source.name} emitted at past time {time} < {self.current_time}"
+            )
+        for down, port in source.downstream:
+            self.pending[time][down.id].append((port, updates))
+        self._note_time(time)
+
+    # -- main loop ---------------------------------------------------------
+    def step(self) -> bool:
+        """Process the earliest pending time fully. Returns False when idle."""
+        while self._times_heap:
+            t = heapq.heappop(self._times_heap)
+            self._times_set.discard(t)
+            if t in self.pending or t > self.frontier:
+                self._run_time(t)
+                return True
+        return False
+
+    def _run_time(self, t: Time) -> None:
+        self.current_time = t
+        order = self.topo_order()
+        bucket = self.pending.get(t)
+        for op in order:
+            if bucket is not None:
+                batches = bucket.pop(op.id, None)
+                if batches:
+                    for port, updates in batches:
+                        op.rows_in += len(updates)
+                        op.process(port, updates, t)
+                    # route() may have added to this time's bucket again
+                    bucket = self.pending.get(t)
+            op.flush(t)
+            bucket = self.pending.get(t)
+        self.pending.pop(t, None)
+        self.frontier = t
+        self.current_time = None
+
+    def run_until_idle(self) -> None:
+        while self.step():
+            pass
+
+    def finish(self) -> None:
+        self.run_until_idle()
+        for op in self.topo_order():
+            op.on_end()
+        self.run_until_idle()
+
+
+# ---------------------------------------------------------------------------
+# Shared state-cell helpers
+# ---------------------------------------------------------------------------
+
+class KeyedState:
+    """key -> (row, count) with Z-set update semantics."""
+
+    __slots__ = ("data",)
+
+    def __init__(self) -> None:
+        self.data: dict[Key, tuple[Row, int]] = {}
+
+    def apply(self, key: Key, row: Row, diff: int) -> None:
+        cur = self.data.get(key)
+        if cur is None:
+            if diff != 0:
+                self.data[key] = (row, diff)
+        else:
+            crow, ccount = cur
+            ncount = ccount + diff
+            if ncount == 0:
+                del self.data[key]
+            else:
+                # latest row wins on additions; keeps the live row on mixed batches
+                self.data[key] = (row if diff > 0 else crow, ncount)
+
+    def get_row(self, key: Key) -> Row | None:
+        cur = self.data.get(key)
+        if cur is None or cur[1] <= 0:
+            return None
+        return cur[0]
+
+    def __contains__(self, key: Key) -> bool:
+        return self.get_row(key) is not None
+
+    def keys(self) -> Iterable[Key]:
+        return (k for k, (_, c) in self.data.items() if c > 0)
+
+    def items(self) -> Iterable[tuple[Key, Row]]:
+        return ((k, r) for k, (r, c) in self.data.items() if c > 0)
+
+    def __len__(self) -> int:
+        return sum(1 for _, (_, c) in self.data.items() if c > 0)
+
+
+class DiffOutputOperator(Operator):
+    """Stateful operator that emits output-vs-last-emitted differences.
+
+    Subclasses define `compute(out_key) -> Row | None` over the current input
+    states and `dirty_keys_for(port, in_key)` mapping touched input keys to
+    affected output keys.  The flush hook stabilizes output exactly once per
+    logical time, so downstream sees one retract+insert per changed key per
+    time regardless of intra-time churn.
+    """
+
+    def __init__(self, n_inputs: int, name: str = ""):
+        super().__init__(name)
+        self.state: list[KeyedState] = [KeyedState() for _ in range(n_inputs)]
+        self.last_out: dict[Key, Row] = {}
+        self._dirty: set[Key] = set()
+
+    def dirty_keys_for(self, port: int, key: Key) -> Iterable[Key]:
+        return (key,)
+
+    def compute(self, key: Key) -> Row | None:
+        raise NotImplementedError
+
+    def process(self, port: int, updates: list[Update], time: Time) -> None:
+        st = self.state[port]
+        for key, row, diff in updates:
+            self.pre_apply(port, key, row, diff)
+            st.apply(key, row, diff)
+            self._dirty.update(self.dirty_keys_for(port, key))
+
+    def pre_apply(self, port: int, key: Key, row: Row, diff: int) -> None:
+        """Hook called before state mutation (for reverse-index upkeep)."""
+
+    def flush(self, time: Time) -> None:
+        if not self._dirty:
+            return
+        out: list[Update] = []
+        for key in self._dirty:
+            new_row = self.compute(key)
+            old_row = self.last_out.get(key)
+            if rows_equal(new_row, old_row):
+                continue
+            if old_row is not None:
+                out.append((key, old_row, -1))
+                del self.last_out[key]
+            if new_row is not None:
+                out.append((key, new_row, 1))
+                self.last_out[key] = new_row
+        self._dirty.clear()
+        self.emit(time, consolidate(out))

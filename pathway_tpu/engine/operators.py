@@ -1,0 +1,625 @@
+"""Concrete engine operators.
+
+TPU-native re-implementations of the reference's dataflow operators
+(/root/reference/src/engine/dataflow.rs — join_tables :2720, group_by_table
+:3747, expression tables :1557, connector_table :4022, output :4405).  All
+operators are incremental over Z-set update batches; stateless ops stream
+per-delta, stateful ops stabilize once per logical time via
+DiffOutputOperator.flush.
+"""
+
+from __future__ import annotations
+
+from collections import defaultdict
+from typing import Any, Callable, Iterable
+
+from ..internals.value import ERROR, Error, ref_scalar
+from .graph import DiffOutputOperator, KeyedState, Operator
+from .types import Key, Row, Time, Update, consolidate, rows_equal
+
+
+class EnvBuilder:
+    """Builds the expression-evaluation environment for a row.
+
+    Maps (table_id, column_name) aliases to positions in the concatenated row
+    so that ColumnReferences from any aliased table resolve correctly.
+    """
+
+    __slots__ = ("positions",)
+
+    def __init__(self, positions: dict[tuple[int, str], int]):
+        self.positions = positions
+
+    @staticmethod
+    def single(table_id: int, colnames: list[str]) -> "EnvBuilder":
+        return EnvBuilder({(table_id, n): i for i, n in enumerate(colnames)})
+
+    def with_alias(self, table_id: int, colnames: list[str], offset: int = 0) -> "EnvBuilder":
+        pos = dict(self.positions)
+        for i, n in enumerate(colnames):
+            pos[(table_id, n)] = offset + i
+        return EnvBuilder(pos)
+
+    def build(self, key: Key, row: Row) -> dict:
+        env: dict = {"id": key}
+        for alias, i in self.positions.items():
+            env[alias] = row[i]
+        return env
+
+
+class InputOperator(Operator):
+    """Entry node; the runner pushes update batches into it."""
+
+    def process(self, port: int, updates: list[Update], time: Time) -> None:
+        self.emit(time, updates)
+
+
+class StatelessRowwise(Operator):
+    """select/with_columns over a single input with deterministic expressions.
+
+    Streams per-delta: f is deterministic, so a retraction maps to the
+    retraction of the mapped row (reference: expression_table_deterministic,
+    dataflow.rs:1557).
+    """
+
+    def __init__(self, env: EnvBuilder, exprs: list[Callable[[dict], Any]], name=""):
+        super().__init__(name)
+        self.env = env
+        self.exprs = exprs
+
+    def process(self, port, updates, time):
+        out: list[Update] = []
+        build = self.env.build
+        exprs = self.exprs
+        for key, row, diff in updates:
+            e = build(key, row)
+            out.append((key, tuple(f(e) for f in exprs), diff))
+        self.emit(time, out)
+
+
+class StatefulRowwise(DiffOutputOperator):
+    """Rowwise over multiple same-universe inputs, or non-deterministic UDFs.
+
+    Port 0 is the primary table; extra ports are same-universe tables whose
+    columns are referenced.  Output exists only when all inputs have the key.
+    """
+
+    def __init__(self, n_inputs: int, env: EnvBuilder, exprs, name=""):
+        super().__init__(n_inputs, name)
+        self.env = env
+        self.exprs = exprs
+
+    def compute(self, key: Key) -> Row | None:
+        rows = []
+        for st in self.state:
+            r = st.get_row(key)
+            if r is None:
+                return None
+            rows.append(r)
+        joined = tuple(v for r in rows for v in r)
+        e = self.env.build(key, joined)
+        return tuple(f(e) for f in self.exprs)
+
+
+class StatelessFilter(Operator):
+    def __init__(self, env: EnvBuilder, predicate: Callable[[dict], Any], name=""):
+        super().__init__(name)
+        self.env = env
+        self.predicate = predicate
+
+    def process(self, port, updates, time):
+        import numpy as np
+
+        out: list[Update] = []
+        for key, row, diff in updates:
+            v = self.predicate(self.env.build(key, row))
+            if isinstance(v, np.generic):
+                v = v.item()
+            if v is True:
+                out.append((key, row, diff))
+        self.emit(time, out)
+
+
+class StatefulFilter(DiffOutputOperator):
+    """filter with references to extra same-universe tables."""
+
+    def __init__(self, n_inputs: int, env: EnvBuilder, predicate, name=""):
+        super().__init__(n_inputs, name)
+        self.env = env
+        self.predicate = predicate
+
+    def compute(self, key):
+        import numpy as np
+
+        rows = []
+        for st in self.state:
+            r = st.get_row(key)
+            if r is None:
+                return None
+            rows.append(r)
+        joined = tuple(v for r in rows for v in r)
+        v = self.predicate(self.env.build(key, joined))
+        if isinstance(v, np.generic):
+            v = v.item()
+        if v is True:
+            return rows[0]
+        return None
+
+
+class ReindexOperator(Operator):
+    """with_id / with_id_from: derive a new key from the row (dataflow.rs
+    reindex; reference Table.with_id_from internals/table.py)."""
+
+    def __init__(self, env: EnvBuilder, key_fn: Callable[[dict], Any], name=""):
+        super().__init__(name)
+        self.env = env
+        self.key_fn = key_fn
+
+    def process(self, port, updates, time):
+        out: list[Update] = []
+        for key, row, diff in updates:
+            new_key = self.key_fn(self.env.build(key, row))
+            out.append((new_key, row, diff))
+        self.emit(time, out)
+
+
+class ConcatOperator(Operator):
+    """Disjoint union; the Table layer guarantees key-disjointness
+    (concat_reindex reindexes first)."""
+
+    def process(self, port, updates, time):
+        self.emit(time, updates)
+
+
+class FlattenOperator(Operator):
+    """Explode a sequence column; new key derived from (key, position)
+    (reference: flatten_table, dataflow.rs)."""
+
+    def __init__(self, position: int, name=""):
+        super().__init__(name)
+        self.position = position
+
+    def process(self, port, updates, time):
+        out: list[Update] = []
+        pos = self.position
+        for key, row, diff in updates:
+            seq = row[pos]
+            if seq is None:
+                continue
+            if isinstance(seq, Error):
+                continue
+            import numpy as np
+
+            if isinstance(seq, (str, bytes)):
+                items: Iterable = list(seq)
+            elif isinstance(seq, np.ndarray):
+                items = list(seq)
+            else:
+                items = seq
+            for j, v in enumerate(items):
+                nk = ref_scalar(key, j)
+                nrow = row[:pos] + (v,) + row[pos + 1 :]
+                out.append((nk, nrow, diff))
+        self.emit(time, out)
+
+
+class JoinOperator(Operator):
+    """Incremental binary join with inner/left/right/outer modes.
+
+    Re-design of join_tables (dataflow.rs:2720): per-side arrangements keyed
+    by join key; each delta joins against the opposite arrangement; outer
+    padding rows are maintained via per-join-key multiplicity totals.
+    """
+
+    def __init__(
+        self,
+        left_env: EnvBuilder,
+        right_env: EnvBuilder,
+        left_on: list[Callable],
+        right_on: list[Callable],
+        how: str,
+        id_policy: str,
+        left_ncols: int,
+        right_ncols: int,
+        exact_match: bool = False,
+        name: str = "",
+    ):
+        super().__init__(name)
+        self.left_env, self.right_env = left_env, right_env
+        self.left_on, self.right_on = left_on, right_on
+        self.how = how
+        self.id_policy = id_policy
+        self.left_ncols, self.right_ncols = left_ncols, right_ncols
+        # jk -> {row_key: (row, count)}
+        self.left: dict[Any, dict[Key, tuple[Row, int]]] = defaultdict(dict)
+        self.right: dict[Any, dict[Key, tuple[Row, int]]] = defaultdict(dict)
+        self.left_total: dict[Any, int] = defaultdict(int)
+        self.right_total: dict[Any, int] = defaultdict(int)
+
+    # -- key derivation ----------------------------------------------------
+    def _out_key(self, lk: Key, rk: Key) -> Key:
+        if self.id_policy == "left":
+            return lk
+        if self.id_policy == "right":
+            return rk
+        return ref_scalar(lk, rk)
+
+    def _pad_key_left(self, lk: Key) -> Key:
+        return lk if self.id_policy == "left" else ref_scalar(lk, None)
+
+    def _pad_key_right(self, rk: Key) -> Key:
+        return rk if self.id_policy == "right" else ref_scalar(None, rk)
+
+    def _jk(self, side: str, key: Key, row: Row):
+        env = (self.left_env if side == "l" else self.right_env).build(key, row)
+        fns = self.left_on if side == "l" else self.right_on
+        vals = tuple(f(env) for f in fns)
+        if any(isinstance(v, Error) for v in vals):
+            return None  # error rows never match
+        try:
+            hash(vals)
+            return vals
+        except TypeError:
+            from ..internals.value import hash_values
+
+            return ("#h", hash_values(vals))
+
+    @staticmethod
+    def _apply(index: dict, totals: dict, jk, key: Key, row: Row, diff: int) -> None:
+        side = index[jk]
+        cur = side.get(key)
+        if cur is None:
+            side[key] = (row, diff)
+        else:
+            crow, c = cur
+            if c + diff == 0:
+                del side[key]
+            else:
+                side[key] = (row if diff > 0 else crow, c + diff)
+        if not side:
+            del index[jk]
+        totals[jk] += diff
+        if totals[jk] == 0:
+            del totals[jk]
+
+    def process(self, port, updates, time):
+        out: list[Update] = []
+        pad_r = (None,) * self.right_ncols
+        pad_l = (None,) * self.left_ncols
+        for key, row, diff in updates:
+            if port == 0:
+                jk = self._jk("l", key, row)
+                if jk is None:
+                    continue
+                # join against current right state
+                for rk, (rrow, rc) in list(self.right.get(jk, {}).items()):
+                    out.append(
+                        (self._out_key(key, rk), row + rrow + (key, rk), diff * rc)
+                    )
+                if self.how in ("left", "outer") and self.right_total.get(jk, 0) == 0:
+                    out.append((self._pad_key_left(key), row + pad_r + (key, None), diff))
+                self._apply(self.left, self.left_total, jk, key, row, diff)
+                # right-outer padding driven by left-side emptiness changes
+                if self.how in ("right", "outer"):
+                    lt_new = self.left_total.get(jk, 0)
+                    lt_old = lt_new - diff
+                    if lt_old == 0 and lt_new != 0:
+                        for rk, (rrow, rc) in list(self.right.get(jk, {}).items()):
+                            out.append(
+                                (self._pad_key_right(rk), pad_l + rrow + (None, rk), -rc)
+                            )
+                    elif lt_old != 0 and lt_new == 0:
+                        for rk, (rrow, rc) in list(self.right.get(jk, {}).items()):
+                            out.append(
+                                (self._pad_key_right(rk), pad_l + rrow + (None, rk), rc)
+                            )
+            else:
+                jk = self._jk("r", key, row)
+                if jk is None:
+                    continue
+                old_total = self.right_total.get(jk, 0)
+                for lk, (lrow, lc) in list(self.left.get(jk, {}).items()):
+                    out.append(
+                        (self._out_key(lk, key), lrow + row + (lk, key), diff * lc)
+                    )
+                self._apply(self.right, self.right_total, jk, key, row, diff)
+                new_total = self.right_total.get(jk, 0)
+                if self.how in ("left", "outer"):
+                    if old_total == 0 and new_total != 0:
+                        for lk, (lrow, lc) in list(self.left.get(jk, {}).items()):
+                            out.append(
+                                (self._pad_key_left(lk), lrow + pad_r + (lk, None), -lc)
+                            )
+                    elif old_total != 0 and new_total == 0:
+                        for lk, (lrow, lc) in list(self.left.get(jk, {}).items()):
+                            out.append(
+                                (self._pad_key_left(lk), lrow + pad_r + (lk, None), lc)
+                            )
+                if self.how in ("right", "outer"):
+                    if self.left_total.get(jk, 0) == 0:
+                        out.append(
+                            (self._pad_key_right(key), pad_l + row + (None, key), diff)
+                        )
+        self.emit(time, consolidate(out))
+
+
+class GroupbyOperator(Operator):
+    """Incremental groupby with the full reducer set (dataflow.rs:3747).
+
+    Output stabilizes once per logical time: per dirty group, the operator
+    diffs the freshly-computed row against the last emitted one.
+    """
+
+    def __init__(
+        self,
+        env: EnvBuilder,
+        gb_fns: list[Callable],
+        reducers: list[tuple[str, list[Callable], dict]],
+        n_out_gvals: int | None = None,
+        key_fn: Callable | None = None,
+        sort_fn: Callable | None = None,
+        name: str = "",
+    ):
+        super().__init__(name)
+        self.env = env
+        self.gb_fns = gb_fns
+        self.n_out_gvals = len(gb_fns) if n_out_gvals is None else n_out_gvals
+        self.key_fn = key_fn
+        self.sort_fn = sort_fn
+        self.reducer_specs = reducers
+        # gkey -> (gvals, [ReducerState], count)
+        self.groups: dict[Key, list] = {}
+        self.last_out: dict[Key, Row] = {}
+        self._dirty: set[Key] = set()
+
+    def process(self, port, updates, time):
+        from . import reducers_impl
+
+        for key, row, diff in updates:
+            e = self.env.build(key, row)
+            gvals = tuple(f(e) for f in self.gb_fns)
+            gkey = self.key_fn(e) if self.key_fn is not None else ref_scalar(*gvals)
+            group = self.groups.get(gkey)
+            if group is None:
+                states = [
+                    reducers_impl.make_state(rid, kw) for rid, _, kw in self.reducer_specs
+                ]
+                group = [gvals, states, 0]
+                self.groups[gkey] = group
+            group[2] += diff
+            # ordering key for tuple/ndarray/earliest reducers: sort_by wins,
+            # row key breaks ties (reference: sort_by in group_by_table)
+            okey = key if self.sort_fn is None else (_sort_key(self.sort_fn(e)), key)
+            for (rid, arg_fns, kw), st in zip(self.reducer_specs, group[1]):
+                args = tuple(f(e) for f in arg_fns)
+                st.update(args, diff, time, okey)
+            self._dirty.add(gkey)
+
+    def flush(self, time):
+        if not self._dirty:
+            return
+        out: list[Update] = []
+        for gkey in self._dirty:
+            group = self.groups.get(gkey)
+            old = self.last_out.get(gkey)
+            if group is None or group[2] <= 0:
+                # negative counts are kept: a retraction can precede its
+                # matching insertion across logical times; the group resolves
+                # to 0 (and is dropped) once the insertion arrives
+                if group is not None and group[2] == 0:
+                    del self.groups[gkey]
+                if old is not None:
+                    out.append((gkey, old, -1))
+                    del self.last_out[gkey]
+                continue
+            new_row = tuple(group[0][: self.n_out_gvals]) + tuple(
+                st.value() for st in group[1]
+            )
+            if rows_equal(new_row, old):
+                continue
+            if old is not None:
+                out.append((gkey, old, -1))
+            out.append((gkey, new_row, 1))
+            self.last_out[gkey] = new_row
+        self._dirty.clear()
+        self.emit(time, consolidate(out))
+
+
+def _sort_key(v):
+    # totally-ordered wrapper for heterogeneous sort values
+    if v is None:
+        return (0, 0)
+    try:
+        v < v  # comparability probe
+        return (1, v)
+    except TypeError:
+        from ..internals.value import hash_values
+
+        return (2, hash_values(v))
+
+
+class IxOperator(DiffOutputOperator):
+    """Pointer lookup: output[src_key] = target_row[ptr(src_row)]
+    (reference: ix/ix_ref, internals/table.py; restrict/with_universe_of uses
+    the identity pointer)."""
+
+    def __init__(
+        self,
+        src_env: EnvBuilder,
+        ptr_fn: Callable[[dict], Any],
+        optional: bool,
+        target_ncols: int,
+        name: str = "",
+    ):
+        super().__init__(2, name)
+        self.src_env = src_env
+        self.ptr_fn = ptr_fn
+        self.optional = optional
+        self.target_ncols = target_ncols
+        self.fwd: dict[Key, Any] = {}
+        self.rev: dict[Any, set[Key]] = defaultdict(set)
+
+    def _ptr(self, key: Key, row: Row):
+        return self.ptr_fn(self.src_env.build(key, row))
+
+    def pre_apply(self, port, key, row, diff):
+        if port != 0:
+            return
+        if diff > 0:
+            ptr = self._ptr(key, row)
+            old = self.fwd.get(key)
+            if old is not None and old != ptr:
+                self.rev[old].discard(key)
+            self.fwd[key] = ptr
+            self.rev[ptr].add(key)
+        # retractions keep reverse entries until recompute; harmless
+
+    def dirty_keys_for(self, port, key):
+        if port == 0:
+            return (key,)
+        return tuple(self.rev.get(key, ()))
+
+    def compute(self, key):
+        srow = self.state[0].get_row(key)
+        if srow is None:
+            return None
+        ptr = self._ptr(key, srow)
+        if ptr is None:
+            if self.optional:
+                return (None,) * self.target_ncols
+            return None
+        trow = self.state[1].get_row(ptr)
+        if trow is None:
+            if self.optional:
+                return (None,) * self.target_ncols
+            return None
+        return trow
+
+
+class DifferenceOperator(DiffOutputOperator):
+    def __init__(self, name=""):
+        super().__init__(2, name)
+
+    def compute(self, key):
+        if key in self.state[1]:
+            return None
+        return self.state[0].get_row(key)
+
+
+class IntersectOperator(DiffOutputOperator):
+    def __init__(self, n_inputs: int, name=""):
+        super().__init__(n_inputs, name)
+
+    def compute(self, key):
+        for st in self.state[1:]:
+            if key not in st:
+                return None
+        return self.state[0].get_row(key)
+
+
+class UpdateRowsOperator(DiffOutputOperator):
+    """other's rows override self's by key (internals/table.py update_rows)."""
+
+    def __init__(self, name=""):
+        super().__init__(2, name)
+
+    def compute(self, key):
+        r = self.state[1].get_row(key)
+        if r is not None:
+            return r
+        return self.state[0].get_row(key)
+
+
+class UpdateCellsOperator(DiffOutputOperator):
+    """Override a subset of columns for matching keys (update_cells)."""
+
+    def __init__(self, positions: list[int], name=""):
+        super().__init__(2, name)
+        self.positions = positions
+
+    def compute(self, key):
+        base = self.state[0].get_row(key)
+        if base is None:
+            return None
+        over = self.state[1].get_row(key)
+        if over is None:
+            return base
+        row = list(base)
+        for i, pos in enumerate(self.positions):
+            row[pos] = over[i]
+        return tuple(row)
+
+
+class DeduplicateOperator(Operator):
+    """Stateful deduplication with a user acceptor
+    (reference: deduplicate, dataflow.rs:3858; stdlib/stateful/deduplicate.py)."""
+
+    def __init__(
+        self,
+        env: EnvBuilder,
+        value_fn: Callable,
+        instance_fns: list[Callable],
+        acceptor: Callable[[Any, Any], bool],
+        name: str = "",
+    ):
+        super().__init__(name)
+        self.env = env
+        self.value_fn = value_fn
+        self.instance_fns = instance_fns
+        self.acceptor = acceptor
+        # instance_key -> (value, row)
+        self.accepted: dict[Key, tuple[Any, Row]] = {}
+        self._pending_out: list[Update] = []
+
+    def process(self, port, updates, time):
+        for key, row, diff in updates:
+            if diff <= 0:
+                continue  # deduplicate consumes append-only streams
+            e = self.env.build(key, row)
+            value = self.value_fn(e)
+            ivals = tuple(f(e) for f in self.instance_fns)
+            ikey = ref_scalar(*ivals) if ivals else ref_scalar(None)
+            cur = self.accepted.get(ikey)
+            # first value is always accepted (reference:
+            # expression_evaluator deduplicate — `state is None or acceptor(...)`)
+            accept = cur is None or bool(self.acceptor(value, cur[0]))
+            if accept:
+                if cur is not None:
+                    self._pending_out.append((ikey, cur[1], -1))
+                self.accepted[ikey] = (value, row)
+                self._pending_out.append((ikey, row, 1))
+
+    def flush(self, time):
+        if self._pending_out:
+            self.emit(time, consolidate(self._pending_out))
+            self._pending_out = []
+
+
+class OutputOperator(Operator):
+    """Terminal sink: consolidates per time and invokes a callback
+    (reference: output_table/subscribe_table, dataflow.rs:4405,4510)."""
+
+    def __init__(
+        self,
+        on_time: Callable[[Time, list[Update]], None],
+        on_end: Callable[[], None] | None = None,
+        name: str = "",
+    ):
+        super().__init__(name)
+        self._on_time = on_time
+        self._on_end = on_end
+        self._buffer: list[Update] = []
+
+    def process(self, port, updates, time):
+        self._buffer.extend(updates)
+
+    def flush(self, time):
+        if self._buffer:
+            batch = consolidate(self._buffer)
+            self._buffer = []
+            if batch:
+                self._on_time(time, batch)
+
+    def on_end(self):
+        if self._on_end is not None:
+            self._on_end()

@@ -1,0 +1,321 @@
+"""GraphRunner: lower the ParseGraph to engine operators and execute.
+
+Reference call stack being re-designed: GraphRunner.run_outputs →
+run_with_new_graph → timely worker loop (SURVEY.md §3.1).  Here the lowering
+and the scheduler live in-process; streaming mode polls live sources and
+stamps wall-clock logical times (even-numbered, matching the reference's
+alt-neu convention, src/connectors/mod.rs:248).
+"""
+
+from __future__ import annotations
+
+import time as _time
+from collections import defaultdict
+from typing import Any, Callable
+
+from ..internals import parse_graph as pg
+from ..internals.expression import ColumnExpression
+from ..internals.table import Table
+from . import operators as ops
+from .graph import Operator, Scheduler
+from .types import CapturedStream, Update
+
+
+def _compile(expr: ColumnExpression) -> Callable[[dict], Any]:
+    return expr._eval
+
+
+class LoweredGraph:
+    def __init__(self) -> None:
+        self.scheduler = Scheduler()
+        self.by_node: dict[int, Operator] = {}
+        self.input_ops: list[tuple[ops.InputOperator, Any]] = []  # (op, source)
+        self.captures: dict[int, CapturedStream] = {}
+        self.output_callbacks: list[Callable[[], None]] = []
+
+
+def _env_for(table: Table) -> ops.EnvBuilder:
+    positions = {(id(table), n): i for i, n in enumerate(table._colnames)}
+    if table._aliases:
+        positions.update(table._aliases)
+    return ops.EnvBuilder(positions)
+
+
+def _env_multi(tables: list[Table]) -> ops.EnvBuilder:
+    positions: dict[tuple[int, str], int] = {}
+    offset = 0
+    for t in tables:
+        for i, n in enumerate(t._colnames):
+            positions.setdefault((id(t), n), offset + i)
+        if t._aliases:
+            for k, p in t._aliases.items():
+                positions.setdefault(k, offset + p)
+        offset += len(t._colnames)
+    return ops.EnvBuilder(positions)
+
+
+def lower(sinks: list[pg.OpNode]) -> LoweredGraph:
+    lg = LoweredGraph()
+
+    def build(node: pg.OpNode) -> Operator:
+        if node.id in lg.by_node:
+            return lg.by_node[node.id]
+        upstream = [build(t._node) for t in node.input_tables]
+        op = _make_operator(node, lg)
+        lg.scheduler.register(op)
+        op.connect(*upstream)
+        lg.by_node[node.id] = op
+        return op
+
+    for sink in sinks:
+        build(sink)
+    return lg
+
+
+def _make_operator(node: pg.OpNode, lg: LoweredGraph) -> Operator:
+    kind = node.kind
+    p = node.params
+    tables = node.input_tables
+
+    if kind == "input":
+        op = ops.InputOperator(name=f"input:{node.id}")
+        lg.input_ops.append((op, p["source"]))
+        return op
+
+    if kind == "rowwise":
+        exprs = [_compile(e) for e in p["exprs"]]
+        if p.get("deterministic", True) and len(tables) == 1:
+            return ops.StatelessRowwise(_env_for(tables[0]), exprs, name="select")
+        return ops.StatefulRowwise(len(tables), _env_multi(tables), exprs, name="select*")
+
+    if kind == "filter":
+        pred = _compile(p["predicate"])
+        if p.get("deterministic", True) and len(tables) == 1:
+            return ops.StatelessFilter(_env_for(tables[0]), pred, name="filter")
+        return ops.StatefulFilter(len(tables), _env_multi(tables), pred, name="filter*")
+
+    if kind == "reindex":
+        return ops.ReindexOperator(_env_for(tables[0]), _compile(p["key_expr"]), name="reindex")
+
+    if kind == "concat":
+        return ops.ConcatOperator(name="concat")
+
+    if kind == "flatten":
+        return ops.FlattenOperator(p["position"], name="flatten")
+
+    if kind == "join":
+        lt, rt = tables
+        return ops.JoinOperator(
+            _env_for(lt),
+            _env_for(rt),
+            [_compile(e) for e in p["left_on"]],
+            [_compile(e) for e in p["right_on"]],
+            p["how"],
+            p["id_policy"],
+            len(lt._colnames),
+            len(rt._colnames),
+            name=f"join:{p['how']}",
+        )
+
+    if kind == "groupby":
+        src = tables[0]
+        n_out = len(p["gb_exprs"])
+        gb_fns = [_compile(e) for e in p["gb_exprs"]]
+        if p.get("instance") is not None:
+            gb_fns.append(_compile(p["instance"]))
+        reducers = [
+            (rid, [_compile(a) for a in args], kw) for rid, args, kw in p["reducers"]
+        ]
+        return ops.GroupbyOperator(
+            _env_for(src),
+            gb_fns,
+            reducers,
+            n_out_gvals=n_out,
+            key_fn=_compile(p["id_expr"]) if p.get("id_expr") is not None else None,
+            sort_fn=_compile(p["sort_by"]) if p.get("sort_by") is not None else None,
+            name="groupby",
+        )
+
+    if kind == "ix":
+        src, target = tables
+        return ops.IxOperator(
+            _env_for(src),
+            _compile(p["ptr_expr"]),
+            p["optional"],
+            len(target._colnames),
+            name="ix",
+        )
+
+    if kind == "difference":
+        return ops.DifferenceOperator(name="difference")
+
+    if kind == "intersect":
+        return ops.IntersectOperator(len(tables), name="intersect")
+
+    if kind == "update_rows":
+        return ops.UpdateRowsOperator(name="update_rows")
+
+    if kind == "update_cells":
+        return ops.UpdateCellsOperator(p["positions"], name="update_cells")
+
+    if kind == "deduplicate":
+        src = tables[0]
+        return ops.DeduplicateOperator(
+            _env_for(src),
+            _compile(p["value_expr"]),
+            [_compile(e) for e in p["instance_exprs"]],
+            p["acceptor"],
+            name="deduplicate",
+        )
+
+    if kind == "capture":
+        cap = CapturedStream(p["colnames"])
+        lg.captures[node.id] = cap
+
+        def on_time(t, updates, _cap=cap):
+            _cap.extend(t, updates)
+
+        return ops.OutputOperator(on_time, name="capture")
+
+    if kind == "subscribe":
+        on_change = p.get("on_change")
+        on_time_end = p.get("on_time_end")
+        on_end = p.get("on_end")
+        colnames = p["colnames"]
+
+        def on_time(t, updates):
+            if on_change is not None:
+                from .types import unwrap_row
+
+                for key, row, diff in updates:
+                    row_d = dict(zip(colnames, unwrap_row(row)))
+                    on_change(key=key, row=row_d, time=t, is_addition=diff > 0)
+            if on_time_end is not None:
+                on_time_end(t)
+
+        return ops.OutputOperator(on_time, on_end=on_end, name="subscribe")
+
+    if kind == "output":
+        writer = p["writer"]
+        colnames = p["colnames"]
+
+        def on_time(t, updates, _w=writer):
+            _w.write_batch(t, colnames, updates)
+
+        return ops.OutputOperator(on_time, on_end=getattr(writer, "close", None), name="output")
+
+    if kind in _EXTRA_LOWERINGS:
+        return _EXTRA_LOWERINGS[kind](node, lg)
+
+    raise NotImplementedError(f"no lowering for node kind {kind!r}")
+
+
+# plug-in lowering registry for stdlib/temporal/index operators
+_EXTRA_LOWERINGS: dict[str, Callable[[pg.OpNode, "LoweredGraph"], Operator]] = {}
+
+
+def register_lowering(kind: str):
+    def deco(fn):
+        _EXTRA_LOWERINGS[kind] = fn
+        return fn
+
+    return deco
+
+
+class GraphRunner:
+    def __init__(self, sinks: list[pg.OpNode]):
+        self.lg = lower(sinks)
+
+    def run_batch(self) -> dict[int, CapturedStream]:
+        """Feed all static events, process times in order, finish."""
+        by_time: dict[int, dict[int, list[Update]]] = defaultdict(lambda: defaultdict(list))
+        for op, source in self.lg.input_ops:
+            for t, key, row, diff in source.static_events():
+                by_time[t][op.id].append((key, row, diff))
+        sched = self.lg.scheduler
+        op_by_id = {op.id: op for op, _ in self.lg.input_ops}
+        for t in sorted(by_time):
+            for op_id, updates in by_time[t].items():
+                sched.push_input(op_by_id[op_id], t, updates)
+        sched.finish()
+        return self.lg.captures
+
+    def run_streaming(
+        self,
+        autocommit_ms: int = 50,
+        timeout_s: float | None = None,
+        idle_stop_s: float | None = None,
+    ) -> dict[int, CapturedStream]:
+        """Poll live sources; stamp each commit with an even logical time."""
+        sched = self.lg.scheduler
+        live = []
+        start = _time.monotonic()
+        for op, source in self.lg.input_ops:
+            if source.is_live():
+                source.start()
+                live.append((op, source))
+            else:
+                events = source.static_events()
+                if events:
+                    by_t: dict[int, list[Update]] = defaultdict(list)
+                    for t, key, row, diff in events:
+                        by_t[t].append((key, row, diff))
+                    for t in sorted(by_t):
+                        sched.push_input(op, t, by_t[t])
+        sched.run_until_idle()
+        last_event = _time.monotonic()
+        finished: set[int] = set()
+        logical = sched.frontier + 2 if sched.frontier >= 0 else 0
+        if logical % 2:
+            logical += 1
+        while live and len(finished) < len(live):
+            got_any = False
+            for op, source in live:
+                if op.id in finished:
+                    continue
+                events = source.poll()
+                if events is None:
+                    finished.add(op.id)
+                    continue
+                if events:
+                    got_any = True
+                    updates = [(key, row, diff) for _, key, row, diff in events]
+                    sched.push_input(op, logical, updates)
+            if got_any:
+                sched.run_until_idle()
+                logical += 2
+                last_event = _time.monotonic()
+            else:
+                _time.sleep(autocommit_ms / 1000.0)
+            now = _time.monotonic()
+            if timeout_s is not None and now - start > timeout_s:
+                break
+            if idle_stop_s is not None and now - last_event > idle_stop_s:
+                break
+        for op in self.lg.scheduler.topo_order():
+            op.on_end()
+        sched.run_until_idle()
+        return self.lg.captures
+
+
+def run_tables(*tables: Table) -> list[CapturedStream]:
+    """Capture the final update streams of the given tables (test harness —
+    mirrors GraphRunner.run_tables, reference tests/utils.py:314)."""
+    sinks = [t._materialize_capture() for t in tables]
+    runner = GraphRunner(sinks)
+    caps = runner.run_batch()
+    return [caps[s.id] for s in sinks]
+
+
+def has_live_sources(sinks: list[pg.OpNode]) -> bool:
+    seen = set()
+
+    def visit(node) -> bool:
+        if node.id in seen:
+            return False
+        seen.add(node.id)
+        if node.kind == "input" and node.params["source"].is_live():
+            return True
+        return any(visit(t._node) for t in node.input_tables)
+
+    return any(visit(s) for s in sinks)

@@ -1,0 +1,46 @@
+"""Env-var backed configuration (reference: internals/config.py:199).
+
+All knobs also settable programmatically; licensing is a no-op acceptance
+layer kept for API parity (reference: src/engine/license.rs).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+
+
+def _env_bool(name: str, default: bool = False) -> bool:
+    v = os.environ.get(name)
+    if v is None:
+        return default
+    return v.strip().lower() in ("1", "true", "yes", "on")
+
+
+@dataclasses.dataclass
+class PathwayConfig:
+    license_key: str | None = os.environ.get("PATHWAY_LICENSE_KEY")
+    monitoring_server: str | None = os.environ.get("PATHWAY_MONITORING_SERVER")
+    run_id: str = os.environ.get("PATHWAY_RUN_ID", "")
+    persistent_storage: str | None = os.environ.get("PATHWAY_PERSISTENT_STORAGE")
+    ignore_asserts: bool = _env_bool("PATHWAY_IGNORE_ASSERTS")
+    threads: int = int(os.environ.get("PATHWAY_THREADS", "1"))
+    processes: int = int(os.environ.get("PATHWAY_PROCESSES", "1"))
+    process_id: int = int(os.environ.get("PATHWAY_PROCESS_ID", "0"))
+    first_port: int = int(os.environ.get("PATHWAY_FIRST_PORT", "10000"))
+    terminate_on_error: bool = True
+
+
+pathway_config = PathwayConfig()
+
+
+def get_pathway_config() -> PathwayConfig:
+    return pathway_config
+
+
+def set_license_key(key: str | None) -> None:
+    pathway_config.license_key = key
+
+
+def set_monitoring_config(*, server_endpoint: str | None = None) -> None:
+    pathway_config.monitoring_server = server_endpoint

@@ -1,0 +1,35 @@
+"""Monitoring levels + console stats (reference: internals/monitoring.py).
+
+The rich-TUI dashboard equivalent lives in utils/console; here we keep the
+public enum and a lightweight stats snapshotter fed by engine operator
+counters (engine/graph.py Operator.rows_in/rows_out).
+"""
+
+from __future__ import annotations
+
+import enum
+
+
+class MonitoringLevel(enum.Enum):
+    AUTO = 0
+    AUTO_ALL = 1
+    NONE = 2
+    IN_OUT = 3
+    ALL = 4
+
+
+class StatsMonitor:
+    def __init__(self, scheduler):
+        self.scheduler = scheduler
+
+    def snapshot(self) -> dict:
+        ops = {}
+        for op in self.scheduler.operators:
+            ops[f"{op.name}#{op.id}"] = {
+                "rows_in": op.rows_in,
+                "rows_out": op.rows_out,
+            }
+        return {
+            "frontier": self.scheduler.frontier,
+            "operators": ops,
+        }

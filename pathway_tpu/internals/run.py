@@ -1,0 +1,48 @@
+"""pw.run() — execute every registered output sink.
+
+Reference: python/pathway/internals/run.py:13.  Batch graphs execute to
+completion; graphs with live sources run the streaming poll loop.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from ..engine.runner import GraphRunner, has_live_sources
+from . import parse_graph as pg
+
+
+def run(
+    *,
+    debug: bool = False,
+    monitoring_level: Any = None,
+    with_http_server: bool = False,
+    default_logging: bool = True,
+    persistence_config: Any = None,
+    runtime_typechecking: bool = False,
+    terminate_on_error: bool = True,
+    autocommit_duration_ms: int = 50,
+    timeout_s: float | None = None,
+    idle_stop_s: float | None = None,
+    **kwargs: Any,
+) -> None:
+    sinks = list(pg.G.outputs)
+    if not sinks:
+        return
+    runner = GraphRunner(sinks)
+    if persistence_config is not None:
+        from ..persistence import attach_persistence
+
+        attach_persistence(runner, persistence_config)
+    if has_live_sources(sinks):
+        runner.run_streaming(
+            autocommit_ms=autocommit_duration_ms,
+            timeout_s=timeout_s,
+            idle_stop_s=idle_stop_s,
+        )
+    else:
+        runner.run_batch()
+
+
+def run_all(**kwargs: Any) -> None:
+    run(**kwargs)

@@ -1,0 +1,112 @@
+"""pw.sql — SQL front-end (reference: internals/sql/processing.py via sqlglot).
+
+Minimal dialect: SELECT cols/exprs FROM t [WHERE ...] [GROUP BY ...]; lowered
+onto Table.select/filter/groupby.  sqlglot is not available in this
+environment, so a small parser covers the common subset; unsupported syntax
+raises with a clear message.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any
+
+from . import reducers
+from .expression import ColumnExpression
+from .table import Table
+from .thisclass import this
+
+_AGGS = {
+    "count": reducers.count,
+    "sum": reducers.sum,
+    "avg": reducers.avg,
+    "min": reducers.min,
+    "max": reducers.max,
+}
+
+
+def sql(query: str, **tables: Table) -> Table:
+    q = query.strip().rstrip(";")
+    m = re.match(
+        r"(?is)^select\s+(?P<cols>.*?)\s+from\s+(?P<table>\w+)"
+        r"(?:\s+where\s+(?P<where>.*?))?"
+        r"(?:\s+group\s+by\s+(?P<group>.*?))?$",
+        q,
+    )
+    if not m:
+        raise NotImplementedError(f"unsupported SQL: {query!r}")
+    tname = m.group("table")
+    if tname not in tables:
+        raise ValueError(f"unknown table {tname!r} in SQL query")
+    t = tables[tname]
+    if m.group("where"):
+        t = t.filter(_parse_expr(m.group("where"), t))
+    cols_txt = _split_commas(m.group("cols"))
+    group_txt = m.group("group")
+    if group_txt:
+        gb_cols = [c.strip() for c in group_txt.split(",")]
+        out: dict[str, Any] = {}
+        for c in cols_txt:
+            name, e = _parse_output(c, t)
+            out[name] = e
+        return t.groupby(*[t[g] for g in gb_cols]).reduce(**out)
+    if len(cols_txt) == 1 and cols_txt[0].strip() == "*":
+        return t.select(*[t[n] for n in t.column_names()])
+    has_agg = any(re.match(r"(?i)\s*(count|sum|avg|min|max)\s*\(", c) for c in cols_txt)
+    out = {}
+    for c in cols_txt:
+        name, e = _parse_output(c, t)
+        out[name] = e
+    if has_agg:
+        return t.reduce(**out)
+    return t.select(**out)
+
+
+def _split_commas(s: str) -> list[str]:
+    parts, depth, cur = [], 0, []
+    for ch in s:
+        if ch == "(":
+            depth += 1
+        elif ch == ")":
+            depth -= 1
+        if ch == "," and depth == 0:
+            parts.append("".join(cur))
+            cur = []
+        else:
+            cur.append(ch)
+    parts.append("".join(cur))
+    return [p for p in parts if p.strip()]
+
+
+def _parse_output(col: str, t: Table):
+    m = re.match(r"(?is)^(?P<expr>.*?)\s+as\s+(?P<name>\w+)\s*$", col.strip())
+    if m:
+        e = _parse_expr(m.group("expr"), t)
+        return m.group("name"), e
+    e = _parse_expr(col.strip(), t)
+    name = col.strip() if re.match(r"^\w+$", col.strip()) else f"col_{abs(hash(col)) % 1000}"
+    magg = re.match(r"(?i)^\s*(count|sum|avg|min|max)\s*\(", col.strip())
+    if magg:
+        name = magg.group(1).lower()
+    return name, e
+
+
+def _parse_expr(txt: str, t: Table) -> Any:
+    txt = txt.strip()
+    magg = re.match(r"(?is)^(count|sum|avg|min|max)\s*\((.*)\)$", txt)
+    if magg:
+        fn = _AGGS[magg.group(1).lower()]
+        inner = magg.group(2).strip()
+        if inner == "*":
+            return reducers.count()
+        return fn(_parse_expr(inner, t))
+    # binary comparisons / arithmetic via safe eval over column names
+    names = {n: t[n] for n in t.column_names()}
+    py = re.sub(r"(?<![<>!=])=(?!=)", "==", txt)
+    py = re.sub(r"(?i)\bAND\b", "&", py)
+    py = re.sub(r"(?i)\bOR\b", "|", py)
+    py = re.sub(r"(?i)\bNOT\b", "~", py)
+    try:
+        return eval(py, {"__builtins__": {}}, names)  # noqa: S307 - controlled env
+    except Exception as exc:
+        raise NotImplementedError(f"unsupported SQL expression: {txt!r} ({exc})")

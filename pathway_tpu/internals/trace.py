@@ -1,0 +1,29 @@
+"""User stack-frame capture for error attribution.
+
+Reference: python/pathway/internals/trace.py — operators remember where in
+user code they were created so engine errors point at the right line.
+"""
+
+from __future__ import annotations
+
+import traceback
+from dataclasses import dataclass
+
+
+@dataclass(frozen=True)
+class Trace:
+    filename: str
+    line_number: int
+    line: str
+
+    def __str__(self) -> str:
+        return f"{self.filename}:{self.line_number} :: {self.line}"
+
+
+def capture_trace() -> Trace | None:
+    for frame in reversed(traceback.extract_stack()):
+        fn = frame.filename
+        if "/pathway_tpu/" in fn or fn.startswith("<"):
+            continue
+        return Trace(fn, frame.lineno or 0, frame.line or "")
+    return None

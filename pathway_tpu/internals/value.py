@@ -1,0 +1,230 @@
+"""Value model: dynamic values, 128-bit keys (Pointers), stable hashing.
+
+TPU-native re-design of the reference's value model
+(/root/reference/src/engine/value.rs:41,209): values stay host-side Python
+objects until they hit a dense operator, at which point homogeneous columns are
+encoded as numpy / jax arrays.  Keys are 128-bit stable hashes so that row
+identity is deterministic across workers, processes, and restarts.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import math
+import struct
+from typing import Any, Iterable
+
+_MASK128 = (1 << 128) - 1
+
+
+class Pointer(int):
+    """A 128-bit row id.  Subclass of int so it is cheap, hashable, sortable.
+
+    Mirrors the reference's `Key` (src/engine/value.rs:41) which is a 128-bit
+    hash; here it doubles as the Python-visible `pw.Pointer` value.
+    """
+
+    __slots__ = ()
+
+    def __repr__(self) -> str:  # pragma: no cover - cosmetic
+        return f"^{int(self):032X}"[:12] + "…"
+
+
+def _ser(value: Any, out: list[bytes]) -> None:
+    """Canonical serialization for hashing. Type-tagged to avoid collisions."""
+    if value is None:
+        out.append(b"N")
+    elif value is True:
+        out.append(b"T")
+    elif value is False:
+        out.append(b"F")
+    elif isinstance(value, Pointer):
+        out.append(b"P" + int(value).to_bytes(16, "little"))
+    elif isinstance(value, int):
+        out.append(b"I" + value.to_bytes((value.bit_length() + 8) // 8 + 1, "little", signed=True))
+    elif isinstance(value, float):
+        if math.isnan(value):
+            out.append(b"f" + b"nan")
+        else:
+            out.append(b"f" + struct.pack("<d", value))
+    elif isinstance(value, str):
+        b = value.encode("utf-8")
+        out.append(b"S" + len(b).to_bytes(8, "little") + b)
+    elif isinstance(value, bytes):
+        out.append(b"B" + len(value).to_bytes(8, "little") + value)
+    elif isinstance(value, tuple) or isinstance(value, list):
+        out.append(b"(" + len(value).to_bytes(8, "little"))
+        for v in value:
+            _ser(v, out)
+        out.append(b")")
+    elif isinstance(value, dict):
+        out.append(b"{" + len(value).to_bytes(8, "little"))
+        for k in sorted(value, key=str):
+            _ser(str(k), out)
+            _ser(value[k], out)
+        out.append(b"}")
+    else:
+        # numpy arrays, datetimes, Json wrappers, arbitrary objects
+        import numpy as np
+
+        if isinstance(value, np.ndarray):
+            out.append(b"A" + str(value.dtype).encode() + str(value.shape).encode() + value.tobytes())
+        elif isinstance(value, np.generic):
+            _ser(value.item(), out)
+        elif hasattr(value, "_pw_hash_repr_"):
+            _ser(value._pw_hash_repr_(), out)
+        else:
+            out.append(b"O" + repr(value).encode("utf-8"))
+
+
+def hash_values(*values: Any) -> int:
+    """128-bit stable hash of a value tuple."""
+    out: list[bytes] = []
+    for v in values:
+        _ser(v, out)
+    d = hashlib.blake2b(b"".join(out), digest_size=16).digest()
+    return int.from_bytes(d, "little")
+
+
+def ref_scalar(*values: Any) -> Pointer:
+    """Derive a Pointer from values (reference: `Key::for_values`)."""
+    return Pointer(hash_values(*values) & _MASK128)
+
+
+def ref_scalar_with_instance(values: Iterable[Any], instance: Any) -> Pointer:
+    return Pointer(hash_values(tuple(values), ("#instance", instance)) & _MASK128)
+
+
+_SEQ_SALT = hash_values("__pathway_tpu_sequential__")
+
+
+def sequential_pointer(n: int) -> Pointer:
+    """Deterministic pointer for the n-th row of a generated sequence."""
+    return Pointer(hash_values(_SEQ_SALT, n) & _MASK128)
+
+
+class Json:
+    """pw.Json — wrapper for parsed JSON values (reference: internals/json.py)."""
+
+    __slots__ = ("value",)
+
+    def __init__(self, value: Any) -> None:
+        if isinstance(value, Json):
+            value = value.value
+        self.value = value
+
+    # -- accessors ---------------------------------------------------------
+    def __getitem__(self, item: Any) -> "Json":
+        return Json(self.value[item])
+
+    def get(self, key: Any, default: Any = None) -> Any:
+        if isinstance(self.value, dict):
+            v = self.value.get(key, default)
+        elif isinstance(self.value, list) and isinstance(key, int):
+            v = self.value[key] if -len(self.value) <= key < len(self.value) else default
+        else:
+            v = default
+        return Json(v) if not isinstance(v, Json) else v
+
+    def as_int(self) -> int | None:
+        return self.value if isinstance(self.value, int) and not isinstance(self.value, bool) else None
+
+    def as_float(self) -> float | None:
+        if isinstance(self.value, bool):
+            return None
+        return float(self.value) if isinstance(self.value, (int, float)) else None
+
+    def as_str(self) -> str | None:
+        return self.value if isinstance(self.value, str) else None
+
+    def as_bool(self) -> bool | None:
+        return self.value if isinstance(self.value, bool) else None
+
+    def as_list(self) -> list | None:
+        return self.value if isinstance(self.value, list) else None
+
+    def as_dict(self) -> dict | None:
+        return self.value if isinstance(self.value, dict) else None
+
+    def __eq__(self, other: Any) -> bool:
+        if isinstance(other, Json):
+            return self.value == other.value
+        return self.value == other
+
+    def __hash__(self) -> int:
+        return hash_values(self._pw_hash_repr_()) & 0x7FFFFFFFFFFFFFFF
+
+    def _pw_hash_repr_(self) -> Any:
+        import json as _json
+
+        return ("#json", _json.dumps(self.value, sort_keys=True, default=str))
+
+    def __repr__(self) -> str:
+        return f"pw.Json({self.value!r})"
+
+    def __str__(self) -> str:
+        import json as _json
+
+        return _json.dumps(self.value, default=str)
+
+    @staticmethod
+    def parse(s: str | bytes) -> "Json":
+        import json as _json
+
+        return Json(_json.loads(s))
+
+    @staticmethod
+    def dumps(value: Any) -> str:
+        import json as _json
+
+        if isinstance(value, Json):
+            value = value.value
+        return _json.dumps(value, default=str)
+
+    NULL: "Json"
+
+
+Json.NULL = Json(None)
+
+
+class Error:
+    """Singleton error value (reference: Value::Error, src/engine/value.rs:209).
+
+    Poisoning semantics: any expression consuming an Error yields Error.
+    """
+
+    _instance: "Error | None" = None
+
+    def __new__(cls) -> "Error":
+        if cls._instance is None:
+            cls._instance = super().__new__(cls)
+        return cls._instance
+
+    def __repr__(self) -> str:
+        return "Error"
+
+    def _pw_hash_repr_(self) -> Any:
+        return ("#error",)
+
+
+ERROR = Error()
+
+
+class Pending:
+    """Singleton placeholder for fully-async UDF results (value.rs Pending)."""
+
+    _instance: "Pending | None" = None
+
+    def __new__(cls) -> "Pending":
+        if cls._instance is None:
+            cls._instance = super().__new__(cls)
+        return cls._instance
+
+    def __repr__(self) -> str:
+        return "Pending"
+
+    def _pw_hash_repr_(self) -> Any:
+        return ("#pending",)
+
+
+PENDING = Pending()

@@ -1,0 +1,87 @@
+"""I/O connectors (reference: python/pathway/io/, 43 modules, io/__init__.py:4-46).
+
+Implemented natively: fs, csv, jsonlines, python (ConnectorSubject), kafka,
+http (REST server), plaintext, debug helpers, subscribe.  The long tail of
+system connectors (databases, lakes, queues, vector stores) shares the same
+Reader/Writer seam and is stubbed with an informative error until its client
+library is wired in.
+"""
+
+from __future__ import annotations
+
+import sys
+import types
+from typing import Any
+
+from . import csv, fs, jsonlines, kafka, python
+from ._subscribe import subscribe
+from ._synchronization import register_input_synchronization_group
+
+# plaintext alias (reference: io/plaintext)
+plaintext = types.ModuleType("pathway_tpu.io.plaintext")
+
+
+def _plaintext_read(path: str, *, mode: str = "streaming", **kwargs):
+    return fs.read(path, format="plaintext", mode=mode, **kwargs)
+
+
+plaintext.read = _plaintext_read
+sys.modules["pathway_tpu.io.plaintext"] = plaintext
+
+
+def _make_stub(name: str, needs: str) -> types.ModuleType:
+    mod = types.ModuleType(f"pathway_tpu.io.{name}")
+
+    def _raise(*args: Any, **kwargs: Any):
+        raise NotImplementedError(
+            f"pw.io.{name} requires {needs}; this connector is stubbed in this "
+            "build — use fs/csv/jsonlines/kafka/python/http or add the client"
+        )
+
+    mod.read = _raise
+    mod.write = _raise
+    sys.modules[f"pathway_tpu.io.{name}"] = mod
+    return mod
+
+
+# long-tail connectors behind the same seam (reference: src/connectors/data_storage/)
+s3 = _make_stub("s3", "boto3")
+s3_csv = _make_stub("s3_csv", "boto3")
+minio = _make_stub("minio", "boto3")
+gdrive = _make_stub("gdrive", "google-api-python-client")
+sharepoint = _make_stub("sharepoint", "Office365-REST client")
+postgres = _make_stub("postgres", "psycopg")
+mysql = _make_stub("mysql", "pymysql")
+sqlite = _make_stub("sqlite", "sqlite driver wiring")
+mongodb = _make_stub("mongodb", "pymongo")
+elasticsearch = _make_stub("elasticsearch", "elasticsearch client")
+deltalake = _make_stub("deltalake", "deltalake")
+iceberg = _make_stub("iceberg", "pyiceberg")
+nats = _make_stub("nats", "nats-py")
+mqtt = _make_stub("mqtt", "paho-mqtt")
+rabbitmq = _make_stub("rabbitmq", "pika")
+kinesis = _make_stub("kinesis", "boto3")
+dynamodb = _make_stub("dynamodb", "boto3")
+bigquery = _make_stub("bigquery", "google-cloud-bigquery")
+redpanda = kafka
+questdb = _make_stub("questdb", "questdb client")
+airbyte = _make_stub("airbyte", "airbyte-serverless runtime")
+debezium = _make_stub("debezium", "kafka + debezium format wiring")
+logstash = _make_stub("logstash", "http wiring")
+null = types.ModuleType("pathway_tpu.io.null")
+null.write = lambda table, **kwargs: None
+sys.modules["pathway_tpu.io.null"] = null
+
+from . import http  # noqa: E402  (needs subscribe defined)
+
+CsvParserSettings = dict
+OnChangeCallback = Any
+OnFinishCallback = Any
+
+__all__ = [
+    "csv", "fs", "jsonlines", "kafka", "python", "http", "plaintext",
+    "subscribe", "register_input_synchronization_group", "s3", "minio",
+    "gdrive", "postgres", "mysql", "mongodb", "elasticsearch", "deltalake",
+    "iceberg", "nats", "mqtt", "rabbitmq", "kinesis", "dynamodb", "bigquery",
+    "redpanda", "airbyte", "debezium", "null", "sharepoint",
+]

@@ -1,0 +1,25 @@
+"""Input synchronization groups (reference: io/_synchronization.py:59 +
+src/connectors/synchronization.rs): sources in a group advance logical time
+together within max_difference."""
+
+from __future__ import annotations
+
+from typing import Any
+
+
+class _SyncGroup:
+    def __init__(self, columns, max_difference, name):
+        self.columns = columns
+        self.max_difference = max_difference
+        self.name = name
+
+
+_groups: list[_SyncGroup] = []
+
+
+def register_input_synchronization_group(*columns: Any, max_difference: Any,
+                                         name: str = "default") -> None:
+    """Records the synchronization constraint; the single-scheduler engine
+    already advances all sources on one frontier, so within-process skew is
+    bounded by the autocommit interval."""
+    _groups.append(_SyncGroup(columns, max_difference, name))

@@ -1,0 +1,203 @@
+"""Shared connector plumbing: format parsing, writers, file watching.
+
+Reference: src/connectors/data_format/ (dsv, jsonlines, identity codecs) and
+the Reader/Writer traits (src/connectors/data_storage/mod.rs:516,951).
+"""
+
+from __future__ import annotations
+
+import csv as _csv
+import glob
+import io as _io
+import json
+import os
+import threading
+import time
+from typing import Any, Callable, Iterable
+
+from ..internals import dtype as dt
+from ..internals import parse_graph as pg
+from ..internals.datasource import DataSource, StaticDataSource
+from ..internals.schema import SchemaMetaclass
+from ..internals.table import Table, Universe
+from ..internals.value import Json, ref_scalar
+from ..engine.types import unwrap_row
+
+
+def coerce_value(v: Any, d: dt.DType):
+    if v is None:
+        return None
+    t = d.strip_optional()
+    try:
+        if t == dt.INT:
+            return int(v)
+        if t == dt.FLOAT:
+            return float(v)
+        if t == dt.BOOL:
+            if isinstance(v, bool):
+                return v
+            return str(v).strip().lower() in ("true", "1", "yes", "on")
+        if t == dt.STR:
+            return v if isinstance(v, str) else str(v)
+        if t == dt.BYTES:
+            return v if isinstance(v, bytes) else str(v).encode()
+        if t == dt.JSON:
+            if isinstance(v, Json):
+                return v
+            if isinstance(v, (dict, list, int, float, bool)):
+                return Json(v)
+            return Json.parse(v)
+    except (ValueError, TypeError):
+        from ..internals.value import ERROR
+
+        return ERROR
+    return v
+
+
+def make_input_table(
+    schema: SchemaMetaclass, source: DataSource, name: str = "io"
+) -> Table:
+    node = pg.new_node("input", [], source=source)
+    return Table(node, schema.column_names(), dict(schema.dtypes()), Universe(), name=name)
+
+
+def events_from_dicts(
+    dicts: Iterable[dict], schema: SchemaMetaclass, time: int = 0, seed: str = "io"
+) -> list:
+    colnames = schema.column_names()
+    dtypes = schema.dtypes()
+    pk = schema.primary_key_columns()
+    events = []
+    for i, d in enumerate(dicts):
+        row = tuple(coerce_value(d.get(c), dtypes[c]) for c in colnames)
+        if pk:
+            key = ref_scalar(*[d.get(c) for c in pk])
+        else:
+            key = ref_scalar(seed, i, tuple(sorted(d.items(), key=lambda kv: kv[0]))
+                             if all(isinstance(v, (str, int, float, bool, type(None))) for v in d.values())
+                             else i)
+        events.append((time, key, row, 1))
+    return events
+
+
+class FilePollingSource(DataSource):
+    """Streaming-mode file source: re-scan the path, emit new rows.
+
+    Reference: src/connectors/scanner/filesystem.rs + polling.rs.
+    """
+
+    append_only = True
+
+    def __init__(self, path: str, parse_file: Callable[[str], list[dict]],
+                 schema: SchemaMetaclass, poll_interval_s: float = 0.5,
+                 with_metadata: bool = False):
+        self.path = path
+        self.parse_file = parse_file
+        self.schema = schema
+        self.poll_interval_s = poll_interval_s
+        self._seen: dict[str, float] = {}
+        self._emitted = 0
+        self._last_poll = 0.0
+
+    def is_live(self) -> bool:
+        return True
+
+    def _files(self) -> list[str]:
+        if os.path.isdir(self.path):
+            out = []
+            for root, _dirs, files in os.walk(self.path):
+                out.extend(os.path.join(root, f) for f in files)
+            return sorted(out)
+        return sorted(glob.glob(self.path))
+
+    def poll(self):
+        now = time.monotonic()
+        if now - self._last_poll < self.poll_interval_s:
+            return []
+        self._last_poll = now
+        events = []
+        for f in self._files():
+            try:
+                mtime = os.path.getmtime(f)
+            except OSError:
+                continue
+            if self._seen.get(f) == mtime:
+                continue
+            self._seen[f] = mtime
+            try:
+                dicts = self.parse_file(f)
+            except Exception:
+                continue
+            for e in events_from_dicts(dicts, self.schema, seed=f):
+                events.append(e)
+        return events
+
+
+class FileWriter:
+    """Base sink writing consolidated update batches."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._fh = open(path, "w", encoding="utf-8")
+        self._lock = threading.Lock()
+
+    def write_batch(self, time: int, colnames: list[str], updates: list) -> None:
+        with self._lock:
+            for key, row, diff in updates:
+                self.write_row(time, colnames, key, unwrap_row(row), diff)
+            self._fh.flush()
+
+    def write_row(self, time, colnames, key, row, diff):
+        raise NotImplementedError
+
+    def close(self) -> None:
+        try:
+            self._fh.close()
+        except Exception:
+            pass
+
+
+class JsonlinesWriter(FileWriter):
+    def write_row(self, time, colnames, key, row, diff):
+        obj = dict(zip(colnames, [_jsonable(v) for v in row]))
+        obj["time"] = time
+        obj["diff"] = diff
+        self._fh.write(json.dumps(obj, default=str) + "\n")
+
+
+class CsvWriter(FileWriter):
+    def __init__(self, path: str):
+        super().__init__(path)
+        self._writer = None
+
+    def write_row(self, time, colnames, key, row, diff):
+        if self._writer is None:
+            self._writer = _csv.writer(self._fh)
+            self._writer.writerow(list(colnames) + ["time", "diff"])
+        self._writer.writerow([_csvable(v) for v in row] + [time, diff])
+
+
+def _jsonable(v):
+    if isinstance(v, Json):
+        return v.value
+    if isinstance(v, bytes):
+        import base64
+
+        return base64.b64encode(v).decode()
+    import numpy as np
+
+    if isinstance(v, np.ndarray):
+        return v.tolist()
+    return v
+
+
+def _csvable(v):
+    if isinstance(v, Json):
+        return str(v)
+    return v
+
+
+def add_output_node(table: Table, writer) -> None:
+    pg.new_output_node(
+        "output", [table], colnames=table.column_names(), writer=writer
+    )

@@ -1,0 +1,53 @@
+"""CSV connector (reference: io/csv + src/connectors/data_format/dsv)."""
+
+from __future__ import annotations
+
+import csv as _csv
+
+from ..internals.schema import SchemaMetaclass
+from ..internals.table import Table
+from ._utils import (
+    CsvWriter,
+    FilePollingSource,
+    StaticDataSource,
+    add_output_node,
+    events_from_dicts,
+    make_input_table,
+)
+
+
+def _parse_csv_file(path: str) -> list[dict]:
+    with open(path, newline="", encoding="utf-8") as f:
+        return list(_csv.DictReader(f))
+
+
+def read(
+    path: str,
+    *,
+    schema: SchemaMetaclass,
+    mode: str = "streaming",
+    csv_settings=None,
+    autocommit_duration_ms: int = 1500,
+    with_metadata: bool = False,
+    **kwargs,
+) -> Table:
+    if mode in ("static", "batch"):
+        import glob
+        import os
+
+        files = []
+        if os.path.isdir(path):
+            for root, _d, fs in os.walk(path):
+                files.extend(os.path.join(root, f) for f in fs)
+        else:
+            files = sorted(glob.glob(path)) or [path]
+        events = []
+        for f in sorted(files):
+            events.extend(events_from_dicts(_parse_csv_file(f), schema, seed=f))
+        return make_input_table(schema, StaticDataSource(events), name="csv")
+    source = FilePollingSource(path, _parse_csv_file, schema)
+    return make_input_table(schema, source, name="csv")
+
+
+def write(table: Table, filename: str, **kwargs) -> None:
+    add_output_node(table, CsvWriter(filename))

@@ -1,0 +1,110 @@
+"""Filesystem connector: plaintext / binary / json / csv formats with
+metadata (reference: io/fs + src/connectors/scanner/filesystem.rs)."""
+
+from __future__ import annotations
+
+import json
+import os
+
+from ..internals import dtype as dt
+from ..internals.schema import SchemaMetaclass, schema_from_columns, ColumnDefinition
+from ..internals.table import Table
+from ..internals.value import Json
+from . import csv as _csv_mod
+from . import jsonlines as _jsonl_mod
+from ._utils import (
+    FilePollingSource,
+    StaticDataSource,
+    events_from_dicts,
+    make_input_table,
+)
+
+
+def _binary_schema(with_metadata: bool) -> SchemaMetaclass:
+    cols = {"data": ColumnDefinition(dtype=dt.BYTES)}
+    if with_metadata:
+        cols["_metadata"] = ColumnDefinition(dtype=dt.JSON)
+    return schema_from_columns(cols, name="FsSchema")
+
+
+def _plaintext_schema(with_metadata: bool) -> SchemaMetaclass:
+    cols = {"data": ColumnDefinition(dtype=dt.STR)}
+    if with_metadata:
+        cols["_metadata"] = ColumnDefinition(dtype=dt.JSON)
+    return schema_from_columns(cols, name="FsSchema")
+
+
+def _metadata_for(path: str) -> Json:
+    st = os.stat(path)
+    return Json(
+        {
+            "path": os.path.abspath(path),
+            "name": os.path.basename(path),
+            "size": st.st_size,
+            "modified_at": int(st.st_mtime),
+            "created_at": int(st.st_ctime),
+            "seen_at": int(st.st_mtime),
+        }
+    )
+
+
+def read(
+    path: str,
+    *,
+    format: str = "binary",  # noqa: A002
+    schema: SchemaMetaclass | None = None,
+    mode: str = "streaming",
+    with_metadata: bool = False,
+    autocommit_duration_ms: int = 1500,
+    **kwargs,
+) -> Table:
+    if format == "csv":
+        return _csv_mod.read(path, schema=schema, mode=mode, **kwargs)
+    if format == "json":
+        return _jsonl_mod.read(path, schema=schema, mode=mode, **kwargs)
+    if format in ("plaintext", "plaintext_by_file", "binary"):
+        binary = format == "binary"
+        by_file = format in ("binary", "plaintext_by_file")
+        sch = schema or (_binary_schema(with_metadata) if binary else _plaintext_schema(with_metadata))
+
+        def parse_file(p: str) -> list[dict]:
+            meta = _metadata_for(p) if with_metadata else None
+            if binary:
+                with open(p, "rb") as f:
+                    rows = [{"data": f.read()}]
+            elif by_file:
+                with open(p, encoding="utf-8", errors="replace") as f:
+                    rows = [{"data": f.read()}]
+            else:
+                with open(p, encoding="utf-8", errors="replace") as f:
+                    rows = [{"data": line.rstrip("\n")} for line in f]
+            if with_metadata:
+                for r in rows:
+                    r["_metadata"] = meta
+            return rows
+
+        if mode in ("static", "batch"):
+            import glob
+
+            files = []
+            if os.path.isdir(path):
+                for root, _d, fs in os.walk(path):
+                    files.extend(os.path.join(root, f) for f in fs)
+            else:
+                files = sorted(glob.glob(path)) or ([path] if os.path.exists(path) else [])
+            events = []
+            for f in sorted(files):
+                events.extend(events_from_dicts(parse_file(f), sch, seed=f))
+            return make_input_table(sch, StaticDataSource(events), name="fs")
+        source = FilePollingSource(path, parse_file, sch)
+        return make_input_table(sch, source, name="fs")
+    raise ValueError(f"unknown format {format!r}")
+
+
+def write(table: Table, filename: str, format: str = "json", **kwargs) -> None:  # noqa: A002
+    if format in ("json", "jsonlines"):
+        _jsonl_mod.write(table, filename)
+    elif format == "csv":
+        _csv_mod.write(table, filename)
+    else:
+        raise ValueError(f"unknown format {format!r}")

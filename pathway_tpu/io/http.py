@@ -1,0 +1,232 @@
+"""HTTP REST connector + webserver (reference: io/http/_server.py:388-723).
+
+`rest_connector` turns HTTP requests into a live query table; the returned
+response writer delivers each query's first answer back to the waiting HTTP
+client — the request/response idiom over the incremental engine
+(SURVEY.md §3.5).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import uuid
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any
+
+from ..internals import dtype as dt
+from ..internals import parse_graph as pg
+from ..internals.datasource import SubjectDataSource
+from ..internals.schema import SchemaMetaclass
+from ..internals.table import Table
+from ..internals.value import Json, Pointer, ref_scalar
+from ._utils import coerce_value, make_input_table, _jsonable
+
+
+class PathwayWebserver:
+    """Shared HTTP endpoint host (reference: io/http PathwayWebserver)."""
+
+    def __init__(self, host: str = "0.0.0.0", port: int = 8080, with_cors: bool = False):
+        self.host = host
+        self.port = port
+        self.with_cors = with_cors
+        self._routes: dict[tuple[str, str], Any] = {}
+        self._server: ThreadingHTTPServer | None = None
+        self._thread: threading.Thread | None = None
+
+    def register(self, route: str, methods: list[str], handler) -> None:
+        for m in methods:
+            self._routes[(m.upper(), route)] = handler
+
+    def _ensure_started(self) -> None:
+        if self._server is not None:
+            return
+        routes = self._routes
+        cors = self.with_cors
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):
+                pass
+
+            def _respond(self, code: int, payload: bytes, ctype="application/json"):
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                if cors:
+                    self.send_header("Access-Control-Allow-Origin", "*")
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+
+            def _handle(self, method: str):
+                path = self.path.split("?")[0]
+                handler = routes.get((method, path))
+                if handler is None:
+                    self._respond(404, b'{"error": "no such route"}')
+                    return
+                length = int(self.headers.get("Content-Length") or 0)
+                body = self.rfile.read(length) if length else b"{}"
+                try:
+                    payload = json.loads(body) if body.strip() else {}
+                except Exception:
+                    self._respond(400, b'{"error": "bad json"}')
+                    return
+                try:
+                    result = handler(payload)
+                    self._respond(200, json.dumps(result, default=str).encode())
+                except TimeoutError:
+                    self._respond(504, b'{"error": "query timed out"}')
+                except Exception as exc:
+                    self._respond(500, json.dumps({"error": str(exc)}).encode())
+
+            def do_POST(self):
+                self._handle("POST")
+
+            def do_GET(self):
+                self._handle("GET")
+
+            def do_OPTIONS(self):
+                self._respond(200, b"")
+
+        self._server = ThreadingHTTPServer((self.host, self.port), Handler)
+        self._thread = threading.Thread(target=self._server.serve_forever, daemon=True)
+        self._thread.start()
+
+    def shutdown(self) -> None:
+        if self._server is not None:
+            self._server.shutdown()
+            self._server = None
+
+
+class _RestSubject:
+    """Bridges HTTP handler threads to the engine's query stream."""
+
+    def __init__(self, schema: SchemaMetaclass, delete_completed_queries: bool,
+                 timeout_s: float):
+        self.schema = schema
+        self.delete_completed = delete_completed_queries
+        self.timeout_s = timeout_s
+        self.pending: dict[int, tuple[threading.Event, list]] = {}
+        self._source: SubjectDataSource | None = None
+        self._started = threading.Event()
+
+    def _run(self, source: SubjectDataSource) -> None:
+        self._source = source
+        self._started.set()
+        # stay alive until the engine stops
+        threading.Event().wait()
+
+    def handle(self, payload: dict) -> Any:
+        self._started.wait(timeout=10)
+        colnames = self.schema.column_names()
+        dtypes = self.schema.dtypes()
+        qid = ref_scalar("rest", uuid.uuid4().hex)
+        row = tuple(coerce_value(payload.get(c), dtypes[c]) for c in colnames)
+        ev = threading.Event()
+        slot: list = []
+        self.pending[qid] = (ev, slot)
+        self._source.push(row, 1, qid)
+        ok = ev.wait(timeout=self.timeout_s)
+        if self.delete_completed:
+            self._source.push(row, -1, qid)
+        self.pending.pop(qid, None)
+        if not ok:
+            raise TimeoutError
+        return slot[0] if slot else None
+
+    def deliver(self, key: int, value: Any) -> None:
+        entry = self.pending.get(key)
+        if entry is not None:
+            ev, slot = entry
+            slot.clear()
+            slot.append(value)
+            ev.set()
+
+
+def rest_connector(
+    host: str = "0.0.0.0",
+    port: int = 8080,
+    *,
+    route: str = "/",
+    schema: SchemaMetaclass | None = None,
+    methods: list[str] | None = None,
+    autocommit_duration_ms: int = 50,
+    keep_queries: bool = False,
+    delete_completed_queries: bool = True,
+    request_validator=None,
+    webserver: PathwayWebserver | None = None,
+    timeout_s: float = 30.0,
+    documentation=None,
+):
+    """Returns (queries_table, response_writer)."""
+    if schema is None:
+        from ..internals.schema import schema_from_types
+
+        schema = schema_from_types(query=str)
+    ws = webserver or PathwayWebserver(host, port)
+    subject = _RestSubject(schema, delete_completed_queries, timeout_s)
+    ws.register(route, methods or ["POST"], subject.handle)
+
+    colnames = schema.column_names()
+    source = SubjectDataSource(subject, colnames, None, append_only=False)
+    queries = make_input_table(schema, source, name=f"rest:{route}")
+    # starting the server happens when the source starts (engine run)
+    orig_start = source.start
+
+    def start():
+        ws._ensure_started()
+        orig_start()
+
+    source.start = start
+
+    def response_writer(response_table: Table, result_column: str | None = None) -> None:
+        rcols = response_table.column_names()
+        col = result_column or ("result" if "result" in rcols else rcols[0])
+        pos = rcols.index(col)
+
+        def on_time(time: int, updates: list) -> None:
+            from ..engine.types import unwrap_row
+
+            for key, row, diff in updates:
+                if diff > 0:
+                    subject.deliver(key, _jsonable(unwrap_row(row)[pos]))
+
+        pg.new_output_node(
+            "raw_output", [response_table], on_time=on_time, colnames=rcols
+        )
+
+    return queries, response_writer
+
+
+# raw_output lowering
+from ..engine.runner import register_lowering  # noqa: E402
+from ..engine import operators as _ops  # noqa: E402
+
+
+@register_lowering("raw_output")
+def _lower_raw_output(node, lg):
+    return _ops.OutputOperator(node.params["on_time"], name="raw_output")
+
+
+def write(table: Table, url: str, *, method: str = "POST", format: str = "json",  # noqa: A002
+          **kwargs) -> None:
+    """POST each update batch to a URL (reference: io/http write)."""
+    import urllib.request
+
+    colnames = table.column_names()
+
+    def on_time(time: int, updates: list) -> None:
+        from ..engine.types import unwrap_row
+
+        for key, row, diff in updates:
+            obj = dict(zip(colnames, [_jsonable(v) for v in unwrap_row(row)]))
+            obj.update(time=time, diff=diff)
+            req = urllib.request.Request(
+                url, json.dumps(obj, default=str).encode(),
+                headers={"Content-Type": "application/json"}, method=method,
+            )
+            try:
+                urllib.request.urlopen(req, timeout=10)
+            except Exception:
+                pass
+
+    pg.new_output_node("raw_output", [table], on_time=on_time, colnames=colnames)

@@ -1,0 +1,171 @@
+"""Kafka connector (reference: io/kafka + src/connectors/data_storage/kafka.rs).
+
+Uses confluent_kafka/kafka-python when installed; raises a clear error
+otherwise.  Message formats: json / plaintext / raw.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any
+
+from ..internals import dtype as dt
+from ..internals.datasource import DataSource
+from ..internals.schema import SchemaMetaclass, schema_from_columns, ColumnDefinition
+from ..internals.table import Table
+from ..internals.value import ref_scalar
+from ._utils import coerce_value, make_input_table
+from ..internals import parse_graph as pg
+
+
+def _get_consumer(rdkafka_settings: dict, topic: str):
+    try:
+        from confluent_kafka import Consumer  # type: ignore
+    except ImportError:
+        try:
+            from kafka import KafkaConsumer  # type: ignore
+
+            servers = rdkafka_settings.get("bootstrap.servers", "localhost:9092")
+            return ("kafka-python", KafkaConsumer(
+                topic,
+                bootstrap_servers=servers.split(","),
+                group_id=rdkafka_settings.get("group.id"),
+                auto_offset_reset=rdkafka_settings.get("auto.offset.reset", "earliest"),
+            ))
+        except ImportError as exc:
+            raise ImportError(
+                "kafka connector needs confluent_kafka or kafka-python installed"
+            ) from exc
+    c = Consumer(rdkafka_settings)
+    c.subscribe([topic])
+    return ("confluent", c)
+
+
+class KafkaSource(DataSource):
+    append_only = True
+
+    def __init__(self, rdkafka_settings: dict, topic: str, format: str,  # noqa: A002
+                 schema: SchemaMetaclass):
+        self.settings = rdkafka_settings
+        self.topic = topic
+        self.format = format
+        self.schema = schema
+        self._consumer = None
+        self._kind = None
+        self._n = 0
+
+    def is_live(self) -> bool:
+        return True
+
+    def start(self) -> None:
+        self._kind, self._consumer = _get_consumer(self.settings, self.topic)
+
+    def poll(self):
+        events = []
+        colnames = self.schema.column_names()
+        dtypes = self.schema.dtypes()
+        pk = self.schema.primary_key_columns()
+        msgs: list[bytes] = []
+        if self._kind == "confluent":
+            while True:
+                m = self._consumer.poll(0)
+                if m is None:
+                    break
+                if m.error():
+                    continue
+                msgs.append(m.value())
+        else:
+            polled = self._consumer.poll(timeout_ms=0)
+            for batch in polled.values():
+                msgs.extend(r.value for r in batch)
+        for raw in msgs:
+            if self.format == "json":
+                try:
+                    d = json.loads(raw)
+                except Exception:
+                    continue
+                row = tuple(coerce_value(d.get(c), dtypes[c]) for c in colnames)
+                key = ref_scalar(*[d.get(c) for c in pk]) if pk else ref_scalar(
+                    self.topic, self._n
+                )
+            else:  # plaintext / raw
+                v = raw.decode("utf-8", "replace") if self.format == "plaintext" else raw
+                row = tuple(
+                    coerce_value(v if c == "data" else None, dtypes[c]) for c in colnames
+                )
+                key = ref_scalar(self.topic, self._n)
+            self._n += 1
+            events.append((0, key, row, 1))
+        return events
+
+    def stop(self):
+        if self._consumer is not None:
+            try:
+                self._consumer.close()
+            except Exception:
+                pass
+
+
+def read(
+    rdkafka_settings: dict,
+    topic: str | None = None,
+    *,
+    schema: SchemaMetaclass | None = None,
+    format: str = "json",  # noqa: A002
+    autocommit_duration_ms: int = 1500,
+    topic_names: list[str] | None = None,
+    **kwargs,
+) -> Table:
+    if topic is None and topic_names:
+        topic = topic_names[0]
+    if schema is None:
+        schema = schema_from_columns(
+            {"data": ColumnDefinition(dtype=dt.STR if format == "plaintext" else dt.BYTES)},
+            name="KafkaSchema",
+        )
+    source = KafkaSource(rdkafka_settings, topic, format, schema)
+    return make_input_table(schema, source, name=f"kafka:{topic}")
+
+
+class KafkaWriter:
+    def __init__(self, rdkafka_settings: dict, topic: str, format: str):  # noqa: A002
+        self.topic = topic
+        self.format = format
+        try:
+            from confluent_kafka import Producer  # type: ignore
+
+            self._producer = Producer(rdkafka_settings)
+            self._kind = "confluent"
+        except ImportError:
+            from kafka import KafkaProducer  # type: ignore
+
+            servers = rdkafka_settings.get("bootstrap.servers", "localhost:9092")
+            self._producer = KafkaProducer(bootstrap_servers=servers.split(","))
+            self._kind = "kafka-python"
+
+    def write_batch(self, time: int, colnames: list[str], updates: list) -> None:
+        from ..engine.types import unwrap_row
+        from ._utils import _jsonable
+
+        for key, row, diff in updates:
+            obj = dict(zip(colnames, [_jsonable(v) for v in unwrap_row(row)]))
+            obj["time"] = time
+            obj["diff"] = diff
+            payload = json.dumps(obj, default=str).encode()
+            if self._kind == "confluent":
+                self._producer.produce(self.topic, payload)
+            else:
+                self._producer.send(self.topic, payload)
+        if self._kind == "confluent":
+            self._producer.flush()
+        else:
+            self._producer.flush()
+
+    def close(self):
+        pass
+
+
+def write(table: Table, rdkafka_settings: dict, topic_name: str, *,
+          format: str = "json", **kwargs) -> None:  # noqa: A002
+    writer = KafkaWriter(rdkafka_settings, topic_name, format)
+    pg.new_output_node("output", [table], colnames=table.column_names(), writer=writer)

@@ -1,0 +1,1 @@
+"""On-device model zoo (JAX/Flax): embedders, rerankers, decoders."""

@@ -1,0 +1,1 @@
+"""TPU compute kernels (JAX / Pallas)."""

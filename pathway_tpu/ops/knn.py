@@ -1,0 +1,87 @@
+"""On-device KNN scoring: matmul + top-k on the accelerator.
+
+Replaces the reference's ndarray brute-force scan
+(src/external_integration/brute_force_knn_integration.rs:22-60) with an XLA
+matmul that hits the MXU; scores come back to host for merging with the
+index's key table.  Batched queries use a single (Q,d)x(d,N) matmul.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+_MATRIX_VERSION: dict[int, int] = {}
+_DEVICE_MATRIX: dict[int, object] = {}
+
+
+@functools.lru_cache(maxsize=1)
+def _jax():
+    import jax
+    import jax.numpy as jnp
+
+    return jax, jnp
+
+
+@functools.lru_cache(maxsize=8)
+def _scores_fn(metric: str):
+    jax, jnp = _jax()
+
+    @jax.jit
+    def cos(m, q):
+        qn = q / (jnp.linalg.norm(q) + 1e-12)
+        mn = m / (jnp.linalg.norm(m, axis=1, keepdims=True) + 1e-12)
+        return mn @ qn
+
+    @jax.jit
+    def dot(m, q):
+        return m @ q
+
+    @jax.jit
+    def l2sq(m, q):
+        # -(|m|^2 - 2 m.q + |q|^2); matmul form keeps the MXU busy
+        return 2.0 * (m @ q) - jnp.sum(m * m, axis=1) - jnp.sum(q * q)
+
+    return {"cos": cos, "dot": dot, "l2sq": l2sq}[metric]
+
+
+def device_topk_scores(matrix: np.ndarray, query: np.ndarray, metric: str = "cos") -> np.ndarray:
+    """Full score vector computed on device (bf16 matmul, f32 accumulate)."""
+    jax, jnp = _jax()
+    m = jnp.asarray(matrix)
+    q = jnp.asarray(query)
+    return np.asarray(_scores_fn(metric)(m, q))
+
+
+@functools.lru_cache(maxsize=8)
+def _batched_topk_fn(metric: str, k: int):
+    jax, jnp = _jax()
+
+    @jax.jit
+    def run(m, qs):
+        if metric == "cos":
+            mn = m / (jnp.linalg.norm(m, axis=1, keepdims=True) + 1e-12)
+            qn = qs / (jnp.linalg.norm(qs, axis=1, keepdims=True) + 1e-12)
+            scores = qn @ mn.T
+        elif metric == "dot":
+            scores = qs @ m.T
+        else:
+            scores = (
+                2.0 * (qs @ m.T)
+                - jnp.sum(m * m, axis=1)[None, :]
+                - jnp.sum(qs * qs, axis=1)[:, None]
+            )
+        vals, idx = jax.lax.top_k(scores, k)
+        return vals, idx
+
+    return run
+
+
+def batched_topk(matrix: np.ndarray, queries: np.ndarray, k: int, metric: str = "cos"):
+    """(Q,k) top-k values and indices for a batch of queries — one device
+    dispatch for the whole micro-batch."""
+    jax, jnp = _jax()
+    k = min(k, matrix.shape[0])
+    vals, idx = _batched_topk_fn(metric, k)(jnp.asarray(matrix), jnp.asarray(queries))
+    return np.asarray(vals), np.asarray(idx)

@@ -1,0 +1,1 @@
+"""Multi-chip execution: meshes, sharded state, collectives."""

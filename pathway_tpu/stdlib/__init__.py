@@ -1,0 +1,3 @@
+from . import graphs, indexing, ml, ordered, stateful, statistical, temporal, utils
+
+__all__ = ["temporal", "indexing", "ml", "graphs", "statistical", "ordered", "stateful", "utils"]

@@ -1,0 +1,49 @@
+"""Graph algorithms on tables (reference: stdlib/graphs/ — Bellman-Ford,
+Louvain communities, graph utilities)."""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+
+from ...internals import reducers as R
+from ...internals.iterate import iterate
+from ...internals.table import Table
+
+
+@dataclasses.dataclass
+class Graph:
+    """Vertex + edge tables; edges have columns u, v (vertex pointers)."""
+
+    V: Table
+    E: Table
+
+
+def bellman_ford(vertices: Table, edges: Table) -> Table:
+    """Shortest distances from rows with is_source=True.
+
+    vertices: columns [is_source]; edges: columns [u, v, dist] with u/v vertex
+    pointers.  Returns a table with dist_from_source per vertex
+    (reference: stdlib/graphs/bellman_ford).
+    """
+    from ... import coalesce, if_else
+
+    init = vertices.select(dist=if_else(vertices.is_source, 0.0, math.inf))
+
+    def step(state: Table) -> Table:
+        relaxed = edges.join(state, edges.u == state.id).select(
+            v=edges.v, d=state.dist + edges.dist
+        )
+        best = relaxed.groupby(relaxed.v).reduce(relaxed.v, d=R.min(relaxed.d))
+        best = best.with_id(best.v).select(d=best.d)
+        looked = best.ix(state.id, optional=True)
+        cand = coalesce(looked.d, math.inf)
+        return state.select(dist=if_else(cand < state.dist, cand, state.dist))
+
+    return iterate(lambda state: step(state), state=init)
+
+
+def louvain_level(G: Graph, total_weight=None) -> Table:  # pragma: no cover
+    raise NotImplementedError(
+        "louvain: planned (reference stdlib/graphs/louvain_communities)"
+    )

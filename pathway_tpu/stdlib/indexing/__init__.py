@@ -1,0 +1,41 @@
+"""Live indexes: KNN / BM25 / hybrid behind the index-as-a-join DataIndex.
+
+Reference: python/pathway/stdlib/indexing/.
+"""
+
+from .data_index import DataIndex
+from .inner_index import (
+    BruteForceKnn,
+    HybridIndex,
+    InnerIndex,
+    LshKnn,
+    TantivyBM25,
+    USearchKnn,
+)
+from .retrievers import (
+    AbstractRetrieverFactory,
+    BruteForceKnnFactory,
+    HybridIndexFactory,
+    LshKnnFactory,
+    TantivyBM25Factory,
+    UsearchKnnFactory,
+)
+
+
+def default_vector_document_index(data_column, data_table, *, embedder=None,
+                                  dimensions=None, metadata_column=None) -> DataIndex:
+    factory = BruteForceKnnFactory(dimensions=dimensions, embedder=embedder)
+    return factory.build_index(data_column, data_table, metadata_column=metadata_column)
+
+
+def default_full_text_document_index(data_column, data_table, *, metadata_column=None) -> DataIndex:
+    return TantivyBM25Factory().build_index(data_column, data_table, metadata_column=metadata_column)
+
+
+__all__ = [
+    "DataIndex", "InnerIndex", "BruteForceKnn", "USearchKnn", "LshKnn",
+    "TantivyBM25", "HybridIndex", "AbstractRetrieverFactory",
+    "BruteForceKnnFactory", "UsearchKnnFactory", "LshKnnFactory",
+    "TantivyBM25Factory", "HybridIndexFactory",
+    "default_vector_document_index", "default_full_text_document_index",
+]

@@ -1,0 +1,228 @@
+"""DataIndex: index-as-a-join over live tables.
+
+Reference: stdlib/indexing/data_index.py:206,278 — `query()` is fully
+incremental (answers are revised as data changes), `query_as_of_now()` is
+request/response (answered once, never revised; the serving path).
+Lowered to a single engine operator keeping an InnerIndex plus the data rows
+(src/engine/dataflow/operators/external_index.rs equivalent).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+from ...engine.graph import DiffOutputOperator
+from ...engine.runner import register_lowering, _env_for, _compile
+from ...engine.types import consolidate
+from ...internals import dtype as dt
+from ...internals import parse_graph as pg
+from ...internals.expression import ColumnExpression, ColumnReference, wrap
+from ...internals.table import Table, Universe
+from ...internals.value import ERROR, Error
+
+
+class ExternalIndexOperator(DiffOutputOperator):
+    """Port 0: queries, port 1: data."""
+
+    def __init__(
+        self,
+        query_env,
+        data_env,
+        index_factory: Callable[[], Any],
+        query_item_fn,
+        data_item_fn,
+        data_meta_fn,
+        k_fn,
+        filter_fn,
+        n_data_cols: int,
+        as_of_now: bool,
+        name="external_index",
+    ):
+        super().__init__(2, name)
+        self.query_env, self.data_env = query_env, data_env
+        self.index = index_factory()
+        self.query_item_fn = query_item_fn
+        self.data_item_fn = data_item_fn
+        self.data_meta_fn = data_meta_fn
+        self.k_fn = k_fn
+        self.filter_fn = filter_fn
+        self.n_data_cols = n_data_cols
+        self.as_of_now = as_of_now
+        self.emitted: dict[int, tuple] = {}  # as-of-now answers
+        self._pending: list = []
+
+    # -- index maintenance -------------------------------------------------
+    def pre_apply(self, port, key, row, diff):
+        if port != 1:
+            return
+        env = self.data_env.build(key, row)
+        if diff > 0:
+            item = self.data_item_fn(env)
+            if item is None or isinstance(item, Error):
+                return
+            meta = self.data_meta_fn(env) if self.data_meta_fn else None
+            self.index.add(key, item, meta)
+        else:
+            self.index.remove(key)
+
+    def dirty_keys_for(self, port, key):
+        if self.as_of_now:
+            return ()
+        if port == 0:
+            return (key,)
+        return tuple(self.last_out.keys()) or tuple(self.state[0].keys())
+
+    def process(self, port, updates, time):
+        if not self.as_of_now:
+            if port == 1:
+                # mark all queries dirty BEFORE updating the index
+                self._dirty.update(self.state[0].keys())
+            super().process(port, updates, time)
+            if port == 1:
+                self._dirty.update(self.state[0].keys())
+            return
+        # as-of-now: answer query inserts immediately, never revise
+        out = []
+        for key, row, diff in updates:
+            if port == 1:
+                self.pre_apply(1, key, row, diff)
+                self.state[1].apply(key, row, diff)
+                continue
+            if diff > 0:
+                self.state[0].apply(key, row, diff)
+                ans = self._answer(key, row)
+                out.append((key, ans, 1))
+                self.emitted[key] = ans
+            else:
+                self.state[0].apply(key, row, diff)
+                prev = self.emitted.pop(key, None)
+                if prev is not None:
+                    out.append((key, prev, -1))
+        if out:
+            self.emit(time, consolidate(out))
+
+    def _answer(self, key, row) -> tuple:
+        env = self.query_env.build(key, row)
+        q = self.query_item_fn(env)
+        if q is None or isinstance(q, Error):
+            return ((), ()) + ((),) * self.n_data_cols
+        k = self.k_fn(env)
+        mf = self.filter_fn(env) if self.filter_fn else None
+        matches = self.index.search(q, int(k), mf)
+        keys = tuple(m[0] for m in matches)
+        scores = tuple(float(m[1]) for m in matches)
+        cols = []
+        for i in range(self.n_data_cols):
+            vals = []
+            for mk in keys:
+                drow = self.state[1].get_row(mk)
+                vals.append(drow[i] if drow is not None else None)
+            cols.append(tuple(vals))
+        return (keys, scores) + tuple(cols)
+
+    def compute(self, key):
+        row = self.state[0].get_row(key)
+        if row is None:
+            return None
+        return self._answer(key, row)
+
+
+@register_lowering("external_index")
+def _lower_external_index(node, lg):
+    p = node.params
+    qt, data = node.input_tables
+    return ExternalIndexOperator(
+        _env_for(qt),
+        _env_for(data),
+        p["index_factory"],
+        _compile(p["query_item"]),
+        _compile(p["data_item"]),
+        _compile(p["data_meta"]) if p.get("data_meta") is not None else None,
+        _compile(p["k_expr"]),
+        _compile(p["filter_expr"]) if p.get("filter_expr") is not None else None,
+        len(data._colnames),
+        p["as_of_now"],
+    )
+
+
+class DataIndex:
+    """An index over `data_table` built from `data_column`."""
+
+    def __init__(
+        self,
+        data_table: Table,
+        data_column: ColumnExpression,
+        *,
+        index_factory: Callable[[], Any],
+        metadata_column: ColumnExpression | None = None,
+        embedder: Callable | None = None,
+    ):
+        self.data_table = data_table
+        self.embedder = embedder
+        if embedder is not None:
+            data_column = embedder(data_column)
+        self.data_column = data_table._desugar(data_column)
+        self.metadata_column = (
+            data_table._desugar(metadata_column) if metadata_column is not None else None
+        )
+        self.index_factory = index_factory
+
+    def _query(
+        self,
+        query_column: ColumnExpression,
+        *,
+        number_of_matches: Any = 3,
+        metadata_filter: ColumnExpression | None = None,
+        as_of_now: bool,
+    ) -> Table:
+        deps = [
+            r.table for r in wrap(query_column)._dependencies() if isinstance(r.table, Table)
+        ]
+        if not deps:
+            raise ValueError("query column must reference the query table")
+        qt = deps[0]
+        qcol = qt._desugar(query_column)
+        if self.embedder is not None:
+            qcol = qt._desugar(self.embedder(qcol))
+        k_expr = qt._desugar(number_of_matches) if isinstance(
+            number_of_matches, ColumnExpression
+        ) else wrap(number_of_matches)
+        f_expr = qt._desugar(metadata_filter) if metadata_filter is not None else None
+        node = pg.new_node(
+            "external_index",
+            [qt, self.data_table],
+            index_factory=self.index_factory,
+            query_item=qcol,
+            data_item=self.data_column,
+            data_meta=self.metadata_column,
+            k_expr=k_expr,
+            filter_expr=f_expr,
+            as_of_now=as_of_now,
+        )
+        data_cols = self.data_table.column_names()
+        out_names = ["_pw_index_reply_id", "_pw_index_reply_score"] + data_cols
+        dtypes: dict[str, dt.DType] = {
+            "_pw_index_reply_id": dt.List(dt.POINTER),
+            "_pw_index_reply_score": dt.List(dt.FLOAT),
+        }
+        for n in data_cols:
+            dtypes[n] = dt.List(self.data_table._dtype_of(n))
+        return Table(node, out_names, dtypes, qt._universe, name="index_reply")
+
+    def query(self, query_column, *, number_of_matches=3, collapse_rows=True,
+              metadata_filter=None, **kwargs) -> Table:
+        return self._query(
+            query_column,
+            number_of_matches=number_of_matches,
+            metadata_filter=metadata_filter,
+            as_of_now=False,
+        )
+
+    def query_as_of_now(self, query_column, *, number_of_matches=3, collapse_rows=True,
+                        metadata_filter=None, **kwargs) -> Table:
+        return self._query(
+            query_column,
+            number_of_matches=number_of_matches,
+            metadata_filter=metadata_filter,
+            as_of_now=True,
+        )

@@ -1,0 +1,310 @@
+"""Mutable secondary indexes (reference: src/external_integration/mod.rs:41-49
+ExternalIndex trait: add/remove/search; brute_force_knn_integration.rs:22-60;
+tantivy_integration.rs).
+
+The vector index keeps vectors in a dense matrix so search is a single
+matmul+top-k — numpy on host, jax on TPU when available (ops/knn.py).
+"""
+
+from __future__ import annotations
+
+import math
+import re
+from collections import Counter, defaultdict
+from typing import Any, Callable
+
+import numpy as np
+
+
+class InnerIndex:
+    def add(self, key: int, item: Any, metadata: Any = None) -> None:
+        raise NotImplementedError
+
+    def remove(self, key: int) -> None:
+        raise NotImplementedError
+
+    def search(self, query: Any, k: int, metadata_filter: str | None = None) -> list[tuple[int, float]]:
+        """Returns [(key, score)] with higher score = better."""
+        raise NotImplementedError
+
+
+def _check_metadata(metadata, metadata_filter: str | None) -> bool:
+    if metadata_filter is None:
+        return True
+    from .jmespath_filter import evaluate_filter
+
+    return evaluate_filter(metadata_filter, metadata)
+
+
+class BruteForceKnn(InnerIndex):
+    """Dense exact KNN: one (N,d) matrix, search = matmul + top-k.
+
+    TPU path: when the matrix crosses `device_threshold` rows the matmul+top-k
+    is executed with JAX on the accelerator (ops/knn.py), sharded over the
+    device mesh by rows.
+    """
+
+    def __init__(
+        self,
+        dimensions: int | None = None,
+        *,
+        reserved_space: int = 1024,
+        metric: str = "cos",
+        device_threshold: int = 2048,
+    ):
+        self.dim = dimensions
+        self.metric = metric
+        self.capacity = max(reserved_space, 16)
+        self.matrix: np.ndarray | None = None
+        self.keys: list[int] = []
+        self.slot_of: dict[int, int] = {}
+        self.metadata: dict[int, Any] = {}
+        self.n = 0
+        self.device_threshold = device_threshold
+        self._device_cache = None
+
+    def _ensure(self, dim: int) -> None:
+        if self.matrix is None:
+            self.dim = dim
+            self.matrix = np.zeros((self.capacity, dim), dtype=np.float32)
+
+    def add(self, key: int, item: Any, metadata: Any = None) -> None:
+        vec = np.asarray(item, dtype=np.float32).reshape(-1)
+        self._ensure(vec.shape[0])
+        if key in self.slot_of:
+            self.matrix[self.slot_of[key]] = vec
+            self.metadata[key] = metadata
+            self._device_cache = None
+            return
+        if self.n == self.capacity:
+            self.capacity *= 2
+            new = np.zeros((self.capacity, self.dim), dtype=np.float32)
+            new[: self.n] = self.matrix[: self.n]
+            self.matrix = new
+        self.matrix[self.n] = vec
+        self.slot_of[key] = self.n
+        self.keys.append(key)
+        self.metadata[key] = metadata
+        self.n += 1
+        self._device_cache = None
+
+    def remove(self, key: int) -> None:
+        slot = self.slot_of.pop(key, None)
+        if slot is None:
+            return
+        last = self.n - 1
+        last_key = self.keys[last]
+        if slot != last:
+            self.matrix[slot] = self.matrix[last]
+            self.keys[slot] = last_key
+            self.slot_of[last_key] = slot
+        self.keys.pop()
+        self.metadata.pop(key, None)
+        self.n = last
+        self._device_cache = None
+
+    def _scores(self, q: np.ndarray) -> np.ndarray:
+        m = self.matrix[: self.n]
+        if self.metric == "cos":
+            qn = q / (np.linalg.norm(q) + 1e-12)
+            mn = m / (np.linalg.norm(m, axis=1, keepdims=True) + 1e-12)
+            return mn @ qn
+        if self.metric == "l2sq":
+            return -np.sum((m - q) ** 2, axis=1)
+        return m @ q  # dot
+
+    def search(self, query: Any, k: int, metadata_filter: str | None = None) -> list[tuple[int, float]]:
+        if self.n == 0:
+            return []
+        q = np.asarray(query, dtype=np.float32).reshape(-1)
+        if self.n >= self.device_threshold:
+            try:
+                from ...ops.knn import device_topk_scores
+
+                scores = device_topk_scores(self.matrix[: self.n], q, self.metric)
+            except Exception:
+                scores = self._scores(q)
+        else:
+            scores = self._scores(q)
+        if metadata_filter is None:
+            kk = min(k, self.n)
+            idx = np.argpartition(-scores, kk - 1)[:kk] if kk < self.n else np.arange(self.n)
+            order = idx[np.argsort(-scores[idx])]
+            return [(self.keys[i], float(scores[i])) for i in order]
+        out = []
+        for i in np.argsort(-scores):
+            key = self.keys[i]
+            if _check_metadata(self.metadata.get(key), metadata_filter):
+                out.append((key, float(scores[i])))
+                if len(out) >= k:
+                    break
+        return out
+
+
+class USearchKnn(BruteForceKnn):
+    """API-parity alias: the reference's USearch HNSW
+    (usearch_integration.rs:21-80).  Exact search here; ANN via LSH below."""
+
+
+class LshKnn(InnerIndex):
+    """Locality-sensitive hashing ANN (reference: stdlib/ml/_lsh.py).
+
+    Random-hyperplane buckets; search unions candidate buckets then scores
+    exactly — the scalable tier when brute force outgrows HBM."""
+
+    def __init__(self, dimensions: int | None = None, *, n_or: int = 8, n_and: int = 6,
+                 bucket_length: float = 1.0, seed: int = 0, metric: str = "cos"):
+        self.dim = dimensions
+        self.n_or = n_or
+        self.n_and = n_and
+        self.seed = seed
+        self.metric = metric
+        self.planes: np.ndarray | None = None
+        self.buckets: list[dict[bytes, set]] = [defaultdict(set) for _ in range(n_or)]
+        self.vectors: dict[int, np.ndarray] = {}
+        self.metadata: dict[int, Any] = {}
+
+    def _ensure(self, dim: int) -> None:
+        if self.planes is None:
+            rng = np.random.default_rng(self.seed)
+            self.planes = rng.normal(size=(self.n_or, self.n_and, dim)).astype(np.float32)
+            self.dim = dim
+
+    def _hashes(self, vec: np.ndarray) -> list[bytes]:
+        bits = (np.einsum("oad,d->oa", self.planes, vec) > 0)
+        return [bits[i].tobytes() for i in range(self.n_or)]
+
+    def add(self, key: int, item: Any, metadata: Any = None) -> None:
+        vec = np.asarray(item, dtype=np.float32).reshape(-1)
+        self._ensure(vec.shape[0])
+        if key in self.vectors:
+            self.remove(key)
+        self.vectors[key] = vec
+        self.metadata[key] = metadata
+        for i, h in enumerate(self._hashes(vec)):
+            self.buckets[i][h].add(key)
+
+    def remove(self, key: int) -> None:
+        vec = self.vectors.pop(key, None)
+        if vec is None:
+            return
+        self.metadata.pop(key, None)
+        for i, h in enumerate(self._hashes(vec)):
+            self.buckets[i][h].discard(key)
+
+    def search(self, query, k, metadata_filter=None):
+        if not self.vectors:
+            return []
+        q = np.asarray(query, dtype=np.float32).reshape(-1)
+        self._ensure(q.shape[0])
+        cands: set[int] = set()
+        for i, h in enumerate(self._hashes(q)):
+            cands |= self.buckets[i].get(h, set())
+        if not cands:
+            cands = set(self.vectors.keys())
+        scored = []
+        qn = q / (np.linalg.norm(q) + 1e-12)
+        for key in cands:
+            if metadata_filter is not None and not _check_metadata(
+                self.metadata.get(key), metadata_filter
+            ):
+                continue
+            v = self.vectors[key]
+            if self.metric == "cos":
+                s = float(v @ qn / (np.linalg.norm(v) + 1e-12))
+            else:
+                s = float(-np.sum((v - q) ** 2))
+            scored.append((key, s))
+        scored.sort(key=lambda t: -t[1])
+        return scored[:k]
+
+
+_TOKEN_RE = re.compile(r"\w+")
+
+
+def _tokenize(text: str) -> list[str]:
+    return [t.lower() for t in _TOKEN_RE.findall(text or "")]
+
+
+class TantivyBM25(InnerIndex):
+    """BM25 full-text index (reference: tantivy_integration.rs) — host-side
+    inverted index with Okapi BM25 scoring."""
+
+    def __init__(self, *, k1: float = 1.2, b: float = 0.75, **kwargs):
+        self.k1, self.b = k1, b
+        self.postings: dict[str, dict[int, int]] = defaultdict(dict)
+        self.doc_len: dict[int, int] = {}
+        self.metadata: dict[int, Any] = {}
+        self.total_len = 0
+
+    def add(self, key: int, item: Any, metadata: Any = None) -> None:
+        if key in self.doc_len:
+            self.remove(key)
+        toks = _tokenize(item if isinstance(item, str) else str(item))
+        counts = Counter(toks)
+        for tok, c in counts.items():
+            self.postings[tok][key] = c
+        self.doc_len[key] = len(toks)
+        self.total_len += len(toks)
+        self.metadata[key] = metadata
+
+    def remove(self, key: int) -> None:
+        n = self.doc_len.pop(key, None)
+        if n is None:
+            return
+        self.total_len -= n
+        self.metadata.pop(key, None)
+        for tok in list(self.postings.keys()):
+            self.postings[tok].pop(key, None)
+            if not self.postings[tok]:
+                del self.postings[tok]
+
+    def search(self, query, k, metadata_filter=None):
+        if not self.doc_len:
+            return []
+        toks = _tokenize(query if isinstance(query, str) else str(query))
+        n_docs = len(self.doc_len)
+        avg_len = self.total_len / n_docs if n_docs else 1.0
+        scores: dict[int, float] = defaultdict(float)
+        for tok in toks:
+            plist = self.postings.get(tok)
+            if not plist:
+                continue
+            idf = math.log(1 + (n_docs - len(plist) + 0.5) / (len(plist) + 0.5))
+            for key, tf in plist.items():
+                dl = self.doc_len[key]
+                scores[key] += idf * tf * (self.k1 + 1) / (
+                    tf + self.k1 * (1 - self.b + self.b * dl / avg_len)
+                )
+        out = [
+            (key, s)
+            for key, s in scores.items()
+            if metadata_filter is None or _check_metadata(self.metadata.get(key), metadata_filter)
+        ]
+        out.sort(key=lambda t: -t[1])
+        return out[:k]
+
+
+class HybridIndex(InnerIndex):
+    """Reciprocal-rank fusion over sub-indexes (reference: hybrid_index.py:14)."""
+
+    def __init__(self, inner_indexes: list[InnerIndex], *, k: float = 60.0):
+        self.inner = inner_indexes
+        self.k = k
+
+    def add(self, key, item, metadata=None):
+        # item is a tuple: one entry per sub-index
+        for idx, it in zip(self.inner, item):
+            idx.add(key, it, metadata)
+
+    def remove(self, key):
+        for idx in self.inner:
+            idx.remove(key)
+
+    def search(self, query, k, metadata_filter=None):
+        fused: dict[int, float] = defaultdict(float)
+        for idx, q in zip(self.inner, query):
+            for rank, (key, _score) in enumerate(idx.search(q, k * 2, metadata_filter)):
+                fused[key] += 1.0 / (self.k + rank + 1)
+        out = sorted(fused.items(), key=lambda t: -t[1])
+        return out[:k]

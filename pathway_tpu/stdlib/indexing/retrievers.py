@@ -1,0 +1,128 @@
+"""Typed retriever factories (reference: stdlib/indexing/retrievers.py +
+nearest_neighbors.py:65-574, bm25.py:41, hybrid_index.py:14)."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable
+
+from ...internals.expression import MakeTupleExpression
+from ...internals.table import Table
+from .data_index import DataIndex
+from .inner_index import BruteForceKnn, HybridIndex, LshKnn, TantivyBM25, USearchKnn
+
+
+class AbstractRetrieverFactory:
+    def build_index(self, data_column, data_table: Table, metadata_column=None) -> DataIndex:
+        raise NotImplementedError
+
+
+@dataclasses.dataclass
+class BruteForceKnnFactory(AbstractRetrieverFactory):
+    dimensions: int | None = None
+    reserved_space: int = 1024
+    embedder: Callable | None = None
+    metric: str = "cos"
+
+    _index_cls = BruteForceKnn
+
+    def build_index(self, data_column, data_table, metadata_column=None) -> DataIndex:
+        cls = type(self)._index_cls
+        dim, space, metric = self.dimensions, self.reserved_space, self.metric
+
+        def factory():
+            return cls(dim, reserved_space=space, metric=metric)
+
+        return DataIndex(
+            data_table,
+            data_column,
+            index_factory=factory,
+            metadata_column=metadata_column,
+            embedder=self.embedder,
+        )
+
+
+@dataclasses.dataclass
+class UsearchKnnFactory(BruteForceKnnFactory):
+    """Parity with the reference's USearch HNSW factory; exact search here."""
+
+    _index_cls = USearchKnn
+
+
+@dataclasses.dataclass
+class LshKnnFactory(AbstractRetrieverFactory):
+    dimensions: int | None = None
+    n_or: int = 8
+    n_and: int = 6
+    embedder: Callable | None = None
+    metric: str = "cos"
+
+    def build_index(self, data_column, data_table, metadata_column=None) -> DataIndex:
+        dim, n_or, n_and, metric = self.dimensions, self.n_or, self.n_and, self.metric
+
+        def factory():
+            return LshKnn(dim, n_or=n_or, n_and=n_and, metric=metric)
+
+        return DataIndex(
+            data_table,
+            data_column,
+            index_factory=factory,
+            metadata_column=metadata_column,
+            embedder=self.embedder,
+        )
+
+
+@dataclasses.dataclass
+class TantivyBM25Factory(AbstractRetrieverFactory):
+    ram_budget: int = 50_000_000
+    in_memory_index: bool = True
+
+    def build_index(self, data_column, data_table, metadata_column=None) -> DataIndex:
+        return DataIndex(
+            data_table,
+            data_column,
+            index_factory=TantivyBM25,
+            metadata_column=metadata_column,
+        )
+
+
+@dataclasses.dataclass
+class HybridIndexFactory(AbstractRetrieverFactory):
+    retriever_factories: list[AbstractRetrieverFactory] = dataclasses.field(default_factory=list)
+    k: float = 60.0
+
+    def build_index(self, data_column, data_table, metadata_column=None) -> DataIndex:
+        subs = self.retriever_factories
+        k = self.k
+
+        sub_embedders = [getattr(f, "embedder", None) for f in subs]
+
+        def make_inner(f):
+            if isinstance(f, (BruteForceKnnFactory, UsearchKnnFactory)):
+                return lambda: type(f)._index_cls(
+                    f.dimensions, reserved_space=f.reserved_space, metric=f.metric
+                )
+            if isinstance(f, LshKnnFactory):
+                return lambda: LshKnn(f.dimensions, n_or=f.n_or, n_and=f.n_and, metric=f.metric)
+            if isinstance(f, TantivyBM25Factory):
+                return lambda: TantivyBM25()
+            raise ValueError(f"unsupported sub-factory {f}")
+
+        inner_factories = [make_inner(f) for f in subs]
+
+        def factory():
+            return HybridIndex([mk() for mk in inner_factories], k=k)
+
+        def hybrid_embedder(col):
+            parts = []
+            for emb in sub_embedders:
+                parts.append(emb(col) if emb is not None else col)
+            return MakeTupleExpression(*parts)
+
+        return DataIndex(
+            data_table,
+            data_column,
+            index_factory=factory,
+            metadata_column=metadata_column,
+            embedder=hybrid_embedder,
+        )

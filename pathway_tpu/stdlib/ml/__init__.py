@@ -1,0 +1,9 @@
+"""ML stdlib: KNN index API, LSH classifiers, smart fuzzy join, HMM.
+
+Reference: python/pathway/stdlib/ml/.
+"""
+
+from . import classifiers, datasets, hmm, index, smart_table_ops
+from .index import KNNIndex
+
+__all__ = ["KNNIndex", "index", "classifiers", "smart_table_ops", "hmm", "datasets"]

@@ -1,0 +1,64 @@
+"""Hidden Markov model state tracking (reference: stdlib/ml/hmm.py, 214 LoC).
+
+`create_hmm_reducer` builds a stateful reducer that runs the Viterbi-style
+forward update per observation.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Hashable
+
+import numpy as np
+
+from ...internals import reducers as R
+
+
+def create_hmm_reducer(
+    graph: dict[Hashable, dict[Hashable, float]],
+    emission_probabilities: Callable[[Any, Hashable], float] | dict | None = None,
+    initial_distribution: dict[Hashable, float] | None = None,
+    num_results_kept: int | None = None,
+):
+    """Returns a stateful reducer computing the most likely current state."""
+    states = list(graph.keys())
+
+    def emis(obs, state):
+        if emission_probabilities is None:
+            return 1.0 if obs == state else 1e-9
+        if callable(emission_probabilities):
+            return emission_probabilities(obs, state)
+        return emission_probabilities.get(state, {}).get(obs, 1e-9)
+
+    def step(state, obs):
+        if state is None:
+            probs = {
+                s: (initial_distribution.get(s, 1e-12) if initial_distribution else 1.0 / len(states))
+                * emis(obs, s)
+                for s in states
+            }
+        else:
+            prev = state
+            probs = {}
+            for s in states:
+                best = max(
+                    (prev.get(p, 1e-300) * graph.get(p, {}).get(s, 1e-12) for p in states),
+                    default=1e-300,
+                )
+                probs[s] = best * emis(obs, s)
+        total = sum(probs.values()) or 1.0
+        return {s: p / total for s, p in probs.items()}
+
+    def combine(state, obs):
+        return step(state, obs)
+
+    def reducer(expr):
+        raw = R.stateful_single(combine, expr)
+        return raw
+
+    return reducer
+
+
+def most_likely_state(probs: dict) -> Any:
+    if probs is None:
+        return None
+    return max(probs.items(), key=lambda kv: kv[1])[0]

@@ -1,0 +1,48 @@
+"""Fuzzy join (reference: stdlib/ml/smart_table_ops/_fuzzy_join.py, 470 LoC).
+
+Token-overlap similarity join between two string columns.
+"""
+
+from __future__ import annotations
+
+import re
+
+from ...internals import dtype as dt
+from ...internals import reducers as R
+from ...internals.expression import ApplyExpression
+from ...internals.table import Table
+
+_TOKEN = re.compile(r"\w+")
+
+
+def _tokens(s: str) -> tuple:
+    return tuple(sorted(set(t.lower() for t in _TOKEN.findall(s or ""))))
+
+
+def fuzzy_match_tables(left: Table, right: Table, *, left_column=None, right_column=None,
+                       threshold: float = 0.0) -> Table:
+    """Match rows by shared tokens, scored by count of common tokens."""
+    lcol = left_column if left_column is not None else left[left.column_names()[0]]
+    rcol = right_column if right_column is not None else right[right.column_names()[0]]
+    lt = left.select(_pw_toks=ApplyExpression(_tokens, dt.List(dt.STR), (lcol,), {}))
+    rt = right.select(_pw_toks=ApplyExpression(_tokens, dt.List(dt.STR), (rcol,), {}))
+    lt = lt.with_columns(_pw_lid=lt.id).flatten(lt._pw_toks)
+    rt = rt.with_columns(_pw_rid=rt.id).flatten(rt._pw_toks)
+    j = lt.join(rt, lt._pw_toks == rt._pw_toks)
+    pairs = j.select(lid=lt._pw_lid, rid=rt._pw_rid)
+    scored = pairs.groupby(pairs.lid, pairs.rid).reduce(
+        pairs.lid, pairs.rid, weight=R.count()
+    )
+    if threshold > 0:
+        scored = scored.filter(scored.weight >= threshold)
+    # keep best match per left row
+    best = scored.groupby(scored.lid).reduce(
+        scored.lid,
+        right=R.argmax(scored.weight, scored.rid),
+        weight=R.max(scored.weight),
+    )
+    return best
+
+
+fuzzy_self_match_table = fuzzy_match_tables
+smart_fuzzy_join = fuzzy_match_tables

@@ -1,0 +1,104 @@
+"""Table.sort — prev/next pointers per instance.
+
+Reference: sort_table (dataflow.rs:2296) + prev_next.rs (895 LoC): maintains,
+for each row, pointers to its predecessor/successor in (instance, key-expr)
+order.  Incremental here via per-instance recompute of the affected
+neighborhood (full instance group, v1).
+"""
+
+from __future__ import annotations
+
+from collections import defaultdict
+from typing import Any
+
+from ...engine.graph import DiffOutputOperator
+from ...engine.runner import register_lowering, _env_for, _compile
+from ...internals import dtype as dt
+from ...internals import parse_graph as pg
+from ...internals.table import Table
+from ...internals.value import hash_values
+
+
+class SortOperator(DiffOutputOperator):
+    """Output universe = input universe; columns = (prev, next)."""
+
+    def __init__(self, env, key_fn, inst_fn, name="sort"):
+        super().__init__(1, name)
+        self.env = env
+        self.key_fn = key_fn
+        self.inst_fn = inst_fn
+        self.by_inst: dict[Any, set] = defaultdict(set)
+        self.key_of: dict[Any, tuple] = {}
+        self.inst_of: dict[Any, Any] = {}
+
+    def _sort_entry(self, key, row):
+        env = self.env.build(key, row)
+        sk = self.key_fn(env)
+        inst = self.inst_fn(env) if self.inst_fn else None
+        try:
+            hash(inst)
+        except TypeError:
+            inst = hash_values(inst)
+        return sk, inst
+
+    def pre_apply(self, port, key, row, diff):
+        if diff > 0:
+            sk, inst = self._sort_entry(key, row)
+            old_inst = self.inst_of.get(key)
+            if old_inst is not None:
+                self.by_inst[old_inst].discard(key)
+            self.by_inst[inst].add(key)
+            self.inst_of[key] = inst
+            self.key_of[key] = sk
+
+    def dirty_keys_for(self, port, key):
+        inst = self.inst_of.get(key)
+        if inst is None:
+            return (key,)
+        return tuple(self.by_inst.get(inst, ())) + (key,)
+
+    def compute(self, key):
+        row = self.state[0].get_row(key)
+        if row is None:
+            inst = self.inst_of.pop(key, None)
+            if inst is not None:
+                self.by_inst[inst].discard(key)
+            self.key_of.pop(key, None)
+            return None
+        inst = self.inst_of.get(key)
+        members = [
+            k for k in self.by_inst.get(inst, ()) if self.state[0].get_row(k) is not None
+        ]
+        members.sort(key=lambda k: (_orderable(self.key_of.get(k)), k))
+        i = members.index(key)
+        prev_k = members[i - 1] if i > 0 else None
+        next_k = members[i + 1] if i + 1 < len(members) else None
+        return (prev_k, next_k)
+
+
+def _orderable(v):
+    try:
+        if v is None:
+            return (0, 0)
+        return (1, v)
+    except Exception:
+        return (2, hash_values(v))
+
+
+@register_lowering("sort")
+def _lower_sort(node, lg):
+    p = node.params
+    src = node.input_tables[0]
+    return SortOperator(
+        _env_for(src),
+        _compile(p["key_expr"]),
+        _compile(p["instance_expr"]) if p.get("instance_expr") is not None else None,
+    )
+
+
+def sort(self: Table, key=None, instance=None, **kwargs) -> Table:
+    key_e = self._desugar(key) if key is not None else self._desugar(kwargs.pop("key", None))
+    inst_e = self._desugar(instance) if instance is not None else None
+    node = pg.new_node("sort", [self], key_expr=key_e, instance_expr=inst_e)
+    dtypes = {"prev": dt.optional(dt.POINTER), "next": dt.optional(dt.POINTER)}
+    return Table(node, ["prev", "next"], dtypes, self._universe, name="sorted")

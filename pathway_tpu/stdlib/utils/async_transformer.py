@@ -1,0 +1,91 @@
+"""AsyncTransformer: Table -> Table asynchronous transformation.
+
+Reference: stdlib/utils/async_transformer.py:60,387 — rows are fed to an
+async `invoke`, results arrive as updates of the output table with a status
+column.  Batch-mode implementation runs the coroutines per micro-batch; the
+streaming path shares the same operator.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Any, ClassVar
+
+from ...internals import dtype as dt
+from ...internals.expression import ApplyExpression, MakeTupleExpression
+from ...internals.schema import SchemaMetaclass
+from ...internals.table import Table
+from ...internals.udfs import run_coroutine_batch
+from ...internals.value import ERROR
+
+
+class _Result:
+    def __init__(self, table: Table):
+        self.successful = table.filter(table._pw_ok == True)  # noqa: E712
+        self.failed = table.filter(table._pw_ok == False)  # noqa: E712
+        self.finished = table
+        self.result = self.successful
+
+
+class AsyncTransformer:
+    output_schema: ClassVar[SchemaMetaclass]
+
+    def __init__(self, input_table: Table, *, instance=None, autocommit_duration_ms=None):
+        self._input = input_table
+        self._instance = instance
+
+    async def invoke(self, *args, **kwargs) -> dict:
+        raise NotImplementedError
+
+    def open(self) -> None:
+        pass
+
+    def close(self) -> None:
+        pass
+
+    @property
+    def successful(self) -> Table:
+        return self.result.successful
+
+    @property
+    def failed(self) -> Table:
+        return self.result.failed
+
+    @property
+    def finished(self) -> Table:
+        return self.result.finished
+
+    @property
+    def result(self) -> _Result:
+        if not hasattr(self, "_result"):
+            self._result = self._build()
+        return self._result
+
+    def _build(self) -> _Result:
+        t = self._input
+        out_cols = self.output_schema.column_names()
+        colnames = t.column_names()
+        self.open()
+
+        def run_row(*vals):
+            kwargs = dict(zip(colnames, vals))
+
+            async def one():
+                return await self.invoke(**kwargs)
+
+            try:
+                res = asyncio.run(one())
+                return tuple(res.get(c) for c in out_cols) + (True,)
+            except Exception:
+                return tuple(None for _ in out_cols) + (False,)
+
+        packed = t.select(
+            _pw_res=ApplyExpression(
+                run_row, dt.ANY, tuple(t[c] for c in colnames), {}, deterministic=False
+            )
+        )
+        out = packed.select(
+            **{c: packed._pw_res[i] for i, c in enumerate(out_cols)},
+            _pw_ok=packed._pw_res[len(out_cols)],
+        )
+        return _Result(out)

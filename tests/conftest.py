@@ -1,0 +1,18 @@
+import os
+
+# virtual 8-device CPU mesh for sharding tests; keep TPU free for bench
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+
+import pytest
+
+
+@pytest.fixture(autouse=True)
+def clear_parse_graph():
+    """Reference parity: autouse fixture clears the global ParseGraph after
+    every test (python/pathway/conftest.py:21-77)."""
+    from pathway_tpu.internals import parse_graph as pg
+
+    pg.G.clear()
+    yield
+    pg.G.clear()

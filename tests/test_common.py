@@ -1,0 +1,438 @@
+"""Core Table semantics (reference model: python/pathway/tests/test_common.py)."""
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.debug import table_from_markdown
+
+from .utils import (
+    assert_table_equality,
+    assert_table_equality_wo_index,
+    run_and_squash,
+)
+
+
+def t_abc():
+    return table_from_markdown(
+        """
+        | a | b | c
+      1 | 1 | x | 10.5
+      2 | 2 | y | 20.5
+      3 | 3 | z | 30.5
+        """
+    )
+
+
+def test_select_arithmetic():
+    t = t_abc()
+    out = t.select(d=t.a * 2 + 1)
+    expected = table_from_markdown(
+        """
+        | d
+      1 | 3
+      2 | 5
+      3 | 7
+        """
+    )
+    assert_table_equality(out, expected)
+
+
+def test_select_this():
+    t = t_abc()
+    out = t.select(pw.this.a, doubled=pw.this.a * 2)
+    state = run_and_squash(out)
+    assert sorted(state.values()) == [(1, 2), (2, 4), (3, 6)]
+
+
+def test_select_star():
+    t = t_abc()
+    out = t.select(*pw.this)
+    assert out.column_names() == ["a", "b", "c"]
+    assert len(run_and_squash(out)) == 3
+
+
+def test_with_columns():
+    t = t_abc()
+    out = t.with_columns(d=pw.this.a + 1)
+    assert out.column_names() == ["a", "b", "c", "d"]
+    state = run_and_squash(out)
+    assert sorted(r[3] for r in state.values()) == [2, 3, 4]
+
+
+def test_filter():
+    t = t_abc()
+    out = t.filter(pw.this.a > 1).select(pw.this.a)
+    state = run_and_squash(out)
+    assert sorted(r[0] for r in state.values()) == [2, 3]
+
+
+def test_filter_keeps_keys():
+    t = t_abc()
+    filtered = t.filter(pw.this.a >= 2)
+    out = filtered.select(filtered.b)
+    state = run_and_squash(out)
+    assert sorted(r[0] for r in state.values()) == ["y", "z"]
+
+
+def test_string_ops():
+    t = t_abc()
+    out = t.select(u=pw.this.b.str.upper(), n=pw.this.b.str.len())
+    state = run_and_squash(out)
+    assert sorted(state.values()) == [("X", 1), ("Y", 1), ("Z", 1)]
+
+
+def test_if_else_and_bool():
+    t = t_abc()
+    out = t.select(big=pw.if_else(pw.this.a >= 2, "big", "small"))
+    state = run_and_squash(out)
+    assert sorted(r[0] for r in state.values()) == ["big", "big", "small"]
+
+
+def test_concat_reindex():
+    t1 = table_from_markdown(
+        """
+        | a
+      1 | 1
+        """
+    )
+    t2 = table_from_markdown(
+        """
+        | a
+      1 | 2
+        """
+    )
+    out = t1.concat_reindex(t2)
+    state = run_and_squash(out)
+    assert sorted(r[0] for r in state.values()) == [1, 2]
+
+
+def test_rename_and_without():
+    t = t_abc()
+    out = t.rename(aa=pw.this.a).without("b", "c")
+    assert out.column_names() == ["aa"]
+
+
+def test_update_cells():
+    t = t_abc()
+    upd = table_from_markdown(
+        """
+        | b
+      1 | q
+        """
+    )
+    out = t.update_cells(upd.with_universe_of(t) if False else upd.promise_universe_is_subset_of(t))
+    state = run_and_squash(out)
+    bs = sorted(r[1] for r in state.values())
+    assert bs == ["q", "y", "z"]
+
+
+def test_update_rows():
+    t = t_abc()
+    upd = table_from_markdown(
+        """
+        | a | b | c
+      1 | 9 | q | 0.5
+      7 | 8 | w | 1.5
+        """
+    )
+    out = t.update_rows(upd)
+    state = run_and_squash(out)
+    assert len(state) == 4
+    assert sorted(r[0] for r in state.values()) == [2, 3, 8, 9]
+
+
+def test_ix():
+    target = table_from_markdown(
+        """
+        k | v
+        1 | 100
+        2 | 200
+        """,
+        id_from=["k"],
+    )
+    src = table_from_markdown(
+        """
+        | ptr_name
+      5 | 1
+      6 | 2
+        """
+    )
+    withptr = src.select(p=target.pointer_from(src.ptr_name))
+    # pointer_from over values matching target's explicit ids
+    looked = target.ix(withptr.p)
+    out = looked.select(looked.v)
+    state = run_and_squash(out)
+    assert sorted(r[0] for r in state.values()) == [100, 200]
+
+
+def test_groupby_count_sum():
+    t = table_from_markdown(
+        """
+        | g | v
+      1 | a | 1
+      2 | a | 2
+      3 | b | 5
+        """
+    )
+    out = t.groupby(t.g).reduce(t.g, cnt=pw.reducers.count(), s=pw.reducers.sum(t.v))
+    state = run_and_squash(out)
+    assert sorted(state.values()) == [("a", 2, 3), ("b", 1, 5)]
+
+
+def test_groupby_min_max_avg():
+    t = table_from_markdown(
+        """
+        | g | v
+      1 | a | 1
+      2 | a | 3
+      3 | b | 5
+        """
+    )
+    out = t.groupby(t.g).reduce(
+        t.g,
+        mn=pw.reducers.min(t.v),
+        mx=pw.reducers.max(t.v),
+        av=pw.reducers.avg(t.v),
+    )
+    state = run_and_squash(out)
+    assert sorted(state.values()) == [("a", 1, 3, 2.0), ("b", 5, 5, 5.0)]
+
+
+def test_groupby_argmin_argmax_tuple():
+    t = table_from_markdown(
+        """
+        | g | v | n
+      1 | a | 1 | one
+      2 | a | 3 | three
+      3 | b | 5 | five
+        """
+    )
+    out = t.groupby(t.g).reduce(
+        t.g,
+        lo=pw.reducers.argmin(t.v, t.n),
+        hi=pw.reducers.argmax(t.v, t.n),
+        st=pw.reducers.sorted_tuple(t.v),
+    )
+    state = run_and_squash(out)
+    assert sorted(state.values()) == [
+        ("a", "one", "three", (1, 3)),
+        ("b", "five", "five", (5,)),
+    ]
+
+
+def test_global_reduce():
+    t = t_abc()
+    out = t.reduce(s=pw.reducers.sum(t.a), c=pw.reducers.count())
+    state = run_and_squash(out)
+    assert list(state.values()) == [(6, 3)]
+
+
+def test_reduce_expression_over_reducers():
+    t = t_abc()
+    out = t.reduce(m=pw.reducers.sum(t.a) * 10 + pw.reducers.count())
+    state = run_and_squash(out)
+    assert list(state.values()) == [(63,)]
+
+
+def test_join_inner():
+    left = table_from_markdown(
+        """
+        | k | x
+      1 | a | 1
+      2 | b | 2
+        """
+    )
+    right = table_from_markdown(
+        """
+        | k | y
+      5 | a | 10
+      6 | c | 30
+        """
+    )
+    out = left.join(right, left.k == right.k).select(left.k, pw.left.x, pw.right.y)
+    state = run_and_squash(out)
+    assert list(state.values()) == [("a", 1, 10)]
+
+
+def test_join_left():
+    left = table_from_markdown(
+        """
+        | k | x
+      1 | a | 1
+      2 | b | 2
+        """
+    )
+    right = table_from_markdown(
+        """
+        | k | y
+      5 | a | 10
+        """
+    )
+    out = left.join_left(right, left.k == right.k).select(left.k, pw.right.y)
+    state = run_and_squash(out)
+    assert sorted(state.values(), key=repr) == [("a", 10), ("b", None)]
+
+
+def test_join_outer():
+    left = table_from_markdown(
+        """
+        | k | x
+      1 | a | 1
+        """
+    )
+    right = table_from_markdown(
+        """
+        | k | y
+      5 | b | 10
+        """
+    )
+    out = left.join_outer(right, left.k == right.k).select(
+        lx=pw.left.x, ry=pw.right.y
+    )
+    state = run_and_squash(out)
+    assert sorted(state.values(), key=repr) == [(1, None), (None, 10)]
+
+
+def test_flatten():
+    t = table_from_markdown(
+        """
+        | a
+      1 | x
+        """
+    ).select(parts=pw.make_tuple(1, 2, 3))
+    out = t.flatten(t.parts)
+    state = run_and_squash(out)
+    assert sorted(r[0] for r in state.values()) == [1, 2, 3]
+
+
+def test_difference_intersect():
+    t1 = table_from_markdown(
+        """
+        | a
+      1 | 1
+      2 | 2
+        """
+    )
+    t2 = table_from_markdown(
+        """
+        | b
+      2 | 20
+        """
+    )
+    diff = t1.difference(t2)
+    inter = t1.intersect(t2)
+    assert sorted(r[0] for r in run_and_squash(diff).values()) == [1]
+    assert sorted(r[0] for r in run_and_squash(inter).values()) == [2]
+
+
+def test_groupby_retraction_stream():
+    t = table_from_markdown(
+        """
+        | g | v | __time__ | __diff__
+        | a | 1 | 0        | 1
+        | a | 2 | 2        | 1
+        | a | 1 | 4        | -1
+        """
+    )
+    out = t.groupby(t.g).reduce(t.g, s=pw.reducers.sum(t.v), c=pw.reducers.count())
+    state = run_and_squash(out)
+    assert list(state.values()) == [("a", 2, 1)]
+
+
+def test_deduplicate():
+    t = table_from_markdown(
+        """
+        | v | __time__
+        | 1 | 0
+        | 3 | 2
+        | 2 | 4
+        """
+    )
+    out = t.deduplicate(value=pw.this.v, acceptor=lambda new, old: old is None or new > old)
+    state = run_and_squash(out)
+    assert list(state.values()) == [(3,)]
+
+
+def test_cast_and_apply():
+    t = t_abc()
+    out = t.select(s=pw.cast(str, t.a), ap=pw.apply(lambda x: x * 3, t.a))
+    state = run_and_squash(out)
+    assert sorted(state.values()) == [("1", 3), ("2", 6), ("3", 9)]
+
+
+def test_udf():
+    @pw.udf
+    def add_one(x: int) -> int:
+        return x + 1
+
+    t = t_abc()
+    out = t.select(b=add_one(t.a))
+    state = run_and_squash(out)
+    assert sorted(r[0] for r in state.values()) == [2, 3, 4]
+
+
+def test_error_poisoning():
+    t = table_from_markdown(
+        """
+        | a | b
+      1 | 1 | 0
+        """
+    )
+    out = t.select(d=pw.fill_error(t.a // t.b, -1))
+    state = run_and_squash(out)
+    assert list(state.values()) == [(-1,)]
+
+
+def test_coalesce_require():
+    t = table_from_markdown(
+        """
+        | a | b
+      1 |   | 5
+      2 | 2 | 7
+        """
+    )
+    out = t.select(c=pw.coalesce(t.a, t.b), r=pw.require(t.b, t.a))
+    state = run_and_squash(out)
+    assert sorted(state.values(), key=repr) == [(2, 7), (5, None)]
+
+
+def test_iterate_collatz():
+    def collatz_step(t):
+        return t.select(
+            a=pw.if_else(
+                t.a == 1, 1, pw.if_else(t.a % 2 == 0, t.a // 2, 3 * t.a + 1)
+            )
+        )
+
+    start = table_from_markdown(
+        """
+        | a
+      1 | 7
+      2 | 12
+      3 | 1
+        """
+    )
+    out = pw.iterate(lambda t: collatz_step(t), t=start)
+    state = run_and_squash(out)
+    assert sorted(r[0] for r in state.values()) == [1, 1, 1]
+
+
+def test_sql_select_where():
+    t = t_abc()
+    out = pw.sql("SELECT a FROM tab WHERE a > 1", tab=t)
+    state = run_and_squash(out)
+    assert sorted(r[0] for r in state.values()) == [2, 3]
+
+
+def test_sql_groupby():
+    t = table_from_markdown(
+        """
+        | g | v
+      1 | a | 1
+      2 | a | 2
+      3 | b | 5
+        """
+    )
+    out = pw.sql("SELECT g, SUM(v) AS s FROM tab GROUP BY g", tab=t)
+    state = run_and_squash(out)
+    assert sorted(state.values()) == [("a", 3), ("b", 5)]

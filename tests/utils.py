@@ -1,0 +1,77 @@
+"""Test helpers mirroring the reference's tests/utils.py:314-365."""
+
+from __future__ import annotations
+
+import pathway_tpu as pw
+from pathway_tpu.engine.runner import run_tables
+
+
+def _normalize(state: dict, colnames: list[str]):
+    import numpy as np
+
+    out = set()
+    for key, row in state.items():
+        norm = []
+        for v in row:
+            if isinstance(v, np.ndarray):
+                v = ("#arr", v.shape, tuple(np.asarray(v).ravel().tolist()))
+            if isinstance(v, float) and v == int(v) and abs(v) < 1e15:
+                v = ("#num", float(v))
+            if isinstance(v, (int,)) and not isinstance(v, bool):
+                v = ("#num", float(v))
+            norm.append(v)
+        out.add((key, tuple(norm)))
+    return out
+
+
+def _normalize_wo_index(state: dict):
+    import numpy as np
+    from collections import Counter
+
+    out = Counter()
+    for _key, row in state.items():
+        norm = []
+        for v in row:
+            if isinstance(v, np.ndarray):
+                v = ("#arr", v.shape, tuple(np.asarray(v).ravel().tolist()))
+            if isinstance(v, float) and v == int(v) and abs(v) < 1e15:
+                v = ("#num", float(v))
+            if isinstance(v, int) and not isinstance(v, bool):
+                v = ("#num", float(v))
+            try:
+                hash(v)
+            except TypeError:
+                v = repr(v)
+            norm.append(v)
+        out[tuple(norm)] += 1
+    return out
+
+
+def assert_table_equality(actual: pw.Table, expected: pw.Table) -> None:
+    caps = run_tables(actual, expected)
+    a, e = caps[0].squash(), caps[1].squash()
+    assert _normalize(a, caps[0].column_names) == _normalize(e, caps[1].column_names), (
+        f"\nactual:   {sorted(a.items())}\nexpected: {sorted(e.items())}"
+    )
+
+
+def assert_table_equality_wo_index(actual: pw.Table, expected: pw.Table) -> None:
+    caps = run_tables(actual, expected)
+    a, e = caps[0].squash(), caps[1].squash()
+    assert _normalize_wo_index(a) == _normalize_wo_index(e), (
+        f"\nactual:   {sorted(map(repr, a.values()))}\nexpected: {sorted(map(repr, e.values()))}"
+    )
+
+
+assert_table_equality_wo_types = assert_table_equality
+assert_table_equality_wo_index_types = assert_table_equality_wo_index
+
+
+def run_and_squash(table: pw.Table) -> dict:
+    [cap] = run_tables(table)
+    return cap.squash()
+
+
+def captured_stream(table: pw.Table):
+    [cap] = run_tables(table)
+    return cap.as_list()
