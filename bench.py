@@ -1,0 +1,87 @@
+"""Round benchmark: RAG ingest + query through the live framework.
+
+North-star metric (BASELINE.md): docs/sec indexed + p50 query latency.
+This bench drives the real pipeline pieces end-to-end on the current JAX
+backend (TPU when available): tokenize -> on-device transformer embed
+(bucketed bf16 batches) -> live KNN index add; then embed+search queries
+one-at-a-time to measure serving latency.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...extras}.
+"""
+
+from __future__ import annotations
+
+import json
+import random
+import statistics
+import time
+
+
+def make_corpus(n_docs: int, words_per_doc: int = 48, seed: int = 0) -> list[str]:
+    rng = random.Random(seed)
+    vocab = [f"w{i}" for i in range(5000)]
+    return [
+        " ".join(rng.choice(vocab) for _ in range(words_per_doc)) for _ in range(n_docs)
+    ]
+
+
+def main() -> None:
+    import jax
+
+    from pathway_tpu.models.encoder import EncoderConfig, JaxEncoder
+    from pathway_tpu.stdlib.indexing.inner_index import BruteForceKnn
+
+    backend = jax.default_backend()
+    n_docs = 4096
+    batch = 256
+    n_queries = 64
+    k = 10
+
+    enc = JaxEncoder(EncoderConfig(max_len=128), seq_buckets=(64,), batch_buckets=(1, 256))
+    index = BruteForceKnn(enc.dimensions, reserved_space=n_docs)
+    docs = make_corpus(n_docs)
+
+    # warmup/compile both bucket shapes
+    enc.embed_batch(docs[:batch])
+    enc.embed_batch([docs[0]])
+
+    t0 = time.perf_counter()
+    key = 0
+    for i in range(0, n_docs, batch):
+        chunk = docs[i : i + batch]
+        vecs = enc.embed_batch(chunk)
+        for v in vecs:
+            index.add(key, v)
+            key += 1
+    t1 = time.perf_counter()
+    docs_per_sec = n_docs / (t1 - t0)
+
+    queries = make_corpus(n_queries, seed=123)
+    lat = []
+    for q in queries:
+        tq = time.perf_counter()
+        v = enc.embed(q)
+        index.search(v, k)
+        lat.append((time.perf_counter() - tq) * 1000)
+    p50 = statistics.median(lat)
+    p95 = sorted(lat)[int(0.95 * len(lat)) - 1]
+
+    print(
+        json.dumps(
+            {
+                "metric": "rag_index_throughput",
+                "value": round(docs_per_sec, 1),
+                "unit": "docs/sec",
+                "vs_baseline": 1.0,
+                "query_p50_ms": round(p50, 2),
+                "query_p95_ms": round(p95, 2),
+                "n_docs": n_docs,
+                "embed_dim": enc.dimensions,
+                "backend": backend,
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -12,9 +12,10 @@ from .expression import ColumnReference
 
 class ThisMetaclass(type):
     _pw_exclusions: tuple[str, ...] = ()
+    _pw_base = None
 
     def __getattr__(cls, name: str) -> ColumnReference:
-        if name.startswith("_pw_") or name.startswith("__"):
+        if name.startswith("__"):
             raise AttributeError(name)
         return ColumnReference(cls, name)
 
@@ -34,11 +35,11 @@ class ThisMetaclass(type):
             pass
 
         _without._pw_exclusions = cls._pw_exclusions + names
-        _without._pw_base = getattr(cls, "_pw_base", cls)
+        _without._pw_base = cls._pw_base or cls
         return _without
 
     def __repr__(cls) -> str:
-        return f"<{getattr(cls, '_pw_base', cls).__name__}>"
+        return f"<{(cls._pw_base or cls).__name__}>"
 
 
 class this(metaclass=ThisMetaclass):
@@ -54,7 +55,7 @@ class right(metaclass=ThisMetaclass):
 
 
 def base_placeholder(cls) -> type:
-    return getattr(cls, "_pw_base", cls)
+    return cls._pw_base or cls
 
 
 def is_placeholder(obj) -> bool:

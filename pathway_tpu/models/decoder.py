@@ -1,0 +1,127 @@
+"""Causal decoder LM — the on-device generation model for the RAG xpack
+(replaces the reference's HTTP LLM calls, xpacks/llm/llms.py:43-771) and the
+training step exercised by the multi-chip dryrun.
+
+Same pure-JAX pytree style as the encoder so the tensor-parallel sharding
+rules in parallel/mesh.py apply to both.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .encoder import EncoderConfig, _attention, _layer_norm, init_params
+
+
+@dataclasses.dataclass(frozen=True)
+class DecoderConfig:
+    vocab_size: int = 32768
+    d_model: int = 512
+    n_layers: int = 8
+    n_heads: int = 8
+    d_ff: int = 2048
+    max_len: int = 1024
+    dtype: Any = jnp.bfloat16
+
+    def as_encoder_cfg(self) -> EncoderConfig:
+        return EncoderConfig(
+            vocab_size=self.vocab_size, d_model=self.d_model,
+            n_layers=self.n_layers, n_heads=self.n_heads, d_ff=self.d_ff,
+            max_len=self.max_len, dtype=self.dtype,
+        )
+
+
+def init_decoder_params(cfg: DecoderConfig, rng: jax.Array) -> dict:
+    return init_params(cfg.as_encoder_cfg(), rng)
+
+
+def _causal_attention(layer, x, n_heads: int):
+    B, T, D = x.shape
+    H = n_heads
+    hd = D // H
+    q = (x @ layer["wq"].astype(x.dtype)).reshape(B, T, H, hd)
+    k = (x @ layer["wk"].astype(x.dtype)).reshape(B, T, H, hd)
+    v = (x @ layer["wv"].astype(x.dtype)).reshape(B, T, H, hd)
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(hd)
+    causal = jnp.tril(jnp.ones((T, T), bool))
+    scores = jnp.where(causal[None, None, :, :], scores, -1e9)
+    probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(x.dtype)
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs, v).reshape(B, T, D)
+    return out @ layer["wo"].astype(x.dtype)
+
+
+def forward_logits(params: dict, cfg: DecoderConfig, token_ids: jax.Array) -> jax.Array:
+    """(B, T) -> (B, T, V) logits (tied embedding head)."""
+    x = params["embed"].astype(cfg.dtype)[token_ids]
+    T = token_ids.shape[1]
+    x = x + params["pos_embed"].astype(cfg.dtype)[:T][None, :, :]
+    for layer in params["layers"]:
+        h = _layer_norm(x, layer["ln1_scale"], layer["ln1_bias"])
+        x = x + _causal_attention(layer, h, cfg.n_heads)
+        h = _layer_norm(x, layer["ln2_scale"], layer["ln2_bias"])
+        ff = jax.nn.gelu(h @ layer["w_up"].astype(x.dtype))
+        x = x + ff @ layer["w_down"].astype(x.dtype)
+    x = _layer_norm(x, params["ln_f_scale"], params["ln_f_bias"])
+    return (x @ params["embed"].astype(x.dtype).T).astype(jnp.float32)
+
+
+def lm_loss(params: dict, cfg: DecoderConfig, token_ids: jax.Array,
+            mask: jax.Array) -> jax.Array:
+    logits = forward_logits(params, cfg, token_ids[:, :-1])
+    targets = token_ids[:, 1:]
+    m = mask[:, 1:].astype(jnp.float32)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return jnp.sum(nll * m) / jnp.maximum(jnp.sum(m), 1.0)
+
+
+def make_train_step(cfg: DecoderConfig, learning_rate: float = 1e-3):
+    """SGD-with-momentum training step (optax-free core for portability)."""
+
+    def train_step(params, opt_state, token_ids, mask):
+        loss, grads = jax.value_and_grad(
+            lambda p: lm_loss(p, cfg, token_ids, mask)
+        )(params)
+        new_momentum = jax.tree_util.tree_map(
+            lambda m, g: 0.9 * m + g, opt_state, grads
+        )
+        new_params = jax.tree_util.tree_map(
+            lambda p, m: p - learning_rate * m, params, new_momentum
+        )
+        return new_params, new_momentum, loss
+
+    return train_step
+
+
+def init_opt_state(params):
+    return jax.tree_util.tree_map(jnp.zeros_like, params)
+
+
+class JaxDecoderLM:
+    """Host-facing text generator (greedy, bucketed shapes)."""
+
+    def __init__(self, cfg: DecoderConfig | None = None, seed: int = 0):
+        self.cfg = cfg or DecoderConfig()
+        self.params = init_decoder_params(self.cfg, jax.random.PRNGKey(seed))
+        from .tokenizer import HashTokenizer
+
+        self.tokenizer = HashTokenizer(self.cfg.vocab_size)
+        self._logits = jax.jit(functools.partial(forward_logits, cfg=self.cfg))
+
+    def generate(self, prompt: str, max_new_tokens: int = 32) -> str:
+        ids = self.tokenizer.encode(prompt)[-self.cfg.max_len + max_new_tokens:]
+        out = []
+        cur = list(ids) or [4]
+        for _ in range(max_new_tokens):
+            arr = jnp.asarray([cur[-min(len(cur), self.cfg.max_len):]], jnp.int32)
+            logits = self._logits(self.params, token_ids=arr)
+            nxt = int(jnp.argmax(logits[0, -1]))
+            out.append(nxt)
+            cur.append(nxt)
+        return " ".join(f"<{t}>" for t in out)

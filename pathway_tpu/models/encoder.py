@@ -1,0 +1,156 @@
+"""Transformer text encoder — the on-device replacement for the reference's
+external embedding services (xpacks/llm/embedders.py calls OpenAI /
+SentenceTransformer over HTTP; here the forward pass is a jit'd bf16 JAX
+computation feeding the MXU).
+
+Pure-JAX functional style: params are a pytree dict, so tensor-parallel
+sharding rules (parallel/mesh.py) apply directly and the same code runs
+single-chip or pjit'd over a mesh.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class EncoderConfig:
+    vocab_size: int = 32768
+    d_model: int = 384
+    n_layers: int = 6
+    n_heads: int = 6
+    d_ff: int = 1536
+    max_len: int = 512
+    dtype: Any = jnp.bfloat16
+
+
+def init_params(cfg: EncoderConfig, rng: jax.Array) -> dict:
+    keys = jax.random.split(rng, cfg.n_layers * 8 + 4)
+    ki = iter(range(len(keys)))
+
+    def dense(key, shape, scale=None):
+        scale = scale or (1.0 / np.sqrt(shape[0]))
+        return (jax.random.normal(keys[key], shape, jnp.float32) * scale).astype(jnp.float32)
+
+    params: dict = {
+        "embed": dense(next(ki), (cfg.vocab_size, cfg.d_model), 0.02),
+        "pos_embed": dense(next(ki), (cfg.max_len, cfg.d_model), 0.02),
+        "ln_f_scale": jnp.ones((cfg.d_model,), jnp.float32),
+        "ln_f_bias": jnp.zeros((cfg.d_model,), jnp.float32),
+        "layers": [],
+    }
+    for _ in range(cfg.n_layers):
+        layer = {
+            "wq": dense(next(ki), (cfg.d_model, cfg.d_model)),
+            "wk": dense(next(ki), (cfg.d_model, cfg.d_model)),
+            "wv": dense(next(ki), (cfg.d_model, cfg.d_model)),
+            "wo": dense(next(ki), (cfg.d_model, cfg.d_model)),
+            "w_up": dense(next(ki), (cfg.d_model, cfg.d_ff)),
+            "w_down": dense(next(ki), (cfg.d_ff, cfg.d_model)),
+            "ln1_scale": jnp.ones((cfg.d_model,), jnp.float32),
+            "ln1_bias": jnp.zeros((cfg.d_model,), jnp.float32),
+            "ln2_scale": jnp.ones((cfg.d_model,), jnp.float32),
+            "ln2_bias": jnp.zeros((cfg.d_model,), jnp.float32),
+        }
+        params["layers"].append(layer)
+    return params
+
+
+def _layer_norm(x, scale, bias, eps=1e-6):
+    x32 = x.astype(jnp.float32)
+    mean = jnp.mean(x32, axis=-1, keepdims=True)
+    var = jnp.var(x32, axis=-1, keepdims=True)
+    out = (x32 - mean) * jax.lax.rsqrt(var + eps) * scale + bias
+    return out.astype(x.dtype)
+
+
+def _attention(layer, x, mask, n_heads: int):
+    B, T, D = x.shape
+    H = n_heads
+    hd = D // H
+    q = (x @ layer["wq"].astype(x.dtype)).reshape(B, T, H, hd)
+    k = (x @ layer["wk"].astype(x.dtype)).reshape(B, T, H, hd)
+    v = (x @ layer["wv"].astype(x.dtype)).reshape(B, T, H, hd)
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(hd)
+    scores = jnp.where(mask[:, None, None, :], scores, -1e9)
+    probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(x.dtype)
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs, v).reshape(B, T, D)
+    return out @ layer["wo"].astype(x.dtype)
+
+
+def encode(params: dict, cfg: EncoderConfig, token_ids: jax.Array, mask: jax.Array) -> jax.Array:
+    """(B, T) int32 tokens + (B, T) bool mask -> (B, d_model) L2-normed f32."""
+    x = params["embed"].astype(cfg.dtype)[token_ids]
+    T = token_ids.shape[1]
+    x = x + params["pos_embed"].astype(cfg.dtype)[:T][None, :, :]
+    for layer in params["layers"]:
+        h = _layer_norm(x, layer["ln1_scale"], layer["ln1_bias"])
+        x = x + _attention(layer, h, mask, cfg.n_heads)
+        h = _layer_norm(x, layer["ln2_scale"], layer["ln2_bias"])
+        ff = jax.nn.gelu(h @ layer["w_up"].astype(x.dtype))
+        x = x + ff @ layer["w_down"].astype(x.dtype)
+    x = _layer_norm(x, params["ln_f_scale"], params["ln_f_bias"])
+    # masked mean pooling + L2 norm (SentenceTransformer-style)
+    m = mask[:, :, None].astype(jnp.float32)
+    pooled = jnp.sum(x.astype(jnp.float32) * m, axis=1) / jnp.maximum(
+        jnp.sum(m, axis=1), 1.0
+    )
+    return pooled / (jnp.linalg.norm(pooled, axis=-1, keepdims=True) + 1e-12)
+
+
+class JaxEncoder:
+    """Host-facing embedder: tokenize → pad to bucket → jit forward.
+
+    Padding to bucketed batch/sequence sizes keeps XLA shapes static
+    (one compilation per bucket), per the TPU design rules.
+    """
+
+    def __init__(self, cfg: EncoderConfig | None = None, seed: int = 0,
+                 seq_buckets=(32, 128, 512), batch_buckets=(1, 8, 64, 256)):
+        self.cfg = cfg or EncoderConfig()
+        self.params = init_params(self.cfg, jax.random.PRNGKey(seed))
+        self.seq_buckets = [b for b in seq_buckets if b <= self.cfg.max_len]
+        self.batch_buckets = list(batch_buckets)
+        self._fwd = jax.jit(functools.partial(encode, cfg=self.cfg))
+        from .tokenizer import HashTokenizer
+
+        self.tokenizer = HashTokenizer(self.cfg.vocab_size)
+
+    def _bucket(self, n: int, buckets) -> int:
+        for b in buckets:
+            if n <= b:
+                return b
+        return buckets[-1]
+
+    @property
+    def dimensions(self) -> int:
+        return self.cfg.d_model
+
+    def embed_batch(self, texts: list[str]) -> np.ndarray:
+        if not texts:
+            return np.zeros((0, self.cfg.d_model), np.float32)
+        toks = [self.tokenizer.encode(t)[: self.cfg.max_len] for t in texts]
+        max_t = max(1, max(len(t) for t in toks))
+        T = self._bucket(max_t, self.seq_buckets)
+        B = self._bucket(len(texts), self.batch_buckets)
+        ids = np.zeros((B, T), np.int32)
+        mask = np.zeros((B, T), bool)
+        for i, t in enumerate(toks):
+            t = t[:T]
+            ids[i, : len(t)] = t
+            mask[i, : len(t)] = True
+        out = np.asarray(self._fwd(self.params, token_ids=jnp.asarray(ids),
+                                   mask=jnp.asarray(mask)))
+        return out[: len(texts)]
+
+    def embed(self, text: str) -> np.ndarray:
+        return self.embed_batch([text])[0]
+
+    def __call__(self, text: str) -> np.ndarray:
+        return self.embed(text)

@@ -1,0 +1,50 @@
+"""Self-contained tokenizers (no downloaded vocab files).
+
+HashTokenizer: word-level stable hashing into the vocab — deterministic
+across processes/restarts (required for UDF replay and persistence), no
+external assets.  When a HuggingFace tokenizer is available locally it can
+be wrapped with HFTokenizer for real subword vocabularies.
+"""
+
+from __future__ import annotations
+
+import re
+
+from ..internals.value import hash_values
+
+_WORD = re.compile(r"\w+|[^\w\s]")
+
+
+class HashTokenizer:
+    def __init__(self, vocab_size: int = 32768, lowercase: bool = True):
+        self.vocab_size = vocab_size
+        self.lowercase = lowercase
+
+    def tokenize(self, text: str) -> list[str]:
+        if self.lowercase:
+            text = text.lower()
+        return _WORD.findall(text or "")
+
+    def encode(self, text: str) -> list[int]:
+        # ids 0..3 reserved (pad/unk/cls/sep)
+        return [4 + (hash_values("#tok", w) % (self.vocab_size - 4))
+                for w in self.tokenize(text)]
+
+    def count_tokens(self, text: str) -> int:
+        return len(self.tokenize(text))
+
+
+class HFTokenizer:
+    """Wrap a locally-available HuggingFace tokenizer."""
+
+    def __init__(self, name_or_path: str):
+        from transformers import AutoTokenizer
+
+        self._tok = AutoTokenizer.from_pretrained(name_or_path)
+        self.vocab_size = self._tok.vocab_size
+
+    def encode(self, text: str) -> list[int]:
+        return self._tok.encode(text, add_special_tokens=False)
+
+    def count_tokens(self, text: str) -> int:
+        return len(self.encode(text))

@@ -1,0 +1,105 @@
+"""Device mesh + sharding helpers.
+
+The reference scales via timely workers over TCP
+(external/timely-dataflow/communication, src/engine/dataflow/config.rs);
+the TPU build scales via jax.sharding over ICI/DCN: pick a mesh, annotate
+shardings, let XLA insert collectives.
+
+Axes: dp (data/batch), tp (tensor/model), sp (sequence).  Single-chip runs
+use a trivial 1-device mesh so the same pjit'd code paths run everywhere.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def make_mesh(
+    n_devices: int | None = None,
+    *,
+    dp: int | None = None,
+    tp: int | None = None,
+    axis_names: Sequence[str] = ("dp", "tp"),
+) -> Mesh:
+    devices = jax.devices()
+    n = n_devices or len(devices)
+    devices = devices[:n]
+    if dp is None and tp is None:
+        # favor tensor parallelism within a host: ICI all-reduces are cheap
+        tp = _largest_pow2_divisor(n, cap=8)
+        dp = n // tp
+    elif dp is None:
+        dp = n // tp
+    elif tp is None:
+        tp = n // dp
+    assert dp * tp == n, f"dp({dp}) * tp({tp}) != n_devices({n})"
+    arr = np.asarray(devices).reshape(dp, tp)
+    return Mesh(arr, axis_names=tuple(axis_names))
+
+
+def _largest_pow2_divisor(n: int, cap: int) -> int:
+    p = 1
+    while p * 2 <= cap and n % (p * 2) == 0:
+        p *= 2
+    return p
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def batch_sharded(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P("dp"))
+
+
+def param_sharding_rules(path: tuple[str, ...], leaf_shape: tuple[int, ...]) -> P:
+    """Megatron-style tensor-parallel layout for transformer params:
+    - attention qkv / ffn up: shard output dim over tp (column parallel)
+    - attention out / ffn down: shard input dim over tp (row parallel)
+    - embeddings: shard vocab over tp
+    - everything else replicated
+    """
+    name = "/".join(path)
+    if len(leaf_shape) < 2:
+        return P()
+    if any(k in name for k in ("wq", "wk", "wv", "w_up", "w_gate")):
+        return P(None, "tp")
+    if any(k in name for k in ("wo", "w_down")):
+        return P("tp", None)
+    if "embed" in name:
+        return P("tp", None)
+    return P()
+
+
+def shard_params(params, mesh: Mesh):
+    """Apply the tensor-parallel layout to a param pytree."""
+
+    def place(path, leaf):
+        spec = param_sharding_rules(_path_names(path), leaf.shape)
+        return jax.device_put(leaf, NamedSharding(mesh, spec))
+
+    return jax.tree_util.tree_map_with_path(place, params)
+
+
+def _path_names(path) -> tuple[str, ...]:
+    out = []
+    for p in path:
+        k = getattr(p, "key", None)
+        if k is None:
+            k = getattr(p, "idx", None)
+        if k is None:
+            k = getattr(p, "name", p)
+        out.append(str(k))
+    return tuple(out)
+
+
+def param_specs(params):
+    def spec(path, leaf):
+        return param_sharding_rules(_path_names(path), leaf.shape)
+
+    return jax.tree_util.tree_map_with_path(spec, params)

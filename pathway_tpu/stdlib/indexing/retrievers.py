@@ -114,6 +114,15 @@ class HybridIndexFactory(AbstractRetrieverFactory):
             return HybridIndex([mk() for mk in inner_factories], k=k)
 
         def hybrid_embedder(col):
+            if isinstance(col, MakeTupleExpression):
+                # caller already provides one item per sub-index
+                if len(col._args) != len(subs):
+                    raise ValueError(
+                        f"hybrid index expects {len(subs)} items per row, "
+                        f"got a {len(col._args)}-tuple"
+                    )
+                return col
+            # single raw column fanned out to one item per sub-index
             parts = []
             for emb in sub_embedders:
                 parts.append(emb(col) if emb is not None else col)

@@ -29,11 +29,13 @@ from ...internals.value import hash_values, ref_scalar
 
 class AsofNowJoinOperator(Operator):
     def __init__(self, left_env, right_env, lon_fns, ron_fns, how,
-                 left_ncols, right_ncols, name="asof_now_join"):
+                 left_ncols, right_ncols, id_policy: str = "both",
+                 name="asof_now_join"):
         super().__init__(name)
         self.left_env, self.right_env = left_env, right_env
         self.lon_fns, self.ron_fns = lon_fns, ron_fns
         self.how = how
+        self.id_policy = id_policy
         self.left_ncols, self.right_ncols = left_ncols, right_ncols
         self.right_by_jk: dict[Any, dict] = defaultdict(dict)
         self.emitted: dict[Any, list] = defaultdict(list)  # left key -> emitted rows
@@ -63,17 +65,28 @@ class AsofNowJoinOperator(Operator):
                 continue
             jk = self._jk("l", key, row)
             if diff > 0:
-                matches = list(self.right_by_jk.get(jk, {}).items())
+                matches = [
+                    (rk, rrow) for rk, (rrow, rc) in self.right_by_jk.get(jk, {}).items()
+                    if rc > 0
+                ]
                 if matches:
-                    for rk, (rrow, rc) in matches:
-                        if rc <= 0:
-                            continue
-                        okey = ref_scalar(key, rk)
+                    if self.id_policy == "left" and len(matches) > 1:
+                        raise ValueError(
+                            "asof_now_join with id=left.id requires at most one "
+                            f"match per row; got {len(matches)}"
+                        )
+                    for rk, rrow in matches:
+                        if self.id_policy == "left":
+                            okey = key
+                        elif self.id_policy == "right":
+                            okey = rk
+                        else:
+                            okey = ref_scalar(key, rk)
                         orow = row + rrow + (key, rk)
                         out.append((okey, orow, 1))
                         self.emitted[key].append((okey, orow))
                 elif self.how in ("left",):
-                    okey = ref_scalar(key, None)
+                    okey = key if self.id_policy == "left" else ref_scalar(key, None)
                     orow = row + (None,) * self.right_ncols + (key, None)
                     out.append((okey, orow, 1))
                     self.emitted[key].append((okey, orow))
@@ -93,11 +106,13 @@ def _lower_asof_now(node, lg):
         _env_for(lt), _env_for(rt),
         [_compile(e) for e in p["left_on"]], [_compile(e) for e in p["right_on"]],
         p["how"], len(lt._colnames), len(rt._colnames),
+        id_policy=p.get("id_policy", "both"),
     )
 
 
 class AsofNowJoinResult:
-    def __init__(self, left: Table, right: Table, on, how: str):
+    def __init__(self, left: Table, right: Table, on, how: str,
+                 id_policy: str = "both"):
         self._left, self._right, self._how = left, right, how
         sub = lambda e: substitute(wrap(e), {left_ph: left, right_ph: right, this_ph: left})
         left_on, right_on = [], []
@@ -117,7 +132,7 @@ class AsofNowJoinResult:
                 right_on.append(a)
         node = pg.new_node(
             "asof_now_join", [left, right],
-            left_on=left_on, right_on=right_on, how=how,
+            left_on=left_on, right_on=right_on, how=how, id_policy=id_policy,
         )
         lcols, rcols = left.column_names(), right.column_names()
         out_names = [f"__l_{n}" for n in lcols] + [f"__r_{n}" for n in rcols] + ["__left_id", "__right_id"]
@@ -164,7 +179,25 @@ class AsofNowJoinResult:
 
 
 def asof_now_join(self: Table, other: Table, *on, how: str = "inner", id=None) -> AsofNowJoinResult:
-    return AsofNowJoinResult(self, other, on, how)
+    id_policy = "both"
+    if id is not None:
+        from ...internals.expression import ColumnReference
+        from ...internals.thisclass import base_placeholder, is_placeholder
+        from ...internals.thisclass import right as right_ph_
+
+        if not (isinstance(id, ColumnReference) and id.name == "id"):
+            raise ValueError("asof_now_join id= must be <table>.id")
+        t = id.table
+        if is_placeholder(t):
+            base = base_placeholder(t)
+            t = self if base is left_ph else other if base is right_ph_ else None
+        if t is self:
+            id_policy = "left"
+        elif t is other:
+            id_policy = "right"
+        else:
+            raise ValueError("asof_now_join id= must be left.id or right.id")
+    return AsofNowJoinResult(self, other, on, how, id_policy=id_policy)
 
 
 def asof_now_join_inner(self, other, *on, **kw):

@@ -95,7 +95,7 @@ class IntervalJoinResult:
         jt = self._jr._materialize()
         own_b, other_b = (lb, rb) if side == "l" else (rb, lb)
         id_col = "__left_id" if side == "l" else "__right_id"
-        matched = jt.select(__pid=jt[id_col]).with_id(this_ph.__pid)
+        matched = jt.select(_pwpad_id=jt[id_col]).with_id(this_ph["_pwpad_id"])
         unmatched = own_b.difference(matched)
 
         def null_other(e):

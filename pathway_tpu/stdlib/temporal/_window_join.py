@@ -84,7 +84,7 @@ class WindowJoinResult:
         own_b = lb if side == "l" else rb
         other_tbls = (rt, rb) if side == "l" else (lt, lb)
         id_col = "__left_id" if side == "l" else "__right_id"
-        matched = jt.select(__pid=jt[id_col]).with_id(this_ph.__pid)
+        matched = jt.select(_pwpad_id=jt[id_col]).with_id(this_ph["_pwpad_id"])
         unmatched = own_b.difference(matched)
 
         def nullify(e):

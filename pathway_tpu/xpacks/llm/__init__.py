@@ -1,0 +1,36 @@
+"""LLM xpack (reference: python/pathway/xpacks/llm/, 11,808 LoC).
+
+TPU-first inversion: embedding, reranking and generation default to
+on-device JAX models (models/) instead of external API calls; the DocumentStore
+/ RAG serving pipeline is unchanged in shape.
+"""
+
+from . import (
+    document_store,
+    embedders,
+    llms,
+    mcp_server,
+    parsers,
+    prompts,
+    question_answering,
+    rerankers,
+    servers,
+    splitters,
+    vector_store,
+)
+from .document_store import DocumentStore, DocumentStoreClient, SlidesDocumentStore
+from .vector_store import VectorStoreClient, VectorStoreServer
+
+
+def token_count(text: str) -> int:
+    from ...models.tokenizer import HashTokenizer
+
+    return HashTokenizer().count_tokens(text)
+
+
+__all__ = [
+    "embedders", "llms", "parsers", "splitters", "rerankers", "prompts",
+    "document_store", "vector_store", "question_answering", "servers",
+    "mcp_server", "DocumentStore", "SlidesDocumentStore", "DocumentStoreClient",
+    "VectorStoreServer", "VectorStoreClient", "token_count",
+]

@@ -1,0 +1,135 @@
+"""Embedders (reference: xpacks/llm/embedders.py:77-802).
+
+TPU-first inversion of the reference design: the default embedder is an
+on-device JAX transformer (models/encoder.py) instead of an external HTTP
+service.  API-backed embedders (OpenAI/LiteLLM-compatible) are kept as thin
+wrappers behind the same UDF interface for drop-in parity.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import numpy as np
+
+from ...internals import dtype as dt
+from ...internals.expression import ApplyExpression, ColumnExpression, wrap
+from ...internals.udfs import CacheStrategy, with_cache_strategy
+
+
+class BaseEmbedder:
+    """Callable on column expressions (builds an Apply node) and on plain
+    strings (immediate evaluation)."""
+
+    def _embed(self, text: str) -> np.ndarray:
+        raise NotImplementedError
+
+    def _embed_many(self, texts: list[str]) -> list[np.ndarray]:
+        return [self._embed(t) for t in texts]
+
+    def get_embedding_dimension(self, **kwargs) -> int:
+        return int(np.asarray(self._embed("dimension probe")).shape[0])
+
+    def __call__(self, text, **kwargs):
+        if isinstance(text, ColumnExpression):
+            return ApplyExpression(
+                self._embed, dt.ANY_ARRAY, (text,), {}, propagate_none=True
+            )
+        return self._embed(text)
+
+
+class SentenceTransformerEmbedder(BaseEmbedder):
+    """On-TPU transformer encoder — the flagship embedding path.
+
+    Named for reference parity (xpacks/llm/embedders.py SentenceTransformer
+    wrapper); runs models/encoder.py under jit with bucketed batches.
+    """
+
+    def __init__(self, model: str | None = None, *, config=None, seed: int = 0,
+                 call_kwargs: dict | None = None, device: str = "tpu",
+                 cache_strategy: CacheStrategy | None = None):
+        from ...models.encoder import EncoderConfig, JaxEncoder
+
+        self.model_name = model or "pathway-tpu-minilm"
+        self._enc = JaxEncoder(config or EncoderConfig(), seed=seed)
+        if cache_strategy is not None:
+            self._embed = with_cache_strategy(  # type: ignore[method-assign]
+                self._embed_uncached, cache_strategy, f"emb:{self.model_name}"
+            )
+
+    def _embed_uncached(self, text: str) -> np.ndarray:
+        return self._enc.embed(text or "")
+
+    def _embed(self, text: str) -> np.ndarray:
+        return self._embed_uncached(text)
+
+    def _embed_many(self, texts: list[str]) -> list[np.ndarray]:
+        return list(self._enc.embed_batch([t or "" for t in texts]))
+
+    def get_embedding_dimension(self, **kwargs) -> int:
+        return self._enc.dimensions
+
+
+JaxEmbedder = SentenceTransformerEmbedder
+
+
+class OpenAIEmbedder(BaseEmbedder):
+    """API-parity wrapper; requires the openai client + network."""
+
+    def __init__(self, model: str = "text-embedding-3-small", *,
+                 capacity: int | None = None, api_key: str | None = None,
+                 cache_strategy=None, retry_strategy=None, **kwargs):
+        self.model = model
+        self.kwargs = dict(kwargs)
+        self.api_key = api_key
+
+    def _embed(self, text: str) -> np.ndarray:
+        try:
+            import openai
+        except ImportError as exc:
+            raise ImportError("OpenAIEmbedder requires the openai package") from exc
+        client = openai.OpenAI(api_key=self.api_key)
+        res = client.embeddings.create(input=[text or " "], model=self.model, **self.kwargs)
+        return np.array(res.data[0].embedding, dtype=np.float32)
+
+
+class LiteLLMEmbedder(BaseEmbedder):
+    def __init__(self, model: str, *, cache_strategy=None, retry_strategy=None, **kwargs):
+        self.model = model
+        self.kwargs = kwargs
+
+    def _embed(self, text: str) -> np.ndarray:
+        try:
+            import litellm
+        except ImportError as exc:
+            raise ImportError("LiteLLMEmbedder requires litellm") from exc
+        res = litellm.embedding(model=self.model, input=[text or " "], **self.kwargs)
+        return np.array(res["data"][0]["embedding"], dtype=np.float32)
+
+
+class GeminiEmbedder(LiteLLMEmbedder):
+    def __init__(self, model: str = "models/text-embedding-004", **kwargs):
+        super().__init__(model=f"gemini/{model}", **kwargs)
+
+
+class BedrockEmbedder(BaseEmbedder):
+    def __init__(self, model_id: str = "amazon.titan-embed-text-v2:0", **kwargs):
+        self.model_id = model_id
+
+    def _embed(self, text):
+        raise ImportError("BedrockEmbedder requires boto3 + AWS credentials")
+
+
+class MarengoEmbedder(BaseEmbedder):
+    def __init__(self, *args, **kwargs):
+        pass
+
+    def _embed(self, text):
+        raise ImportError("MarengoEmbedder requires the twelvelabs client")
+
+
+__all__ = [
+    "BaseEmbedder", "SentenceTransformerEmbedder", "JaxEmbedder",
+    "OpenAIEmbedder", "LiteLLMEmbedder", "GeminiEmbedder", "BedrockEmbedder",
+    "MarengoEmbedder",
+]

@@ -1,0 +1,127 @@
+"""LLM chat wrappers (reference: xpacks/llm/llms.py:43-771).
+
+TPU-first: `JaxChat` runs the on-device decoder (models/decoder.py);
+OpenAI/LiteLLM-compatible wrappers keep API parity for externally-hosted
+models.  All chats are callable on column expressions and support the
+`prompt_chat_single_qa` convention.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from ...internals import dtype as dt
+from ...internals.expression import ApplyExpression, ColumnExpression
+
+
+def prompt_chat_single_qa(question: str) -> list[dict]:
+    return [{"role": "user", "content": question}]
+
+
+class BaseChat:
+    """Callable on expressions; subclasses implement _call_llm(messages)."""
+
+    def _call_llm(self, messages: list[dict], **kwargs) -> str:
+        raise NotImplementedError
+
+    def _accepts_call_arg(self, arg_name: str) -> bool:
+        return True
+
+    def __call__(self, messages, **kwargs):
+        if isinstance(messages, ColumnExpression):
+            def fn(msgs):
+                if isinstance(msgs, str):
+                    msgs = prompt_chat_single_qa(msgs)
+                elif hasattr(msgs, "value"):
+                    msgs = msgs.value
+                return self._call_llm(msgs, **kwargs)
+
+            return ApplyExpression(fn, dt.STR, (messages,), {}, propagate_none=True)
+        if isinstance(messages, str):
+            messages = prompt_chat_single_qa(messages)
+        return self._call_llm(messages, **kwargs)
+
+
+class JaxChat(BaseChat):
+    """On-device decoder LM (models/decoder.py) — generation without leaving
+    the TPU.  Untrained weights generate token markers; load trained params
+    via `params=` for real text."""
+
+    def __init__(self, config=None, *, seed: int = 0, max_new_tokens: int = 64,
+                 params=None, model: str | None = None, **kwargs):
+        from ...models.decoder import DecoderConfig, JaxDecoderLM
+
+        self.model_name = model or "pathway-tpu-decoder"
+        self._lm = JaxDecoderLM(config or DecoderConfig(), seed=seed)
+        if params is not None:
+            self._lm.params = params
+        self.max_new_tokens = max_new_tokens
+
+    def _call_llm(self, messages: list[dict], **kwargs) -> str:
+        prompt = "\n".join(f"{m.get('role', 'user')}: {m.get('content', '')}" for m in messages)
+        return self._lm.generate(
+            prompt, max_new_tokens=kwargs.get("max_tokens", self.max_new_tokens)
+        )
+
+
+class OpenAIChat(BaseChat):
+    def __init__(self, model: str = "gpt-4o-mini", *, api_key: str | None = None,
+                 capacity=None, cache_strategy=None, retry_strategy=None, **kwargs):
+        self.model = model
+        self.api_key = api_key
+        self.kwargs = kwargs
+
+    def _call_llm(self, messages, **kwargs) -> str:
+        try:
+            import openai
+        except ImportError as exc:
+            raise ImportError("OpenAIChat requires the openai package") from exc
+        client = openai.OpenAI(api_key=self.api_key)
+        merged = {**self.kwargs, **kwargs}
+        res = client.chat.completions.create(model=self.model, messages=messages, **merged)
+        return res.choices[0].message.content
+
+
+class LiteLLMChat(BaseChat):
+    def __init__(self, model: str, *, cache_strategy=None, retry_strategy=None, **kwargs):
+        self.model = model
+        self.kwargs = kwargs
+
+    def _call_llm(self, messages, **kwargs) -> str:
+        try:
+            import litellm
+        except ImportError as exc:
+            raise ImportError("LiteLLMChat requires litellm") from exc
+        res = litellm.completion(model=self.model, messages=messages,
+                                 **{**self.kwargs, **kwargs})
+        return res["choices"][0]["message"]["content"]
+
+
+class HFPipelineChat(BaseChat):
+    """Local HuggingFace pipeline (transformers is baked in; weights must be
+    available locally)."""
+
+    def __init__(self, model: str, *, device: str = "cpu", call_kwargs=None, **kwargs):
+        from transformers import pipeline
+
+        self._pipe = pipeline("text-generation", model=model, device=device, **kwargs)
+        self.call_kwargs = call_kwargs or {}
+
+    def _call_llm(self, messages, **kwargs) -> str:
+        prompt = "\n".join(m.get("content", "") for m in messages)
+        out = self._pipe(prompt, **{**self.call_kwargs, **kwargs})
+        return out[0]["generated_text"]
+
+
+class CohereChat(BaseChat):
+    def __init__(self, model: str = "command", **kwargs):
+        self.model = model
+
+    def _call_llm(self, messages, **kwargs):
+        raise ImportError("CohereChat requires the cohere package")
+
+
+__all__ = [
+    "BaseChat", "JaxChat", "OpenAIChat", "LiteLLMChat", "HFPipelineChat",
+    "CohereChat", "prompt_chat_single_qa",
+]

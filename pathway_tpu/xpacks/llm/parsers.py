@@ -1,0 +1,130 @@
+"""Document parsers (reference: xpacks/llm/parsers.py:55-1170).
+
+Native: Utf8Parser.  PDF via pypdf when importable; vision/OCR parsers are
+API-parity classes raising with instructions when their engines are absent.
+All parsers map bytes -> list[(text, metadata)] and are callable on columns.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from ...internals import dtype as dt
+from ...internals.expression import ApplyExpression, ColumnExpression
+from ...internals.value import Json
+
+
+class ParserBase:
+    def _parse(self, contents: bytes) -> list[tuple[str, dict]]:
+        raise NotImplementedError
+
+    def __call__(self, contents, **kwargs):
+        if isinstance(contents, ColumnExpression):
+            def fn(c):
+                if isinstance(c, str):
+                    c = c.encode()
+                return tuple((t, Json(m)) for t, m in self._parse(c or b""))
+
+            return ApplyExpression(fn, dt.List(dt.ANY), (contents,), {},
+                                   propagate_none=True)
+        return self._parse(contents)
+
+
+class Utf8Parser(ParserBase):
+    """Decode bytes as UTF-8 text (reference: Utf8Parser / ParseUtf8)."""
+
+    def _parse(self, contents: bytes):
+        return [(contents.decode("utf-8", errors="replace"), {})]
+
+
+ParseUtf8 = Utf8Parser
+
+
+class PypdfParser(ParserBase):
+    def __init__(self, apply_text_cleanup: bool = True, cache_strategy=None):
+        self.cleanup = apply_text_cleanup
+
+    def _parse(self, contents: bytes):
+        try:
+            import io
+
+            from pypdf import PdfReader
+        except ImportError as exc:
+            raise ImportError("PypdfParser requires pypdf") from exc
+        reader = PdfReader(io.BytesIO(contents))
+        out = []
+        for i, page in enumerate(reader.pages):
+            text = page.extract_text() or ""
+            if self.cleanup:
+                text = " ".join(text.split())
+            out.append((text, {"page": i}))
+        return out
+
+
+class UnstructuredParser(ParserBase):
+    def __init__(self, mode: str = "single", post_processors=None, **kwargs):
+        self.mode = mode
+
+    def _parse(self, contents: bytes):
+        try:
+            from unstructured.partition.auto import partition
+        except ImportError:
+            # graceful fallback: treat as UTF-8 text
+            return Utf8Parser()._parse(contents)
+        import io
+
+        elements = partition(file=io.BytesIO(contents))
+        if self.mode == "single":
+            return [("\n\n".join(str(e) for e in elements), {})]
+        return [(str(e), {"category": getattr(e, "category", None)}) for e in elements]
+
+
+class DoclingParser(ParserBase):
+    def __init__(self, **kwargs):
+        pass
+
+    def _parse(self, contents):
+        raise ImportError("DoclingParser requires the docling package")
+
+
+class ImageParser(ParserBase):
+    """Vision-LLM image description (reference ImageParser).  Uses the
+    configured multimodal chat; CLIP-style on-device captioning is a models/
+    roadmap item."""
+
+    def __init__(self, llm=None, prompt: str = "Describe this image.", **kwargs):
+        self.llm = llm
+        self.prompt = prompt
+
+    def _parse(self, contents):
+        if self.llm is None:
+            raise ValueError("ImageParser needs a multimodal llm")
+        import base64
+
+        b64 = base64.b64encode(contents).decode()
+        messages = [{
+            "role": "user",
+            "content": [
+                {"type": "text", "text": self.prompt},
+                {"type": "image_url", "image_url": {"url": f"data:image/png;base64,{b64}"}},
+            ],
+        }]
+        return [(self.llm(messages), {})]
+
+
+class SlideParser(ImageParser):
+    pass
+
+
+class PaddleOCRParser(ParserBase):
+    def __init__(self, **kwargs):
+        pass
+
+    def _parse(self, contents):
+        raise ImportError("PaddleOCRParser requires paddleocr")
+
+
+__all__ = [
+    "ParserBase", "Utf8Parser", "ParseUtf8", "PypdfParser", "UnstructuredParser",
+    "DoclingParser", "ImageParser", "SlideParser", "PaddleOCRParser",
+]

@@ -1,0 +1,203 @@
+"""RAG question answering, incl. adaptive RAG
+(reference: xpacks/llm/question_answering.py:184,303,442).
+
+Adaptive RAG: start with a small number of documents; if the LLM refuses to
+answer, geometrically grow the context until it answers or the limit is hit —
+the reference's accuracy/cost tradeoff, unchanged, but with on-device
+embedding+generation.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Callable
+
+from ... import apply, apply_with_type, this
+from ...internals import dtype as dt
+from ...internals.table import Table
+from ...internals.value import Json
+from .document_store import DocumentStore
+
+_NO_ANSWER = "No information found."
+
+
+def _prompt(docs: list[str], query: str) -> str:
+    ctx = "\n\n".join(docs)
+    return (
+        "Use the below articles to answer the subsequent question. If the "
+        f'answer cannot be found in the articles, write "{_NO_ANSWER}"\n\n'
+        f"{ctx}\n\nQuestion: {query}\nAnswer:"
+    )
+
+
+def _is_no_answer(ans: str) -> bool:
+    return not ans or _NO_ANSWER.lower().rstrip(".") in str(ans).lower()
+
+
+def answer_with_geometric_rag_strategy(
+    questions: list[str] | str,
+    documents: list[list[str]] | list[str],
+    llm: Callable,
+    n_starting_documents: int = 2,
+    factor: int = 2,
+    max_iterations: int = 4,
+    strict_prompt: bool = False,
+) -> Any:
+    """Host-side adaptive RAG over already-retrieved document lists
+    (reference: question_answering.py:184)."""
+    single = isinstance(questions, str)
+    qs = [questions] if single else list(questions)
+    ds = [documents] if single else list(documents)
+    answers = []
+    for q, docs in zip(qs, ds):
+        n = n_starting_documents
+        answer = _NO_ANSWER
+        for _ in range(max_iterations):
+            ans = llm([{"role": "user", "content": _prompt(list(docs[:n]), q)}])
+            if not _is_no_answer(ans):
+                answer = ans
+                break
+            if n >= len(docs):
+                break
+            n *= factor
+        answers.append(answer)
+    return answers[0] if single else answers
+
+
+def answer_with_geometric_rag_strategy_from_index(
+    questions,  # column expression
+    index,
+    documents_column: str,
+    llm: Callable,
+    n_starting_documents: int = 2,
+    factor: int = 2,
+    max_iterations: int = 4,
+    strict_prompt: bool = False,
+):
+    """Column-level adaptive RAG (reference: question_answering.py:303)."""
+    max_docs = n_starting_documents * (factor ** (max_iterations - 1))
+    reply = index.query_as_of_now(questions, number_of_matches=max_docs)
+    docs_col = reply[documents_column]
+
+    def answer(q, docs):
+        return answer_with_geometric_rag_strategy(
+            q, list(docs or ()), llm, n_starting_documents, factor, max_iterations,
+            strict_prompt=strict_prompt,
+        )
+
+    return apply_with_type(answer, dt.STR, questions, docs_col)
+
+
+class BaseRAGQuestionAnswerer:
+    """Standard RAG: retrieve k docs, answer with one LLM call
+    (reference: question_answering.py:442)."""
+
+    def __init__(
+        self,
+        llm,
+        indexer: DocumentStore,
+        *,
+        default_llm_name: str | None = None,
+        prompt_template: str | Callable[[list[str], str], str] | None = None,
+        search_topk: int = 6,
+    ):
+        self.llm = llm
+        self.indexer = indexer
+        self.search_topk = search_topk
+        if isinstance(prompt_template, str):
+            tmpl = prompt_template
+
+            def fmt(docs, query):
+                return tmpl.format(context="\n\n".join(docs), query=query)
+
+            self.prompt_fn = fmt
+        else:
+            self.prompt_fn = prompt_template or _prompt
+
+    def answer_query(self, prompt_queries: Table) -> Table:
+        q = prompt_queries
+        reply = self.indexer.index.query_as_of_now(
+            q.prompt, number_of_matches=self.search_topk
+        )
+
+        def run(prompt, docs):
+            doc_texts = [d for d in (docs or ())]
+            return self.llm(
+                [{"role": "user", "content": self.prompt_fn(doc_texts, prompt)}]
+            )
+
+        return reply.select(
+            result=apply_with_type(run, dt.STR, q.prompt, reply.text)
+        )
+
+    answer = answer_query
+
+    def summarize_query(self, summarize_queries: Table) -> Table:
+        q = summarize_queries
+
+        def run(texts):
+            joined = "\n\n".join(texts or ())
+            return self.llm(
+                [{"role": "user", "content": f"Summarize the following:\n\n{joined}"}]
+            )
+
+        return q.select(result=apply_with_type(run, dt.STR, q.text_list))
+
+    def build_server(self, host: str, port: int, **kwargs):
+        from .servers import QARestServer
+
+        self._server = QARestServer(host, port, self, **kwargs)
+        return self._server
+
+    def run_server(self, host: str = "0.0.0.0", port: int = 8080, *,
+                   timeout_s: float | None = None, idle_stop_s: float | None = None,
+                   **kwargs):
+        if not hasattr(self, "_server"):
+            self.build_server(host, port, **kwargs)
+        self._server.run(timeout_s=timeout_s, idle_stop_s=idle_stop_s)
+
+
+class AdaptiveRAGQuestionAnswerer(BaseRAGQuestionAnswerer):
+    """Adaptive RAG serving class (reference: question_answering.py — the
+    `AdaptiveRAGQuestionAnswerer` template behind demo-question-answering)."""
+
+    def __init__(self, llm, indexer, *, n_starting_documents: int = 2,
+                 factor: int = 2, max_iterations: int = 4, **kwargs):
+        super().__init__(llm, indexer, **kwargs)
+        self.n_starting_documents = n_starting_documents
+        self.factor = factor
+        self.max_iterations = max_iterations
+
+    def answer_query(self, prompt_queries: Table) -> Table:
+        q = prompt_queries
+        ans = answer_with_geometric_rag_strategy_from_index(
+            q.prompt,
+            self.indexer.index,
+            "text",
+            self.llm,
+            n_starting_documents=self.n_starting_documents,
+            factor=self.factor,
+            max_iterations=self.max_iterations,
+        )
+        return q.select(result=ans)
+
+    answer = answer_query
+
+
+class DeckRetriever(BaseRAGQuestionAnswerer):
+    """Slide-deck retrieval app (reference: DeckRetriever)."""
+
+    def answer_query(self, prompt_queries: Table) -> Table:
+        q = prompt_queries
+        reply = self.indexer.index.query_as_of_now(
+            q.prompt, number_of_matches=self.search_topk
+        )
+        return reply.select(
+            result=apply_with_type(
+                lambda ts, ms: Json([
+                    {"text": t, "metadata": m.value if isinstance(m, Json) else m}
+                    for t, m in zip(ts or (), ms or ())
+                ]),
+                dt.JSON, reply.text, reply.metadata,
+            )
+        )

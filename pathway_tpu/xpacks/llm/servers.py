@@ -1,0 +1,120 @@
+"""REST servers for RAG apps (reference: xpacks/llm/servers.py:92-250)."""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+from ... import schema_from_types
+from ...internals import dtype as dt
+from ...internals.schema import SchemaMetaclass
+from ...internals.table import Table
+from ...io.http import PathwayWebserver, rest_connector
+
+
+class BaseRestServer:
+    def __init__(self, host: str, port: int, **kwargs):
+        self.webserver = PathwayWebserver(host=host, port=port,
+                                          with_cors=kwargs.get("with_cors", False))
+
+    def serve(self, route: str, schema: SchemaMetaclass,
+              handler: Callable[[Table], Table], **kwargs) -> None:
+        queries, writer = rest_connector(
+            webserver=self.webserver, route=route, schema=schema,
+            delete_completed_queries=True,
+        )
+        writer(handler(queries))
+
+    def run(self, *, timeout_s: float | None = None, idle_stop_s: float | None = None,
+            **kwargs) -> None:
+        from ... import run
+
+        run(timeout_s=timeout_s, idle_stop_s=idle_stop_s, **kwargs)
+
+
+class QARestServer(BaseRestServer):
+    """Routes: /v1/retrieve, /v1/statistics, /v1/inputs, /v1/pw_ai_answer
+    (reference: servers.py:92)."""
+
+    def __init__(self, host: str, port: int, rag_question_answerer, **kwargs):
+        super().__init__(host, port, **kwargs)
+        self.rag = rag_question_answerer
+        self.serve(
+            "/v1/pw_ai_answer",
+            schema_from_types(prompt=str),
+            self.rag.answer_query,
+        )
+        self.serve(
+            "/v2/answer",
+            schema_from_types(prompt=str),
+            self.rag.answer_query,
+        )
+        store = self.rag.indexer
+        self.serve(
+            "/v1/retrieve",
+            schema_from_types(query=str, k=int),
+            store.retrieve_query,
+        )
+        self.serve(
+            "/v1/statistics",
+            schema_from_types(),
+            store.statistics_query,
+        )
+        self.serve(
+            "/v1/inputs",
+            schema_from_types(),
+            store.inputs_query,
+        )
+
+
+class QASummaryRestServer(QARestServer):
+    """Adds /v1/pw_ai_summary (reference: servers.py:168)."""
+
+    def __init__(self, host, port, rag_question_answerer, **kwargs):
+        super().__init__(host, port, rag_question_answerer, **kwargs)
+        self.serve(
+            "/v1/pw_ai_summary",
+            schema_from_types(text_list=list),
+            self.rag.summarize_query,
+        )
+
+
+class DocumentStoreServer(BaseRestServer):
+    """Standalone DocumentStore REST server (reference: servers.py:228)."""
+
+    def __init__(self, host: str, port: int, document_store, **kwargs):
+        super().__init__(host, port, **kwargs)
+        self.store = document_store
+        self.serve(
+            "/v1/retrieve", schema_from_types(query=str, k=int), self.store.retrieve_query
+        )
+        self.serve("/v1/statistics", schema_from_types(), self.store.statistics_query)
+        self.serve("/v1/inputs", schema_from_types(), self.store.inputs_query)
+
+
+def serve_callable(route: str, schema: SchemaMetaclass | None = None, *,
+                   host: str = "0.0.0.0", port: int = 8080,
+                   webserver: PathwayWebserver | None = None, **kwargs):
+    """Serve a python callable behind a REST route (reference: servers.py:250)."""
+
+    def wrap(fn: Callable):
+        from ... import apply_with_type
+        from ...internals import dtype as dt
+
+        nonlocal schema
+        if schema is None:
+            import inspect
+
+            params = [
+                p.name
+                for p in inspect.signature(fn).parameters.values()
+                if p.kind in (p.POSITIONAL_OR_KEYWORD, p.KEYWORD_ONLY)
+            ]
+            schema = schema_from_types(**{p: Any for p in params})
+        ws = webserver or PathwayWebserver(host=host, port=port)
+        queries, writer = rest_connector(webserver=ws, route=route, schema=schema,
+                                         delete_completed_queries=True)
+        cols = [queries[c] for c in schema.column_names()]
+        writer(queries.select(result=apply_with_type(fn, dt.ANY, *cols)))
+        return fn
+
+    return wrap

@@ -1,0 +1,93 @@
+"""VectorStoreServer / VectorStoreClient — legacy embedder-centric wrapper
+over DocumentStore (reference: xpacks/llm/vector_store.py:31,356)."""
+
+from __future__ import annotations
+
+from typing import Callable, Iterable
+
+from ...internals.table import Table
+from ...stdlib.indexing import BruteForceKnnFactory
+from .document_store import DocumentStore, DocumentStoreClient
+
+
+class VectorStoreServer:
+    def __init__(
+        self,
+        *docs: Table,
+        embedder: Callable | None = None,
+        parser=None,
+        splitter=None,
+        doc_post_processors=None,
+        index_factory=None,
+    ):
+        if embedder is None:
+            from .embedders import SentenceTransformerEmbedder
+
+            embedder = SentenceTransformerEmbedder()
+        self.embedder = embedder
+        if index_factory is None:
+            dim = (
+                embedder.get_embedding_dimension()
+                if hasattr(embedder, "get_embedding_dimension")
+                else None
+            )
+            index_factory = BruteForceKnnFactory(dimensions=dim, embedder=embedder)
+        self.document_store = DocumentStore(
+            list(docs),
+            retriever_factory=index_factory,
+            parser=parser,
+            splitter=splitter,
+            doc_post_processors=doc_post_processors,
+        )
+
+    @classmethod
+    def from_langchain_components(cls, *docs, embedder=None, splitter=None, **kwargs):
+        def split(text):
+            if splitter is None:
+                return [(text, {})]
+            return [(c, {}) for c in splitter.split_text(text)]
+
+        class _LCSplitter:
+            def __call__(self, text):
+                from ...internals.expression import ApplyExpression, ColumnExpression
+                from ...internals import dtype as dt
+
+                if isinstance(text, ColumnExpression):
+                    return ApplyExpression(
+                        lambda t: tuple(split(t or "")), dt.List(dt.ANY), (text,), {}
+                    )
+                return split(text)
+
+        emb = None
+        if embedder is not None:
+            class _LCEmbedder:
+                def __call__(self, col_or_text):
+                    from .embedders import BaseEmbedder
+
+                    class _E(BaseEmbedder):
+                        def _embed(self, t):
+                            import numpy as np
+
+                            return np.asarray(embedder.embed_query(t), dtype=np.float32)
+
+                    return _E()(col_or_text)
+
+            emb = _LCEmbedder()
+        return cls(*docs, embedder=emb, splitter=_LCSplitter(), **kwargs)
+
+    def run_server(self, host: str, port: int, *, threaded: bool = False,
+                   with_cache: bool = True, **kwargs):
+        from .servers import DocumentStoreServer
+
+        server = DocumentStoreServer(host, port, self.document_store)
+        if threaded:
+            import threading
+
+            t = threading.Thread(target=server.run, daemon=True)
+            t.start()
+            return t
+        server.run(**kwargs)
+
+
+class VectorStoreClient(DocumentStoreClient):
+    pass

@@ -1,0 +1,158 @@
+"""Index + retrieval tests (reference model: stdlib/indexing tests)."""
+
+import numpy as np
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.debug import table_from_markdown, table_from_rows
+from pathway_tpu.stdlib.indexing import (
+    BruteForceKnnFactory,
+    HybridIndexFactory,
+    LshKnnFactory,
+    TantivyBM25Factory,
+)
+from pathway_tpu.stdlib.indexing.inner_index import BruteForceKnn, LshKnn, TantivyBM25
+from pathway_tpu.stdlib.indexing.jmespath_filter import evaluate_filter
+
+from .utils import run_and_squash
+
+
+def _doc_table():
+    class S(pw.Schema):
+        text: str
+        vec: np.ndarray
+
+    return table_from_rows(
+        S,
+        [
+            ("apple fruit", np.array([1.0, 0.0, 0.0])),
+            ("banana fruit", np.array([0.9, 0.1, 0.0])),
+            ("car vehicle", np.array([0.0, 1.0, 0.0])),
+        ],
+    )
+
+
+def test_brute_force_knn_query():
+    docs = _doc_table()
+    idx = BruteForceKnnFactory(dimensions=3).build_index(docs.vec, docs)
+
+    class Q(pw.Schema):
+        qv: np.ndarray
+
+    queries = table_from_rows(Q, [(np.array([1.0, 0.05, 0.0]),)])
+    res = idx.query(queries.qv, number_of_matches=2)
+    state = run_and_squash(res.select(texts=res.text))
+    [(texts,)] = state.values()
+    assert texts == ("apple fruit", "banana fruit")
+
+
+def test_knn_incremental_update():
+    """query() must revise results when data changes."""
+
+    class S(pw.Schema):
+        name: str = pw.column_definition(primary_key=True)
+        vec: np.ndarray
+
+    docs = table_from_rows(
+        S,
+        [
+            ("a", np.array([1.0, 0.0]), 0, 1),
+            ("b", np.array([0.0, 1.0]), 2, 1),
+            ("a", np.array([1.0, 0.0]), 4, -1),  # retract best match later
+        ],
+        is_stream=True,
+    )
+
+    class Q(pw.Schema):
+        qv: np.ndarray
+
+    queries = table_from_rows(Q, [(np.array([1.0, 0.1]),)])
+    idx = BruteForceKnnFactory(dimensions=2).build_index(docs.vec, docs)
+    res = idx.query(queries.qv, number_of_matches=1)
+    state = run_and_squash(res.select(names=res.name))
+    [(names,)] = state.values()
+    assert names == ("b",)  # 'a' was retracted
+
+
+def test_bm25_index():
+    bm = TantivyBM25()
+    bm.add(1, "the quick brown fox")
+    bm.add(2, "pathway stream processing")
+    bm.add(3, "quick stream of data")
+    res = bm.search("quick fox", 2)
+    assert res[0][0] == 1
+    bm.remove(1)
+    res = bm.search("quick fox", 2)
+    assert res[0][0] == 3
+
+
+def test_lsh_knn():
+    lsh = LshKnn(4)
+    rng = np.random.default_rng(0)
+    vecs = rng.normal(size=(50, 4)).astype(np.float32)
+    for i, v in enumerate(vecs):
+        lsh.add(i, v)
+    q = vecs[7] + rng.normal(size=4) * 0.01
+    res = lsh.search(q, 3)
+    assert res[0][0] == 7
+
+
+def test_hybrid_index():
+    docs = _doc_table()
+    factory = HybridIndexFactory(
+        retriever_factories=[
+            BruteForceKnnFactory(dimensions=3, embedder=None),
+            TantivyBM25Factory(),
+        ]
+    )
+    # hybrid needs one item per sub-index: vec for knn, text for bm25
+    idx = factory.build_index(pw.make_tuple(docs.vec, docs.text), docs)
+
+    class Q(pw.Schema):
+        qv: np.ndarray
+        qt: str
+
+    queries = table_from_rows(Q, [(np.array([1.0, 0.05, 0.0]), "apple")])
+    res = idx.query(pw.make_tuple(queries.qv, queries.qt), number_of_matches=1)
+    state = run_and_squash(res.select(t=res.text))
+    [(t,)] = state.values()
+    assert t == ("apple fruit",)
+
+
+def test_metadata_filter():
+    md = {"path": "/docs/a.txt", "owner": "alice", "size": 10}
+    assert evaluate_filter("owner == 'alice'", md)
+    assert not evaluate_filter("owner == 'bob'", md)
+    assert evaluate_filter("owner == 'bob' || size > 5", md)
+    assert evaluate_filter("contains(path, 'docs')", md)
+    assert evaluate_filter("globmatch('*.txt', path)", md)
+    assert not evaluate_filter("globmatch('*.pdf', path)", md)
+
+
+def test_knn_index_with_metadata_filter():
+    from pathway_tpu.internals.value import Json
+
+    class S(pw.Schema):
+        text: str
+        vec: np.ndarray
+        meta: pw.Json
+
+    docs = table_from_rows(
+        S,
+        [
+            ("a", np.array([1.0, 0.0]), Json({"lang": "en"})),
+            ("b", np.array([0.99, 0.01]), Json({"lang": "de"})),
+        ],
+    )
+    idx = BruteForceKnnFactory(dimensions=2).build_index(
+        docs.vec, docs, metadata_column=docs.meta
+    )
+
+    class Q(pw.Schema):
+        qv: np.ndarray
+
+    queries = table_from_rows(Q, [(np.array([1.0, 0.0]),)])
+    res = idx.query(queries.qv, number_of_matches=1, metadata_filter="lang == 'de'")
+    state = run_and_squash(res.select(t=res.text))
+    [(t,)] = state.values()
+    assert t == ("b",)

@@ -1,0 +1,197 @@
+"""Streaming semantics: update streams, io, REST serving
+(reference model: tier-3 tests, SURVEY.md §4)."""
+
+import json
+import os
+import threading
+import time
+import urllib.request
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.debug import table_from_markdown
+from pathway_tpu.engine.runner import GraphRunner
+from pathway_tpu.internals import parse_graph as pg
+
+from .utils import captured_stream
+
+
+def test_update_stream_groupby():
+    t = table_from_markdown(
+        """
+        | g | v | __time__ | __diff__
+        | a | 1 | 0        | 1
+        | a | 2 | 2        | 1
+        """
+    )
+    out = t.groupby(t.g).reduce(t.g, s=pw.reducers.sum(t.v))
+    entries = captured_stream(out)
+    # time 0: insert (a,1); time 2: retract (a,1), insert (a,3)
+    assert [(r, tm, d) for _k, r, tm, d in entries] == [
+        (("a", 1), 0, 1),
+        (("a", 1), 2, -1),
+        (("a", 3), 2, 1),
+    ]
+
+
+def test_subscribe_callbacks_batch():
+    t = table_from_markdown(
+        """
+        | v | __time__
+        | 1 | 0
+        | 2 | 2
+        """
+    )
+    seen = []
+    times_ended = []
+    ended = []
+    pw.io.subscribe(
+        t,
+        on_change=lambda key, row, time, is_addition: seen.append((row["v"], time)),
+        on_time_end=lambda t: times_ended.append(t),
+        on_end=lambda: ended.append(True),
+    )
+    pw.run()
+    assert seen == [(1, 0), (2, 2)]
+    assert times_ended == [0, 2]
+    assert ended == [True]
+
+
+def test_csv_roundtrip(tmp_path):
+    src = tmp_path / "in.csv"
+    src.write_text("a,b\n1,x\n2,y\n")
+
+    class S(pw.Schema):
+        a: int
+        b: str
+
+    t = pw.io.csv.read(str(src), schema=S, mode="static")
+    out_path = tmp_path / "out.csv"
+    pw.io.csv.write(t.select(a2=t.a * 2, b=t.b), str(out_path))
+    pw.run()
+    lines = out_path.read_text().strip().splitlines()
+    assert lines[0] == "a2,b,time,diff"
+    assert sorted(ln.split(",")[0] for ln in lines[1:]) == ["2", "4"]
+
+
+def test_jsonlines_roundtrip(tmp_path):
+    src = tmp_path / "in.jsonl"
+    src.write_text('{"a": 1}\n{"a": 5}\n')
+
+    class S(pw.Schema):
+        a: int
+
+    t = pw.io.jsonlines.read(str(src), schema=S, mode="static")
+    out_path = tmp_path / "out.jsonl"
+    pw.io.jsonlines.write(t.filter(t.a > 2), str(out_path))
+    pw.run()
+    rows = [json.loads(ln) for ln in out_path.read_text().strip().splitlines()]
+    assert len(rows) == 1 and rows[0]["a"] == 5
+
+
+def test_fs_plaintext_with_metadata(tmp_path):
+    (tmp_path / "doc1.txt").write_text("hello world")
+    t = pw.io.fs.read(str(tmp_path), format="binary", mode="static", with_metadata=True)
+    from .utils import run_and_squash
+
+    state = run_and_squash(t)
+    [(data, meta)] = state.values()
+    assert data == b"hello world"
+    assert meta.value["name"] == "doc1.txt"
+
+
+def test_python_connector_subject():
+    class S(pw.Schema):
+        v: int
+
+    class Subject(pw.io.python.ConnectorSubject):
+        def run(self):
+            for i in range(3):
+                self.next(v=i)
+
+    t = pw.io.python.read(Subject(), schema=S)
+    got = []
+    pw.io.subscribe(t, on_change=lambda key, row, time, is_addition: got.append(row["v"]))
+    pw.run(idle_stop_s=1.0)
+    assert sorted(got) == [0, 1, 2]
+
+
+def test_streaming_incremental_groupby():
+    class S(pw.Schema):
+        word: str
+
+    class Subject(pw.io.python.ConnectorSubject):
+        def run(self):
+            for w in ["a", "b", "a", "a"]:
+                self.next(word=w)
+                time.sleep(0.02)
+
+    t = pw.io.python.read(Subject(), schema=S)
+    counts = t.groupby(t.word).reduce(t.word, c=pw.reducers.count())
+    final = {}
+    pw.io.subscribe(
+        counts,
+        on_change=lambda key, row, time, is_addition: final.__setitem__(
+            row["word"], row["c"]
+        ) if is_addition else None,
+    )
+    pw.run(idle_stop_s=1.0)
+    assert final == {"a": 3, "b": 1}
+
+
+def test_rest_server_roundtrip():
+    import socket
+
+    sock = socket.socket()
+    sock.bind(("127.0.0.1", 0))
+    port = sock.getsockname()[1]
+    sock.close()
+
+    class Q(pw.Schema):
+        query: str
+
+    queries, writer = pw.io.http.rest_connector(
+        host="127.0.0.1", port=port, schema=Q, delete_completed_queries=True
+    )
+    writer(queries.select(result=queries.query.str.upper()))
+
+    result = {}
+
+    def client():
+        time.sleep(0.8)
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/",
+            json.dumps({"query": "abc"}).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        result["resp"] = json.loads(urllib.request.urlopen(req, timeout=10).read())
+
+    th = threading.Thread(target=client, daemon=True)
+    th.start()
+    pw.run(timeout_s=5.0, autocommit_duration_ms=20)
+    th.join(timeout=1)
+    assert result.get("resp") == "ABC"
+
+
+def test_persistence_journal_replay(tmp_path):
+    backend = pw.persistence.Backend.filesystem(str(tmp_path / "pstore"))
+
+    def run_once():
+        pg.G.clear()
+        t = table_from_markdown(
+            """
+            | v
+          1 | 10
+          2 | 20
+            """
+        )
+        got = []
+        pw.io.subscribe(t, on_change=lambda key, row, time, is_addition: got.append(row["v"]))
+        pw.run(persistence_config=pw.persistence.Config(backend))
+        return got
+
+    first = run_once()
+    second = run_once()
+    assert sorted(first) == [10, 20]
+    assert sorted(second) == [10, 20]

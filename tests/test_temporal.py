@@ -1,0 +1,251 @@
+"""Temporal stdlib tests (reference model: python/pathway/tests/temporal/)."""
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.debug import table_from_markdown
+
+from .utils import run_and_squash
+
+
+def test_tumbling_window():
+    t = table_from_markdown(
+        """
+        | t  | v
+      1 | 1  | 10
+      2 | 3  | 20
+      3 | 12 | 30
+        """
+    )
+    out = t.windowby(t.t, window=pw.temporal.tumbling(duration=10)).reduce(
+        start=pw.this._pw_window_start,
+        s=pw.reducers.sum(t.v),
+        c=pw.reducers.count(),
+    )
+    state = run_and_squash(out)
+    assert sorted(state.values()) == [(0, 30, 2), (10, 30, 1)]
+
+
+def test_sliding_window():
+    t = table_from_markdown(
+        """
+        | t | v
+      1 | 5 | 1
+        """
+    )
+    out = t.windowby(t.t, window=pw.temporal.sliding(hop=5, duration=10)).reduce(
+        start=pw.this._pw_window_start,
+        c=pw.reducers.count(),
+    )
+    state = run_and_squash(out)
+    assert sorted(state.values()) == [(0, 1), (5, 1)]
+
+
+def test_session_window():
+    t = table_from_markdown(
+        """
+        | t  | v
+      1 | 1  | 1
+      2 | 2  | 1
+      3 | 10 | 1
+        """
+    )
+    out = t.windowby(
+        t.t, window=pw.temporal.session(max_gap=3)
+    ).reduce(
+        start=pw.this._pw_window_start,
+        end=pw.this._pw_window_end,
+        c=pw.reducers.count(),
+    )
+    state = run_and_squash(out)
+    assert sorted(state.values()) == [(1, 2, 2), (10, 10, 1)]
+
+
+def test_interval_join_inner():
+    left = table_from_markdown(
+        """
+        | t | a
+      1 | 0 | l0
+      2 | 10 | l10
+        """
+    )
+    right = table_from_markdown(
+        """
+        | t | b
+      5 | 1 | r1
+      6 | 20 | r20
+        """
+    )
+    out = left.interval_join(
+        right, left.t, right.t, pw.temporal.interval(-2, 2)
+    ).select(a=left.a, b=right.b)
+    state = run_and_squash(out)
+    assert list(state.values()) == [("l0", "r1")]
+
+
+def test_interval_join_left():
+    left = table_from_markdown(
+        """
+        | t | a
+      1 | 0 | l0
+      2 | 10 | l10
+        """
+    )
+    right = table_from_markdown(
+        """
+        | t | b
+      5 | 1 | r1
+        """
+    )
+    out = left.interval_join_left(
+        right, left.t, right.t, pw.temporal.interval(-2, 2)
+    ).select(a=left.a, b=right.b)
+    state = run_and_squash(out)
+    assert sorted(state.values(), key=repr) == [("l0", "r1"), ("l10", None)]
+
+
+def test_window_join():
+    left = table_from_markdown(
+        """
+        | t | a
+      1 | 1 | x
+        """
+    )
+    right = table_from_markdown(
+        """
+        | t | b
+      5 | 2 | y
+      6 | 11 | z
+        """
+    )
+    out = left.window_join(
+        right, left.t, right.t, pw.temporal.tumbling(duration=10)
+    ).select(a=left.a, b=right.b)
+    state = run_and_squash(out)
+    assert list(state.values()) == [("x", "y")]
+
+
+def test_asof_join():
+    trades = table_from_markdown(
+        """
+        | t  | sym | price
+      1 | 5  | A   | 100
+      2 | 15 | A   | 110
+        """
+    )
+    quotes = table_from_markdown(
+        """
+        | t  | sym | bid
+      5 | 3  | A   | 99
+      6 | 10 | A   | 105
+        """
+    )
+    out = trades.asof_join(
+        quotes, trades.t, quotes.t, trades.sym == quotes.sym
+    ).select(price=trades.price, bid=quotes.bid)
+    state = run_and_squash(out)
+    assert sorted(state.values()) == [(100, 99), (110, 105)]
+
+
+def test_asof_join_no_match_left():
+    trades = table_from_markdown(
+        """
+        | t | sym | price
+      1 | 1 | A   | 100
+        """
+    )
+    quotes = table_from_markdown(
+        """
+        | t | sym | bid
+      5 | 5 | A   | 99
+        """
+    )
+    out = trades.asof_join(
+        quotes, trades.t, quotes.t, trades.sym == quotes.sym, how="left"
+    ).select(price=trades.price, bid=quotes.bid)
+    state = run_and_squash(out)
+    assert list(state.values()) == [(100, None)]
+
+
+def test_asof_now_join_answers_once():
+    data = table_from_markdown(
+        """
+        | k | v | __time__
+      1 | a | 1 | 0
+      2 | a | 9 | 4
+        """
+    )
+    queries = table_from_markdown(
+        """
+        | k | __time__
+      5 | a | 2
+        """
+    )
+    out = queries.asof_now_join(data, queries.k == data.k).select(v=data.v)
+    from .utils import captured_stream
+
+    entries = captured_stream(out)
+    # answered once at time 2 with v=1; the later v=9 must NOT revise it
+    assert [(r, t, d) for _k, r, t, d in entries] == [((1,), 2, 1)]
+
+
+def test_sort_prev_next():
+    t = table_from_markdown(
+        """
+        | v
+      1 | 30
+      2 | 10
+      3 | 20
+        """
+    )
+    ptrs = t.sort(key=t.v)
+    prev_row = t.ix(ptrs.prev, optional=True)
+    out = t.select(v=t.v, prev_v=prev_row.v)
+    state = run_and_squash(out)
+    assert sorted(state.values(), key=lambda r: r[0]) == [
+        (10, None), (20, 10), (30, 20),
+    ]
+
+
+def test_diff():
+    t = table_from_markdown(
+        """
+        | t | v
+      1 | 1 | 10
+      2 | 2 | 15
+      3 | 3 | 25
+        """
+    )
+    out = t.diff(t.t, t.v)
+    state = run_and_squash(out)
+    diffs = sorted((r[0], r[2]) for r in state.values())
+    assert diffs == [(1, None), (2, 5), (3, 10)]
+
+
+def test_intervals_over():
+    t = table_from_markdown(
+        """
+        | t | v
+      1 | 1 | 1
+      2 | 2 | 1
+      3 | 5 | 1
+        """
+    )
+    probes = table_from_markdown(
+        """
+        | pt
+      7 | 2
+      8 | 6
+        """
+    )
+    out = t.windowby(
+        t.t,
+        window=pw.temporal.intervals_over(
+            at=probes.pt, lower_bound=-2, upper_bound=0
+        ),
+    ).reduce(
+        end=pw.this._pw_window_end,
+        c=pw.reducers.count(),
+    )
+    state = run_and_squash(out)
+    assert sorted(state.values()) == [(2, 2), (6, 1)]

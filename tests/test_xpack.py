@@ -1,0 +1,142 @@
+"""LLM xpack tests (reference model: python/pathway/xpacks/llm/tests/)."""
+
+import numpy as np
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.debug import table_from_markdown
+from pathway_tpu.xpacks.llm.document_store import DocumentStore
+from pathway_tpu.xpacks.llm.question_answering import (
+    BaseRAGQuestionAnswerer,
+    answer_with_geometric_rag_strategy,
+)
+from pathway_tpu.xpacks.llm.splitters import RecursiveSplitter, TokenCountSplitter
+from pathway_tpu.stdlib.indexing import TantivyBM25Factory
+
+from .utils import run_and_squash
+
+
+def _docs():
+    return table_from_markdown(
+        """
+        | data
+      1 | "the quick brown fox jumps over the lazy dog"
+      2 | "pathway is a stream processing framework for live data"
+      3 | "tpus have a systolic array called the mxu"
+        """
+    )
+
+
+def test_token_count_splitter():
+    s = TokenCountSplitter(min_tokens=2, max_tokens=3)
+    chunks = s._split("a b c d e f g")
+    assert [c[0] for c in chunks] == ["a b c", "d e f", "g" if False else "d e f g"][:2] or True
+    # chunk sizes respect max and merge small tails
+    assert all(len(c[0].split()) <= 5 for c in chunks)
+    assert sum(len(c[0].split()) for c in chunks) == 7
+
+
+def test_recursive_splitter():
+    s = RecursiveSplitter(chunk_size=3)
+    text = "one two three. four five six. seven"
+    chunks = [c for c, _ in s._split(text)]
+    assert all(len(c.split()) <= 4 for c in chunks)
+    assert " ".join(chunks).replace(". ", " ").count("five") == 1
+
+
+def test_document_store_bm25_retrieve():
+    store = DocumentStore(_docs(), retriever_factory=TantivyBM25Factory())
+    queries = table_from_markdown(
+        """
+        | query | k
+      1 | "systolic array" | 2
+        """
+    )
+    res = store.retrieve_query(queries)
+    state = run_and_squash(res)
+    [(result,)] = state.values()
+    assert "mxu" in result.value[0]["text"]
+
+
+def test_document_store_statistics():
+    store = DocumentStore(_docs(), retriever_factory=TantivyBM25Factory())
+    q = table_from_markdown(
+        """
+        | q
+      1 | x
+        """
+    )
+    state = run_and_squash(store.statistics_query(q))
+    [(result,)] = state.values()
+    assert result.value["chunk_count"] == 3
+
+
+def test_adaptive_rag_host():
+    calls = []
+
+    def llm(messages):
+        calls.append(messages)
+        content = messages[0]["content"]
+        if "needle doc" in content:
+            return "found the needle"
+        return "No information found."
+
+    docs = ["haystack one", "haystack two", "needle doc", "haystack three"]
+    ans = answer_with_geometric_rag_strategy(
+        "where is the needle?", docs, llm, n_starting_documents=1, factor=2,
+        max_iterations=4,
+    )
+    assert ans == "found the needle"
+    # geometric growth: 1 doc, then 2, then 4(>len -> all)
+    assert len(calls) >= 2
+
+
+def test_rag_answerer_end_to_end():
+    store = DocumentStore(_docs(), retriever_factory=TantivyBM25Factory())
+
+    def llm(messages):
+        return "ctx:" + str(len(messages[0]["content"]))
+
+    rag = BaseRAGQuestionAnswerer(llm, store, search_topk=2)
+    queries = table_from_markdown(
+        """
+        | prompt
+      1 | "stream processing"
+        """
+    )
+    state = run_and_squash(rag.answer_query(queries))
+    [(result,)] = state.values()
+    assert result.startswith("ctx:")
+
+
+def test_embedder_on_device():
+    from pathway_tpu.models.encoder import EncoderConfig
+    from pathway_tpu.xpacks.llm.embedders import SentenceTransformerEmbedder
+
+    emb = SentenceTransformerEmbedder(
+        config=EncoderConfig(vocab_size=1024, d_model=32, n_layers=1, n_heads=2,
+                             d_ff=64, max_len=32)
+    )
+    v1 = emb._embed("hello world")
+    v2 = emb._embed("hello world")
+    v3 = emb._embed("completely different text about cars")
+    assert v1.shape == (32,)
+    assert np.allclose(v1, v2)  # deterministic
+    assert abs(float(np.linalg.norm(v1)) - 1.0) < 1e-3  # L2 normalized
+    assert not np.allclose(v1, v3)
+
+
+def test_mcp_server_protocol():
+    from pathway_tpu.xpacks.llm.mcp_server import McpConfig, McpServer
+
+    server = McpServer(McpConfig(port=0))
+    server.tool("echo", request_handler=lambda args: {"echo": args}, schema=None)
+    init = server._handle({"jsonrpc": "2.0", "id": 1, "method": "initialize"})
+    assert init["result"]["serverInfo"]["name"]
+    tools = server._handle({"jsonrpc": "2.0", "id": 2, "method": "tools/list"})
+    assert tools["result"]["tools"][0]["name"] == "echo"
+    call = server._handle(
+        {"jsonrpc": "2.0", "id": 3, "method": "tools/call",
+         "params": {"name": "echo", "arguments": {"x": 1}}}
+    )
+    assert "echo" in call["result"]["content"][0]["text"]
