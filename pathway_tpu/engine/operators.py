@@ -59,15 +59,54 @@ class StatelessRowwise(Operator):
 
     Streams per-delta: f is deterministic, so a retraction maps to the
     retraction of the mapped row (reference: expression_table_deterministic,
-    dataflow.rs:1557).
+    dataflow.rs:1557).  Large homogeneous batches take the columnar
+    vectorized path (engine/vectorize.py).
     """
 
-    def __init__(self, env: EnvBuilder, exprs: list[Callable[[dict], Any]], name=""):
+    def __init__(self, env: EnvBuilder, exprs: list[Callable[[dict], Any]],
+                 raw_exprs=None, n_in_cols: int = 0, name=""):
         super().__init__(name)
         self.env = env
         self.exprs = exprs
+        self.n_in_cols = n_in_cols
+        self._plan = ...  # compiled lazily; None = unsupported
+        self._raw_exprs = raw_exprs
+
+    def _get_plan(self):
+        if self._plan is ...:
+            from . import vectorize
+
+            if self._raw_exprs is None:
+                self._plan = None
+            else:
+                self._plan = vectorize.compile_plan(self._raw_exprs, self.env.positions)
+        return self._plan
 
     def process(self, port, updates, time):
+        from .vectorize import VEC_THRESHOLD, try_columns
+
+        plan = self._get_plan() if len(updates) >= VEC_THRESHOLD else None
+        if plan is not None:
+            cols = try_columns(updates, self.n_in_cols, plan.used_columns)
+            if cols is not None:
+                import numpy as np
+
+                n = len(updates)
+                try:
+                    outs = plan(cols)
+                except Exception:
+                    outs = None  # fall back to per-row error poisoning
+                if outs is not None:
+                    out_lists = [
+                        o.tolist() if isinstance(o, np.ndarray) else [o] * n
+                        for o in outs
+                    ]
+                    rows = list(zip(*out_lists)) if out_lists else [()] * n
+                    self.emit(
+                        time,
+                        [(u[0], rows[i], u[2]) for i, u in enumerate(updates)],
+                    )
+                    return
         out: list[Update] = []
         build = self.env.build
         exprs = self.exprs
@@ -102,14 +141,43 @@ class StatefulRowwise(DiffOutputOperator):
 
 
 class StatelessFilter(Operator):
-    def __init__(self, env: EnvBuilder, predicate: Callable[[dict], Any], name=""):
+    def __init__(self, env: EnvBuilder, predicate: Callable[[dict], Any],
+                 raw_predicate=None, n_in_cols: int = 0, name=""):
         super().__init__(name)
         self.env = env
         self.predicate = predicate
+        self.n_in_cols = n_in_cols
+        self._raw = raw_predicate
+        self._plan = ...
+
+    def _get_plan(self):
+        if self._plan is ...:
+            from . import vectorize
+
+            if self._raw is None:
+                self._plan = None
+            else:
+                self._plan = vectorize.compile_plan([self._raw], self.env.positions)
+        return self._plan
 
     def process(self, port, updates, time):
         import numpy as np
 
+        from .vectorize import VEC_THRESHOLD, try_columns
+
+        plan = self._get_plan() if len(updates) >= VEC_THRESHOLD else None
+        if plan is not None:
+            cols = try_columns(updates, self.n_in_cols, plan.used_columns)
+            if cols is not None:
+                try:
+                    [mask] = plan(cols)
+                except Exception:
+                    mask = None
+                if mask is not None:
+                    mask = np.asarray(mask)
+                    if mask.dtype == bool:
+                        self.emit(time, [u for u, m in zip(updates, mask) if m])
+                        return
         out: list[Update] = []
         for key, row, diff in updates:
             v = self.predicate(self.env.build(key, row))

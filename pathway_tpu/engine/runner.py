@@ -85,13 +85,19 @@ def _make_operator(node: pg.OpNode, lg: LoweredGraph) -> Operator:
     if kind == "rowwise":
         exprs = [_compile(e) for e in p["exprs"]]
         if p.get("deterministic", True) and len(tables) == 1:
-            return ops.StatelessRowwise(_env_for(tables[0]), exprs, name="select")
+            return ops.StatelessRowwise(
+                _env_for(tables[0]), exprs, raw_exprs=p["exprs"],
+                n_in_cols=len(tables[0]._colnames), name="select",
+            )
         return ops.StatefulRowwise(len(tables), _env_multi(tables), exprs, name="select*")
 
     if kind == "filter":
         pred = _compile(p["predicate"])
         if p.get("deterministic", True) and len(tables) == 1:
-            return ops.StatelessFilter(_env_for(tables[0]), pred, name="filter")
+            return ops.StatelessFilter(
+                _env_for(tables[0]), pred, raw_predicate=p["predicate"],
+                n_in_cols=len(tables[0]._colnames), name="filter",
+            )
         return ops.StatefulFilter(len(tables), _env_multi(tables), pred, name="filter*")
 
     if kind == "reindex":

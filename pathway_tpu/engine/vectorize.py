@@ -1,0 +1,226 @@
+"""Vectorized (columnar) expression evaluation.
+
+The reference evaluates expressions batch-vectorized per AST node
+(src/engine/expression.rs Expressions::eval over whole batches,
+dataflow.rs:1572-1604).  Here the same idea lowers to numpy on host; the
+JAX/device lowering for very large batches plugs into the same compile_plan
+seam (ops/ kernels use it for dense index/embedding paths).
+
+Correctness contract vs the row interpreter:
+  - any arithmetic fault or unsupported value shape aborts the columnar
+    path and the batch re-runs through the row interpreter (which yields
+    per-row Error poisoning);
+  - integer expressions carry a static magnitude-bound analysis so int64
+    can never wrap (inputs are bounded at column-extraction time), keeping
+    results byte-identical to Python bignum semantics.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import numpy as np
+
+from ..internals import expression as E
+from ..internals.value import Error
+
+VEC_THRESHOLD = 32
+# per-column magnitude bound enforced at extraction time; 2**44 admits
+# millisecond epoch timestamps while keeping sums/products analyzable
+_INT_LEAF_BOUND = 2**44
+_INT_LEAF_EXP = 44
+_INT_SAFE_EXP = 62  # results must provably fit in int64
+
+
+class Unsupported(Exception):
+    pass
+
+
+class _Node:
+    __slots__ = ("fn", "kind", "exp")
+
+    def __init__(self, fn, kind: str, exp: int):
+        self.fn = fn
+        self.kind = kind  # "int" | "float" | "bool" | "str" | "any"
+        self.exp = exp  # log2 magnitude bound for ints (overflow analysis)
+
+
+def compile_plan(exprs, positions: dict[tuple[int, str], int]):
+    """Compile expressions to a columnar fn(cols) -> list of arrays/scalars.
+
+    Returns None when any expression shape is unsupported.
+    """
+    try:
+        nodes = [_compile(e, positions) for e in exprs]
+    except Unsupported:
+        return None
+
+    used: set[int] = set()
+    for e in exprs:
+        for ref in e._dependencies():
+            idx = positions.get((id(ref.table), ref._name))
+            if idx is not None:
+                used.add(idx)
+
+    def plan(cols: list[np.ndarray]):
+        # error-poisoning parity: arithmetic faults abort the columnar path;
+        # the caller falls back to the row interpreter
+        with np.errstate(divide="raise", invalid="raise", over="raise"):
+            return [n.fn(cols) for n in nodes]
+
+    plan.used_columns = used  # type: ignore[attr-defined]
+    return plan
+
+
+def _compile(e, positions) -> _Node:
+    if isinstance(e, E.ColumnReference):
+        if e._name == "id":
+            raise Unsupported("id column")
+        idx = positions.get((id(e._table), e._name))
+        if idx is None:
+            raise Unsupported("unknown column")
+        # column kind resolved at runtime by try_columns; assume numeric-int
+        # bound for the overflow analysis (strings get kind "any")
+        return _Node(lambda cols: cols[idx], "any", _INT_LEAF_EXP)
+    if isinstance(e, E.ConstExpression):
+        v = e._value
+        if isinstance(v, bool):
+            return _Node(lambda cols: v, "bool", 0)
+        if isinstance(v, int):
+            exp = max(v.bit_length(), 1)
+            if exp > 62:
+                raise Unsupported("large int const")
+            return _Node(lambda cols: v, "int", exp)
+        if isinstance(v, float):
+            return _Node(lambda cols: v, "float", 0)
+        if isinstance(v, str):
+            return _Node(lambda cols: v, "str", 0)
+        raise Unsupported("const type")
+    if isinstance(e, E.BinaryOpExpression):
+        n1 = _compile(e._left, positions)
+        n2 = _compile(e._right, positions)
+        op = e._op
+        fn = _VEC_BINOPS.get(op)
+        if fn is None:
+            raise Unsupported(op)
+        exp = _bound(op, n1, n2)
+        if exp > _INT_SAFE_EXP:
+            raise Unsupported("possible int64 overflow")
+        f1, f2 = n1.fn, n2.fn
+        kind = "bool" if op in _CMP_OPS else "any"
+        return _Node(lambda cols: fn(f1(cols), f2(cols)), kind, exp)
+    if isinstance(e, E.UnaryOpExpression):
+        n1 = _compile(e._expr, positions)
+        f1 = n1.fn
+        if e._op == "-":
+            return _Node(lambda cols: -f1(cols), n1.kind, n1.exp + 1)
+
+        def invert(cols):
+            a = np.asarray(f1(cols))
+            return ~a
+
+        return _Node(invert, n1.kind, n1.exp)
+    if isinstance(e, E.IfElseExpression):
+        nc = _compile(e._cond, positions)
+        nt = _compile(e._then, positions)
+        ne = _compile(e._else, positions)
+        fc, ft, fe = nc.fn, nt.fn, ne.fn
+        return _Node(
+            lambda cols: np.where(fc(cols), ft(cols), fe(cols)),
+            "any", max(nt.exp, ne.exp),
+        )
+    raise Unsupported(type(e).__name__)
+
+
+_CMP_OPS = {"==", "!=", "<", "<=", ">", ">="}
+
+
+def _bound(op: str, n1: _Node, n2: _Node) -> int:
+    if op in _CMP_OPS or op in ("&", "|", "^"):
+        return 0
+    if op in ("+", "-"):
+        return max(n1.exp, n2.exp) + 1
+    if op == "*":
+        return n1.exp + n2.exp
+    if op == "//":
+        return n1.exp
+    if op == "%":
+        return n2.exp
+    if op == "/":
+        return 0  # float result; errstate traps overflow/div0
+    if op == "**":
+        raise Unsupported("** not vectorized (unbounded int growth)")
+    return 63
+
+
+def _true_div(a, b):
+    return np.asarray(a, np.float64) / b
+
+
+_VEC_BINOPS = {
+    "+": lambda a, b: a + b,
+    "-": lambda a, b: a - b,
+    "*": lambda a, b: a * b,
+    "/": _true_div,
+    "//": lambda a, b: a // b,
+    "%": lambda a, b: a % b,
+    "==": lambda a, b: a == b,
+    "!=": lambda a, b: a != b,
+    "<": lambda a, b: a < b,
+    "<=": lambda a, b: a <= b,
+    ">": lambda a, b: a > b,
+    ">=": lambda a, b: a >= b,
+    "&": lambda a, b: a & b,
+    "|": lambda a, b: a | b,
+    "^": lambda a, b: a ^ b,
+}
+
+
+def try_columns(updates, ncols: int, used: set[int]):
+    """Extract used columns as homogeneous numpy arrays.
+
+    Returns None (forcing the row-interpreter path) when a column mixes
+    types, contains None/Error, or holds ints outside the overflow-safe
+    leaf bound.
+    """
+    n = len(updates)
+    cols: list = [None] * ncols
+    for ci in used:
+        kinds = set()
+        for _k, row, _d in updates:
+            v = row[ci]
+            if v is None or isinstance(v, Error):
+                return None
+            if isinstance(v, (bool, np.bool_)):
+                kinds.add("bool")
+            elif isinstance(v, (int, np.integer)):
+                kinds.add("int")
+            elif isinstance(v, (float, np.floating)):
+                kinds.add("float")
+            elif isinstance(v, str):
+                kinds.add("str")
+            else:
+                return None
+            if len(kinds) > 1:
+                return None
+        kind = kinds.pop() if kinds else "int"
+        if kind == "bool":
+            dt = np.bool_
+        elif kind == "int":
+            dt = np.int64
+        elif kind == "float":
+            dt = np.float64
+        else:
+            dt = object
+        try:
+            arr = np.empty(n, dt)
+            for i, (_k, row, _d) in enumerate(updates):
+                arr[i] = row[ci]
+            if kind == "int" and (
+                np.any(arr > _INT_LEAF_BOUND) or np.any(arr < -_INT_LEAF_BOUND)
+            ):
+                return None
+            cols[ci] = arr
+        except (TypeError, ValueError, OverflowError):
+            return None
+    return cols

@@ -67,17 +67,42 @@ def events_from_dicts(
     colnames = schema.column_names()
     dtypes = schema.dtypes()
     pk = schema.primary_key_columns()
+    dicts = list(dicts)
     events = []
+    if pk:
+        # primary-key keys must match pointer_from()-derived keys, so they
+        # always use the canonical ref_scalar hash
+        for d in dicts:
+            row = tuple(coerce_value(d.get(c), dtypes[c]) for c in colnames)
+            events.append((time, ref_scalar(*[d.get(c) for c in pk]), row, 1))
+        return events
+    # auto keys are content+position based and never recomputed elsewhere —
+    # batched through the native hashing tier when available
+    keys = _auto_keys(dicts, seed)
     for i, d in enumerate(dicts):
         row = tuple(coerce_value(d.get(c), dtypes[c]) for c in colnames)
-        if pk:
-            key = ref_scalar(*[d.get(c) for c in pk])
-        else:
-            key = ref_scalar(seed, i, tuple(sorted(d.items(), key=lambda kv: kv[0]))
-                             if all(isinstance(v, (str, int, float, bool, type(None))) for v in d.values())
-                             else i)
-        events.append((time, key, row, 1))
+        events.append((time, keys[i], row, 1))
     return events
+
+
+def _auto_keys(dicts: list[dict], seed: str) -> list:
+    from .. import native
+    from ..internals.value import Pointer
+
+    n = len(dicts)
+    if n == 0:
+        return []
+    payloads = [
+        repr(sorted(d.items(), key=lambda kv: str(kv[0]))) for d in dicts
+    ]
+    if native.available():
+        import numpy as np
+
+        hashed = native.hash_rows(
+            [np.arange(n, dtype=np.int64), [seed] * n, payloads]
+        )
+        return [Pointer(int(h)) for h in hashed]
+    return [ref_scalar(seed, i, payloads[i]) for i in range(n)]
 
 
 class FilePollingSource(DataSource):

@@ -1,0 +1,122 @@
+"""Ring attention: sequence-parallel exact attention over the device mesh.
+
+Long-context embedding/generation shards the sequence across devices
+(`sp` axis); K/V blocks rotate around the ring via ppermute while each
+device accumulates a numerically-stable streaming softmax for its local
+queries.  Collectives ride ICI; peak memory per device is O(T/n · T/n)
+per block instead of O(T²).
+
+This is net-new capability vs the reference (SURVEY.md §5 "long-context:
+absent — net-new for the on-device models").
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def _block_attn(q, k, v, mask):
+    """q: (B,Tq,H,D); k,v: (B,Tk,H,D); mask: (Tq,Tk) bool or None.
+    Returns (scores_max (B,H,Tq), exp_sum (B,H,Tq), out (B,Tq,H,D)) partials."""
+    d = q.shape[-1]
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(d)
+    if mask is not None:
+        s = jnp.where(mask[None, None, :, :], s, -jnp.inf)
+    m = jnp.max(s, axis=-1)  # (B,H,Tq)
+    # guard fully-masked rows
+    m_safe = jnp.where(jnp.isfinite(m), m, 0.0)
+    p = jnp.exp(s - m_safe[..., None])
+    p = jnp.where(jnp.isfinite(s), p, 0.0)
+    l = jnp.sum(p, axis=-1)  # (B,H,Tq)
+    o = jnp.einsum("bhqk,bkhd->bqhd", p, v)
+    return m_safe, l, o
+
+
+def ring_attention(q, k, v, axis_name: str, causal: bool = False):
+    """Sequence-sharded exact attention inside shard_map.
+
+    q,k,v: (B, T_local, H, D) — the T axis is sharded over `axis_name`.
+    Streaming log-sum-exp merge across ring steps keeps the result exact.
+    """
+    n = jax.lax.psum(1, axis_name)
+    my_idx = jax.lax.axis_index(axis_name)
+    B, Tl, H, D = q.shape
+
+    m0 = jnp.full((B, H, Tl), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((B, H, Tl), jnp.float32)
+    o0 = jnp.zeros((B, Tl, H, D), jnp.float32)
+    # shard_map vma typing: carries must be marked varying over the axis
+    if hasattr(jax.lax, "pcast"):
+        m0, l0, o0 = (
+            jax.lax.pcast(x, (axis_name,), to="varying") for x in (m0, l0, o0)
+        )
+    elif hasattr(jax.lax, "pvary"):  # older jax
+        m0, l0, o0 = (jax.lax.pvary(x, (axis_name,)) for x in (m0, l0, o0))
+
+    q_pos = my_idx * Tl + jnp.arange(Tl)
+
+    def step(carry, i):
+        k_cur, v_cur, m, l, o = carry
+        src_idx = (my_idx - i) % n  # which shard this block came from
+        if causal:
+            k_pos = src_idx * Tl + jnp.arange(Tl)
+            mask = q_pos[:, None] >= k_pos[None, :]
+        else:
+            mask = None
+        bm, bl, bo = _block_attn(q, k_cur, v_cur, mask)
+        bo32 = bo.astype(jnp.float32)
+        bm32 = bm.astype(jnp.float32)
+        bl32 = bl.astype(jnp.float32)
+        new_m = jnp.maximum(m, bm32)
+        # avoid NaNs from exp(-inf - -inf)
+        c_old = jnp.where(jnp.isfinite(m), jnp.exp(m - new_m), 0.0)
+        c_new = jnp.where(bl32 > 0, jnp.exp(bm32 - new_m), 0.0)
+        l_out = l * c_old + bl32 * c_new
+        o_out = (
+            o * c_old.transpose(0, 2, 1)[..., None]
+            + bo32 * c_new.transpose(0, 2, 1)[..., None]
+        )
+        perm = [(j, (j + 1) % n) for j in range(n)]
+        k_next = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_next = jax.lax.ppermute(v_cur, axis_name, perm)
+        return (k_next, v_next, new_m, l_out, o_out), None
+
+    (k_f, v_f, m, l, o), _ = jax.lax.scan(
+        step, (k, v, m0, l0, o0), jnp.arange(n)
+    )
+    denom = jnp.maximum(l, 1e-20).transpose(0, 2, 1)[..., None]
+    return (o / denom).astype(q.dtype)
+
+
+def make_ring_attention(mesh: Mesh, axis_name: str = "sp", causal: bool = False):
+    """shard_map-wrapped ring attention: takes globally-shaped (B,T,H,D)
+    arrays sharded on T and returns the same."""
+    spec = P(None, axis_name, None, None)
+
+    @functools.partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+    )
+    def fn(q, k, v):
+        return ring_attention(q, k, v, axis_name, causal=causal)
+
+    return fn
+
+
+def reference_attention(q, k, v, causal: bool = False):
+    """Single-device reference for testing."""
+    d = q.shape[-1]
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(d)
+    if causal:
+        T = q.shape[1]
+        mask = jnp.tril(jnp.ones((T, T), bool))
+        s = jnp.where(mask[None, None], s, -1e30)
+    p = jax.nn.softmax(s.astype(jnp.float32), axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v).astype(q.dtype)

@@ -1,0 +1,190 @@
+"""ctypes bindings for the native runtime tier (src/pw_native.cpp).
+
+Builds on first use with g++ (cached .so next to the source); falls back to
+pure-Python implementations when no compiler is available.  The native hash
+is the canonical row-key hash whenever the library is active — it must stay
+bit-stable across versions (persisted state depends on it).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+_HERE = os.path.dirname(__file__)
+_SRC = os.path.join(_HERE, "src", "pw_native.cpp")
+_SO = os.path.join(_HERE, "src", "libpw_native.so")
+
+_lib = None
+_lock = threading.Lock()
+_build_failed = False
+
+
+def _build() -> str | None:
+    if os.path.exists(_SO) and os.path.getmtime(_SO) >= os.path.getmtime(_SRC):
+        return _SO
+    try:
+        subprocess.run(
+            ["g++", "-O3", "-march=native", "-shared", "-fPIC", "-std=c++17",
+             _SRC, "-o", _SO],
+            check=True, capture_output=True, timeout=120,
+        )
+        return _SO
+    except Exception:
+        return None
+
+
+def get_lib():
+    global _lib, _build_failed
+    if _lib is not None or _build_failed:
+        return _lib
+    with _lock:
+        if _lib is not None or _build_failed:
+            return _lib
+        so = _build()
+        if so is None:
+            _build_failed = True
+            return None
+        lib = ctypes.CDLL(so)
+        lib.pw_hash128.argtypes = [
+            ctypes.c_char_p, ctypes.c_uint64, ctypes.c_uint64,
+            ctypes.POINTER(ctypes.c_uint64), ctypes.POINTER(ctypes.c_uint64),
+        ]
+        lib.pw_hash_rows.restype = None
+        lib.pw_hash_rows.argtypes = [
+            ctypes.c_uint64, ctypes.c_uint64,
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_void_p),
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_int64)),
+            ctypes.c_uint64,
+            ctypes.POINTER(ctypes.c_uint64), ctypes.POINTER(ctypes.c_uint64),
+        ]
+        lib.pw_consolidate.restype = ctypes.c_int64
+        lib.pw_consolidate.argtypes = [
+            ctypes.c_uint64,
+            ctypes.POINTER(ctypes.c_uint64), ctypes.POINTER(ctypes.c_uint64),
+            ctypes.POINTER(ctypes.c_uint64), ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
+        ]
+        _lib = lib
+    return _lib
+
+
+def available() -> bool:
+    return get_lib() is not None
+
+
+def hash128(data: bytes, seed: int = 0) -> int:
+    lib = get_lib()
+    if lib is None:
+        import hashlib
+
+        d = hashlib.blake2b(data, digest_size=16, salt=seed.to_bytes(8, "little")).digest()
+        return int.from_bytes(d, "little")
+    hi = ctypes.c_uint64()
+    lo = ctypes.c_uint64()
+    lib.pw_hash128(data, len(data), seed & 0xFFFFFFFFFFFFFFFF,
+                   ctypes.byref(hi), ctypes.byref(lo))
+    return (hi.value << 64) | lo.value
+
+
+def hash_rows(columns: list[np.ndarray | list], seed: int = 0) -> np.ndarray:
+    """Batch-hash rows from typed columns -> uint128 as (n,) object array of ints.
+
+    Columns: int64 arrays, float64 arrays, or lists of bytes/str.
+    """
+    n = len(columns[0]) if columns else 0
+    lib = get_lib()
+    out_hi = np.empty(n, np.uint64)
+    out_lo = np.empty(n, np.uint64)
+    if lib is None or n == 0:
+        from ..internals.value import hash_values
+
+        return np.array(
+            [hash_values(*[_py_col_val(c, i) for c in columns]) for i in range(n)],
+            dtype=object,
+        )
+    kinds = []
+    values = []
+    offsets = []
+    keepalive = []
+    for col in columns:
+        if isinstance(col, np.ndarray) and col.dtype == np.int64:
+            kinds.append(0)
+            c = np.ascontiguousarray(col)
+            keepalive.append(c)
+            values.append(c.ctypes.data_as(ctypes.c_void_p))
+            offsets.append(None)
+        elif isinstance(col, np.ndarray) and col.dtype == np.float64:
+            kinds.append(1)
+            c = np.ascontiguousarray(col)
+            keepalive.append(c)
+            values.append(c.ctypes.data_as(ctypes.c_void_p))
+            offsets.append(None)
+        else:
+            kinds.append(2)
+            bufs = [v.encode() if isinstance(v, str) else bytes(v) for v in col]
+            off = np.zeros(n + 1, np.int64)
+            for i, b in enumerate(bufs):
+                off[i + 1] = off[i] + len(b)
+            buf = b"".join(bufs)
+            cbuf = ctypes.create_string_buffer(buf, len(buf) or 1)
+            keepalive.extend([cbuf, off])
+            values.append(ctypes.cast(cbuf, ctypes.c_void_p))
+            offsets.append(off.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)))
+    k = len(columns)
+    kinds_arr = (ctypes.c_int32 * k)(*kinds)
+    values_arr = (ctypes.c_void_p * k)(*[v.value if isinstance(v, ctypes.c_void_p) else v for v in values])
+    OffPtr = ctypes.POINTER(ctypes.c_int64)
+    offsets_arr = (OffPtr * k)(*[o if o is not None else OffPtr() for o in offsets])
+    lib.pw_hash_rows(
+        n, k, kinds_arr,
+        ctypes.cast(values_arr, ctypes.POINTER(ctypes.c_void_p)),
+        offsets_arr, seed,
+        out_hi.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+        out_lo.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+    )
+    return np.array(
+        [(int(h) << 64) | int(l) for h, l in zip(out_hi, out_lo)], dtype=object
+    )
+
+
+def _py_col_val(col, i):
+    v = col[i]
+    if isinstance(v, np.generic):
+        return v.item()
+    return v
+
+
+def consolidate_hashed(key_hi: np.ndarray, key_lo: np.ndarray,
+                       row_tag: np.ndarray, diffs: np.ndarray):
+    """Returns (surviving first-occurrence indices, net diffs)."""
+    n = len(diffs)
+    lib = get_lib()
+    if lib is None:
+        acc: dict = {}
+        for i in range(n):
+            k = (int(key_hi[i]), int(key_lo[i]), int(row_tag[i]))
+            if k in acc:
+                acc[k][1] += int(diffs[i])
+            else:
+                acc[k] = [i, int(diffs[i])]
+        pairs = sorted((v for v in acc.values() if v[1] != 0), key=lambda p: p[0])
+        return (np.array([p[0] for p in pairs], np.int64),
+                np.array([p[1] for p in pairs], np.int64))
+    out_index = np.empty(n, np.int64)
+    out_diff = np.empty(n, np.int64)
+    m = lib.pw_consolidate(
+        n,
+        np.ascontiguousarray(key_hi, np.uint64).ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+        np.ascontiguousarray(key_lo, np.uint64).ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+        np.ascontiguousarray(row_tag, np.uint64).ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+        np.ascontiguousarray(diffs, np.int64).ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        out_index.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        out_diff.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+    )
+    return out_index[:m].copy(), out_diff[:m].copy()

@@ -1,0 +1,174 @@
+// pathway_tpu native runtime tier.
+//
+// The reference keeps its hot host-side paths in Rust (key hashing in
+// src/engine/value.rs, arrangement consolidation in differential dataflow);
+// here the equivalents are C++ behind a C ABI consumed via ctypes:
+//   - 128-bit stable key hashing, batched over columns
+//   - Z-set consolidation (sum diffs per key, drop zeros)
+// Deterministic across processes/restarts (persistence + multi-worker
+// exchange depend on it).
+
+#include <algorithm>
+#include <cstdint>
+#include <cstring>
+#include <unordered_map>
+#include <vector>
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// 128-bit hashing: two independently-seeded 64-bit mix lanes.
+// Each lane is a murmur3-style stream mixer with strong finalizer.
+// ---------------------------------------------------------------------------
+
+static inline uint64_t mix64(uint64_t k) {
+  k ^= k >> 33;
+  k *= 0xff51afd7ed558ccdULL;
+  k ^= k >> 33;
+  k *= 0xc4ceb9fe1a85ec53ULL;
+  k ^= k >> 33;
+  return k;
+}
+
+struct HashState {
+  uint64_t a, b;
+};
+
+static inline void hs_init(HashState* s, uint64_t seed) {
+  s->a = 0x9e3779b97f4a7c15ULL ^ seed;
+  s->b = 0xbf58476d1ce4e5b9ULL ^ (seed * 0x94d049bb133111ebULL + 1);
+}
+
+static inline void hs_update_u64(HashState* s, uint64_t v) {
+  s->a = mix64(s->a ^ v) * 0x2545f4914f6cdd1dULL;
+  s->b = mix64(s->b + v + 0x165667b19e3779f9ULL);
+}
+
+static inline void hs_update_bytes(HashState* s, const uint8_t* data, uint64_t len) {
+  uint64_t i = 0;
+  while (i + 8 <= len) {
+    uint64_t v;
+    std::memcpy(&v, data + i, 8);
+    hs_update_u64(s, v);
+    i += 8;
+  }
+  uint64_t tail = 0;
+  uint64_t rem = len - i;
+  if (rem) {
+    std::memcpy(&tail, data + i, rem);
+    hs_update_u64(s, tail ^ (rem << 56));
+  }
+  hs_update_u64(s, len ^ 0xa5a5a5a5a5a5a5a5ULL);
+}
+
+static inline void hs_final(HashState* s, uint64_t* hi, uint64_t* lo) {
+  *hi = mix64(s->a ^ (s->b >> 32));
+  *lo = mix64(s->b ^ (s->a << 17) ^ 0x27d4eb2f165667c5ULL);
+}
+
+// hash one byte buffer -> 128 bits
+void pw_hash128(const uint8_t* data, uint64_t len, uint64_t seed,
+                uint64_t* hi, uint64_t* lo) {
+  HashState s;
+  hs_init(&s, seed);
+  hs_update_bytes(&s, data, len);
+  hs_final(&s, hi, lo);
+}
+
+// Batch-hash n rows built from k columns.
+// Column kinds: 0 = int64 (values: int64[n]), 1 = float64 (float64[n]),
+// 2 = bytes (concatenated buffer + offsets int64[n+1]).
+// For each row: lanes absorb a per-column type tag then the value.
+void pw_hash_rows(uint64_t n, uint64_t k,
+                  const int32_t* kinds,
+                  const void** values,
+                  const int64_t** offsets,  // per column, only for kind 2
+                  uint64_t seed,
+                  uint64_t* out_hi, uint64_t* out_lo) {
+  for (uint64_t i = 0; i < n; ++i) {
+    HashState s;
+    hs_init(&s, seed);
+    for (uint64_t c = 0; c < k; ++c) {
+      hs_update_u64(&s, 0x1000 + (uint64_t)kinds[c]);
+      switch (kinds[c]) {
+        case 0: {
+          const int64_t* col = (const int64_t*)values[c];
+          hs_update_u64(&s, (uint64_t)col[i]);
+          break;
+        }
+        case 1: {
+          const double* col = (const double*)values[c];
+          uint64_t v;
+          std::memcpy(&v, &col[i], 8);
+          hs_update_u64(&s, v);
+          break;
+        }
+        case 2: {
+          const uint8_t* buf = (const uint8_t*)values[c];
+          const int64_t* off = offsets[c];
+          hs_update_bytes(&s, buf + off[i], (uint64_t)(off[i + 1] - off[i]));
+          break;
+        }
+      }
+    }
+    hs_final(&s, &out_hi[i], &out_lo[i]);
+  }
+}
+
+// ---------------------------------------------------------------------------
+// Z-set consolidation: sum diffs per (key_hi, key_lo, row_tag); write the
+// surviving entries' first-occurrence index and net diff.
+// Returns number of surviving entries.
+// ---------------------------------------------------------------------------
+
+struct K128 {
+  uint64_t hi, lo, tag;
+  bool operator==(const K128& o) const {
+    return hi == o.hi && lo == o.lo && tag == o.tag;
+  }
+};
+
+struct K128Hash {
+  size_t operator()(const K128& k) const {
+    return (size_t)mix64(k.hi ^ mix64(k.lo) ^ (k.tag * 0x9e3779b97f4a7c15ULL));
+  }
+};
+
+int64_t pw_consolidate(uint64_t n,
+                       const uint64_t* key_hi, const uint64_t* key_lo,
+                       const uint64_t* row_tag, const int64_t* diffs,
+                       int64_t* out_index, int64_t* out_diff) {
+  std::unordered_map<K128, std::pair<int64_t, int64_t>, K128Hash> acc;
+  acc.reserve(n * 2);
+  for (uint64_t i = 0; i < n; ++i) {
+    K128 k{key_hi[i], key_lo[i], row_tag[i]};
+    auto it = acc.find(k);
+    if (it == acc.end()) {
+      acc.emplace(k, std::make_pair((int64_t)i, diffs[i]));
+    } else {
+      it->second.second += diffs[i];
+    }
+  }
+  // preserve first-occurrence order
+  std::vector<std::pair<int64_t, int64_t>> entries;
+  entries.reserve(acc.size());
+  for (auto& kv : acc) {
+    if (kv.second.second != 0) entries.push_back(kv.second);
+  }
+  struct ByIndex {
+    bool operator()(const std::pair<int64_t, int64_t>& a,
+                    const std::pair<int64_t, int64_t>& b) const {
+      return a.first < b.first;
+    }
+  };
+  std::sort(entries.begin(), entries.end(), ByIndex());
+  int64_t m = 0;
+  for (auto& e : entries) {
+    out_index[m] = e.first;
+    out_diff[m] = e.second;
+    ++m;
+  }
+  return m;
+}
+
+}  // extern "C"
