@@ -149,6 +149,9 @@ from .stdlib.temporal import (  # noqa: E402
 )
 from .stdlib.utils.async_transformer import AsyncTransformer  # noqa: E402
 from .internals.iterate import iterate, iteration_limit  # noqa: E402
+from .engine import time_ops as _time_ops  # noqa: E402
+
+_time_ops.install_table_methods()
 from .internals.sql import sql  # noqa: E402
 from .internals.yaml_loader import load_yaml  # noqa: E402
 from .internals.config import set_license_key, set_monitoring_config  # noqa: E402

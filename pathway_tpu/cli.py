@@ -1,0 +1,103 @@
+"""CLI: process supervisor with elastic rescaling.
+
+Reference: python/pathway/cli.py (595 LoC) — `pathway spawn --threads N
+--processes M program...` launches the worker cluster; child exit codes
+10/12 request down/up-scaling and the supervisor respawns with 0.5x/2x
+processes (cli.py:21-25,211-374).
+
+Usage: python -m pathway_tpu spawn --threads 2 --processes 2 -- python app.py
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+import sys
+
+EXIT_CODE_DOWNSCALE = 10
+EXIT_CODE_UPSCALE = 12
+MAX_PROCESSES = 64
+
+
+def _spawn_once(program: list[str], threads: int, processes: int, first_port: int) -> int:
+    """Run the program as `processes` cooperating OS processes."""
+    env_base = dict(os.environ)
+    env_base["PATHWAY_THREADS"] = str(threads)
+    env_base["PATHWAY_PROCESSES"] = str(processes)
+    env_base["PATHWAY_FIRST_PORT"] = str(first_port)
+    if processes == 1:
+        env_base["PATHWAY_PROCESS_ID"] = "0"
+        return subprocess.call(program, env=env_base)
+    procs = []
+    for pid in range(processes):
+        env = dict(env_base)
+        env["PATHWAY_PROCESS_ID"] = str(pid)
+        procs.append(subprocess.Popen(program, env=env))
+    code = 0
+    for p in procs:
+        rc = p.wait()
+        if rc != 0:
+            code = rc
+    return code
+
+
+def spawn(program: list[str], *, threads: int = 1, processes: int = 1,
+          first_port: int = 10000, record: bool = False) -> int:
+    """Supervise the program; honor elastic-rescale exit codes."""
+    while True:
+        code = _spawn_once(program, threads, processes, first_port)
+        if code == EXIT_CODE_DOWNSCALE and processes > 1:
+            processes = max(1, processes // 2)
+            print(f"[pathway-tpu] downscaling to {processes} processes", file=sys.stderr)
+            continue
+        if code == EXIT_CODE_UPSCALE and processes < MAX_PROCESSES:
+            processes = min(MAX_PROCESSES, processes * 2)
+            print(f"[pathway-tpu] upscaling to {processes} processes", file=sys.stderr)
+            continue
+        return code
+
+
+def spawn_from_env() -> int:
+    program = os.environ.get("PATHWAY_SPAWN_PROGRAM")
+    if not program:
+        print("PATHWAY_SPAWN_PROGRAM not set", file=sys.stderr)
+        return 2
+    args = os.environ.get("PATHWAY_SPAWN_ARGS", "").split()
+    return spawn(
+        [program, *args],
+        threads=int(os.environ.get("PATHWAY_THREADS", "1")),
+        processes=int(os.environ.get("PATHWAY_PROCESSES", "1")),
+        first_port=int(os.environ.get("PATHWAY_FIRST_PORT", "10000")),
+    )
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(prog="pathway-tpu")
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    sp = sub.add_parser("spawn", help="launch a program under the worker supervisor")
+    sp.add_argument("--threads", "-t", type=int, default=1)
+    sp.add_argument("--processes", "-n", type=int, default=1)
+    sp.add_argument("--first-port", type=int, default=10000)
+    sp.add_argument("--record", action="store_true")
+    sp.add_argument("program", nargs=argparse.REMAINDER)
+
+    sub.add_parser("spawn-from-env", help="spawn using PATHWAY_SPAWN_PROGRAM env")
+
+    args = parser.parse_args(argv)
+    if args.command == "spawn":
+        program = args.program
+        if program and program[0] == "--":
+            program = program[1:]
+        if not program:
+            parser.error("no program given")
+        return spawn(program, threads=args.threads, processes=args.processes,
+                     first_port=args.first_port, record=args.record)
+    if args.command == "spawn-from-env":
+        return spawn_from_env()
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

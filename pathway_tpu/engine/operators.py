@@ -98,7 +98,8 @@ class StatelessRowwise(Operator):
                     outs = None  # fall back to per-row error poisoning
                 if outs is not None:
                     out_lists = [
-                        o.tolist() if isinstance(o, np.ndarray) else [o] * n
+                        o.tolist() if isinstance(o, np.ndarray) and o.ndim == 1
+                        else [o.item() if isinstance(o, np.ndarray) else o] * n
                         for o in outs
                     ]
                     rows = list(zip(*out_lists)) if out_lists else [()] * n
@@ -175,7 +176,9 @@ class StatelessFilter(Operator):
                     mask = None
                 if mask is not None:
                     mask = np.asarray(mask)
-                    if mask.dtype == bool:
+                    if mask.ndim == 0:
+                        mask = np.broadcast_to(mask, (len(updates),))
+                    if mask.dtype == bool and mask.shape == (len(updates),):
                         self.emit(time, [u for u, m in zip(updates, mask) if m])
                         return
         out: list[Update] = []
@@ -665,7 +668,13 @@ class DeduplicateOperator(Operator):
 
 class OutputOperator(Operator):
     """Terminal sink: consolidates per time and invokes a callback
-    (reference: output_table/subscribe_table, dataflow.rs:4405,4510)."""
+    (reference: output_table/subscribe_table, dataflow.rs:4405,4510).
+
+    With terminate_on_error set, an Error value reaching the sink aborts the
+    run (reference: terminate_on_error flag; handled errors never reach
+    sinks because fill_error replaced them upstream)."""
+
+    terminate_on_error = False
 
     def __init__(
         self,
@@ -686,6 +695,13 @@ class OutputOperator(Operator):
             batch = consolidate(self._buffer)
             self._buffer = []
             if batch:
+                if self.terminate_on_error:
+                    for _k, row, _d in batch:
+                        if any(isinstance(v, Error) for v in row):
+                            raise RuntimeError(
+                                "Error value reached an output (terminate_on_error "
+                                "is set); use pw.fill_error to handle it"
+                            )
                 self._on_time(time, batch)
 
     def on_end(self):

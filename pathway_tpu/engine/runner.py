@@ -229,8 +229,14 @@ def register_lowering(kind: str):
 
 
 class GraphRunner:
-    def __init__(self, sinks: list[pg.OpNode]):
+    def __init__(self, sinks: list[pg.OpNode], terminate_on_error: bool = False):
         self.lg = lower(sinks)
+        if terminate_on_error:
+            from . import operators as _o
+
+            for op in self.lg.scheduler.operators:
+                if isinstance(op, _o.OutputOperator):
+                    op.terminate_on_error = True
 
     def run_batch(self) -> dict[int, CapturedStream]:
         """Feed all static events, process times in order, finish."""

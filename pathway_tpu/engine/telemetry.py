@@ -1,0 +1,121 @@
+"""Telemetry: Prometheus /metrics endpoint + run traces.
+
+Reference: src/engine/telemetry.rs (OTLP push, :296,601) and
+src/engine/http_server.rs (hyper /metrics on port 20000).  Here a stdlib
+HTTP server serves per-operator counters from the live scheduler; OTel
+export is gated on the opentelemetry package being present.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+METRICS_PORT = 20000
+
+
+class MetricsServer:
+    def __init__(self, scheduler, port: int = METRICS_PORT):
+        self.scheduler = scheduler
+        self.port = port
+        self._server: ThreadingHTTPServer | None = None
+        self.started_at = time.time()
+
+    def render(self) -> str:
+        lines = [
+            "# TYPE pathway_frontier gauge",
+            f"pathway_frontier {self.scheduler.frontier}",
+            "# TYPE pathway_uptime_seconds gauge",
+            f"pathway_uptime_seconds {time.time() - self.started_at:.1f}",
+            "# TYPE pathway_operator_rows_total counter",
+        ]
+        for op in self.scheduler.operators:
+            labels = f'operator="{op.name}",id="{op.id}"'
+            lines.append(f"pathway_operator_rows_total{{{labels},direction=\"in\"}} {op.rows_in}")
+            lines.append(f"pathway_operator_rows_total{{{labels},direction=\"out\"}} {op.rows_out}")
+        return "\n".join(lines) + "\n"
+
+    def start(self) -> None:
+        if self._server is not None:
+            return
+        render = self.render
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):
+                pass
+
+            def do_GET(self):
+                if self.path != "/metrics":
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                body = render().encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "text/plain; version=0.0.4")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        try:
+            self._server = ThreadingHTTPServer(("0.0.0.0", self.port), Handler)
+        except OSError:
+            return  # port taken (another run) — metrics disabled, run continues
+        threading.Thread(target=self._server.serve_forever, daemon=True).start()
+
+    def stop(self) -> None:
+        if self._server is not None:
+            self._server.shutdown()
+            self._server = None
+
+
+class ProgressReporter:
+    """Periodic console summaries (reference: src/engine/progress_reporter.rs)."""
+
+    def __init__(self, scheduler, interval_s: float = 10.0):
+        self.scheduler = scheduler
+        self.interval_s = interval_s
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def start(self) -> None:
+        def loop():
+            while not self._stop.wait(self.interval_s):
+                total_in = sum(op.rows_in for op in self.scheduler.operators)
+                total_out = sum(op.rows_out for op in self.scheduler.operators)
+                print(
+                    f"[pathway-tpu] frontier={self.scheduler.frontier} "
+                    f"rows_in={total_in} rows_out={total_out} "
+                    f"operators={len(self.scheduler.operators)}"
+                )
+
+        self._thread = threading.Thread(target=loop, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+
+class ErrorLog:
+    """Collects Value::Error provenance (reference: Graph::error_log,
+    src/engine/graph.rs:977; pw.global_error_log)."""
+
+    def __init__(self) -> None:
+        self.entries: list[dict] = []
+        self._lock = threading.Lock()
+        self.limit = 10_000
+
+    def record(self, message: str, operator: str = "", trace: str = "") -> None:
+        with self._lock:
+            if len(self.entries) < self.limit:
+                self.entries.append(
+                    {"message": message, "operator": operator, "trace": trace,
+                     "ts": time.time()}
+                )
+
+    def clear(self) -> None:
+        with self._lock:
+            self.entries.clear()
+
+
+global_error_log = ErrorLog()

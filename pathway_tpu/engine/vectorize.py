@@ -205,13 +205,15 @@ def try_columns(updates, ncols: int, used: set[int]):
                 return None
         kind = kinds.pop() if kinds else "int"
         if kind == "bool":
-            dt = np.bool_
-        elif kind == "int":
+            # numpy bool arithmetic (True+True -> True) diverges from Python
+            # int semantics; bool columns stay on the row interpreter
+            return None
+        if kind == "int":
             dt = np.int64
         elif kind == "float":
             dt = np.float64
         else:
-            dt = object
+            dt = object  # strings
         try:
             arr = np.empty(n, dt)
             for i, (_k, row, _d) in enumerate(updates):

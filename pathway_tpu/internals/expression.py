@@ -270,6 +270,15 @@ def _is_err(v: Any) -> bool:
     return isinstance(v, Error)
 
 
+def _record_error(exc: Exception, where: str) -> None:
+    try:
+        from ..engine.telemetry import global_error_log
+
+        global_error_log.record(f"{type(exc).__name__}: {exc}", operator=where)
+    except Exception:
+        pass
+
+
 def _true_div(a, b):
     if isinstance(a, int) and isinstance(b, int) and not isinstance(a, bool) and not isinstance(b, bool):
         if b == 0:
@@ -324,7 +333,8 @@ class BinaryOpExpression(ColumnExpression):
             if isinstance(res, np.generic):
                 res = res.item()
             return res
-        except Exception:
+        except Exception as exc:
+            _record_error(exc, self._op)
             return ERROR
 
     def __repr__(self):
@@ -483,7 +493,8 @@ class ApplyExpression(ColumnExpression):
             kwargs[k] = v
         try:
             return self._fun(*args, **kwargs)
-        except Exception:
+        except Exception as exc:
+            _record_error(exc, getattr(self._fun, "__name__", "apply"))
             return ERROR
 
 

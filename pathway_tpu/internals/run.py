@@ -29,19 +29,50 @@ def run(
     sinks = list(pg.G.outputs)
     if not sinks:
         return
-    runner = GraphRunner(sinks)
+    from ..engine.telemetry import global_error_log
+
+    global_error_log.clear()
+    runner = GraphRunner(sinks, terminate_on_error=terminate_on_error)
     if persistence_config is not None:
         from ..persistence import attach_persistence
 
         attach_persistence(runner, persistence_config)
-    if has_live_sources(sinks):
-        runner.run_streaming(
-            autocommit_ms=autocommit_duration_ms,
-            timeout_s=timeout_s,
-            idle_stop_s=idle_stop_s,
+
+    metrics = reporter = None
+    if with_http_server:
+        from ..engine.telemetry import MetricsServer
+
+        metrics = MetricsServer(runner.lg.scheduler)
+        metrics.start()
+    from ..internals.monitoring import MonitoringLevel
+
+    if monitoring_level not in (None, MonitoringLevel.NONE):
+        from ..engine.telemetry import ProgressReporter
+
+        reporter = ProgressReporter(runner.lg.scheduler)
+        reporter.start()
+    try:
+        if has_live_sources(sinks):
+            runner.run_streaming(
+                autocommit_ms=autocommit_duration_ms,
+                timeout_s=timeout_s,
+                idle_stop_s=idle_stop_s,
+            )
+        else:
+            runner.run_batch()
+    finally:
+        if reporter is not None:
+            reporter.stop()
+        if metrics is not None:
+            metrics.stop()
+    if global_error_log.entries:
+        first = global_error_log.entries[0]
+        import logging
+
+        logging.getLogger("pathway_tpu").warning(
+            "%d expression error(s) during run; first: %s (%s)",
+            len(global_error_log.entries), first["message"], first["operator"],
         )
-    else:
-        runner.run_batch()
 
 
 def run_all(**kwargs: Any) -> None:

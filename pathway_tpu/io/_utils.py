@@ -62,12 +62,15 @@ def make_input_table(
 
 
 def events_from_dicts(
-    dicts: Iterable[dict], schema: SchemaMetaclass, time: int = 0, seed: str = "io"
+    dicts: Iterable[dict], schema: SchemaMetaclass, time: int = 0, seed: str = "io",
+    start_index: int = 0,
 ) -> list:
+    """Build input events for dicts[start_index:]; auto keys incorporate the
+    *global* row index so keys are stable across resumed reads."""
     colnames = schema.column_names()
     dtypes = schema.dtypes()
     pk = schema.primary_key_columns()
-    dicts = list(dicts)
+    dicts = list(dicts)[start_index:]
     events = []
     if pk:
         # primary-key keys must match pointer_from()-derived keys, so they
@@ -78,37 +81,41 @@ def events_from_dicts(
         return events
     # auto keys are content+position based and never recomputed elsewhere —
     # batched through the native hashing tier when available
-    keys = _auto_keys(dicts, seed)
+    keys = _auto_keys(dicts, seed, start_index)
     for i, d in enumerate(dicts):
         row = tuple(coerce_value(d.get(c), dtypes[c]) for c in colnames)
         events.append((time, keys[i], row, 1))
     return events
 
 
-def _auto_keys(dicts: list[dict], seed: str) -> list:
+def _auto_keys(dicts: list[dict], seed: str, start_index: int = 0) -> list:
     from .. import native
     from ..internals.value import Pointer
 
     n = len(dicts)
     if n == 0:
         return []
+    import numpy as np
+
     payloads = [
         repr(sorted(d.items(), key=lambda kv: str(kv[0]))) for d in dicts
     ]
-    if native.available():
-        import numpy as np
-
-        hashed = native.hash_rows(
-            [np.arange(n, dtype=np.int64), [seed] * n, payloads]
-        )
-        return [Pointer(int(h)) for h in hashed]
-    return [ref_scalar(seed, i, payloads[i]) for i in range(n)]
+    # native and pure-Python hash_rows are bit-identical, so keys are stable
+    # regardless of whether the compiled library is present
+    hashed = native.hash_rows(
+        [np.arange(start_index, start_index + n, dtype=np.int64),
+         [seed] * n, payloads]
+    )
+    return [Pointer(int(h)) for h in hashed]
 
 
 class FilePollingSource(DataSource):
-    """Streaming-mode file source: re-scan the path, emit new rows.
+    """Streaming-mode file source: re-scan the path, emit only new rows.
 
-    Reference: src/connectors/scanner/filesystem.rs + polling.rs.
+    Reference: src/connectors/scanner/filesystem.rs + polling.rs.  Files are
+    treated as append-only: per-file row offsets track what was already
+    emitted (the reference's OffsetAntichain equivalent), and they persist
+    through the persistence layer for exactly-once resume.
     """
 
     append_only = True
@@ -121,11 +128,19 @@ class FilePollingSource(DataSource):
         self.schema = schema
         self.poll_interval_s = poll_interval_s
         self._seen: dict[str, float] = {}
-        self._emitted = 0
+        self._progress: dict[str, int] = {}  # file -> rows already emitted
         self._last_poll = 0.0
 
     def is_live(self) -> bool:
         return True
+
+    # -- offset frontier (persistence) ------------------------------------
+    def get_offsets(self) -> dict:
+        return dict(self._progress)
+
+    def seek(self, offsets: dict) -> None:
+        self._progress = dict(offsets)
+        self._seen = {}
 
     def _files(self) -> list[str]:
         if os.path.isdir(self.path):
@@ -153,8 +168,14 @@ class FilePollingSource(DataSource):
                 dicts = self.parse_file(f)
             except Exception:
                 continue
-            for e in events_from_dicts(dicts, self.schema, seed=f):
-                events.append(e)
+            start = self._progress.get(f, 0)
+            if len(dicts) <= start:
+                continue
+            new = events_from_dicts(
+                dicts, self.schema, seed=f, start_index=start
+            )
+            self._progress[f] = len(dicts)
+            events.extend(new)
         return events
 
 

@@ -27,14 +27,20 @@ _build_failed = False
 def _build() -> str | None:
     if os.path.exists(_SO) and os.path.getmtime(_SO) >= os.path.getmtime(_SRC):
         return _SO
+    tmp = f"{_SO}.{os.getpid()}.tmp"
     try:
         subprocess.run(
             ["g++", "-O3", "-march=native", "-shared", "-fPIC", "-std=c++17",
-             _SRC, "-o", _SO],
+             _SRC, "-o", tmp],
             check=True, capture_output=True, timeout=120,
         )
+        os.replace(tmp, _SO)  # atomic: concurrent builders never dlopen a torn file
         return _SO
     except Exception:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
         return None
 
 
@@ -78,13 +84,62 @@ def available() -> bool:
     return get_lib() is not None
 
 
+# ---------------------------------------------------------------------------
+# Pure-Python mirror of pw_native.cpp's hash — bit-identical, so keys are
+# stable whether or not the compiled library is present.
+# ---------------------------------------------------------------------------
+
+_M64 = (1 << 64) - 1
+
+
+def _mix64(k: int) -> int:
+    k ^= k >> 33
+    k = (k * 0xFF51AFD7ED558CCD) & _M64
+    k ^= k >> 33
+    k = (k * 0xC4CEB9FE1A85EC53) & _M64
+    k ^= k >> 33
+    return k
+
+
+class _PyHashState:
+    __slots__ = ("a", "b")
+
+    def __init__(self, seed: int):
+        self.a = 0x9E3779B97F4A7C15 ^ seed
+        self.b = 0xBF58476D1CE4E5B9 ^ ((seed * 0x94D049BB133111EB + 1) & _M64)
+
+    def update_u64(self, v: int) -> None:
+        self.a = (_mix64(self.a ^ v) * 0x2545F4914F6CDD1D) & _M64
+        self.b = _mix64((self.b + v + 0x165667B19E3779F9) & _M64)
+
+    def update_bytes(self, data: bytes) -> None:
+        i, ln = 0, len(data)
+        while i + 8 <= ln:
+            self.update_u64(int.from_bytes(data[i : i + 8], "little"))
+            i += 8
+        rem = ln - i
+        if rem:
+            tail = int.from_bytes(data[i:] + b"\0" * (8 - rem), "little")
+            self.update_u64(tail ^ ((rem << 56) & _M64))
+        self.update_u64(ln ^ 0xA5A5A5A5A5A5A5A5)
+
+    def final(self) -> tuple[int, int]:
+        hi = _mix64(self.a ^ (self.b >> 32))
+        lo = _mix64(self.b ^ ((self.a << 17) & _M64) ^ 0x27D4EB2F165667C5)
+        return hi, lo
+
+
+def _py_hash128(data: bytes, seed: int = 0) -> int:
+    s = _PyHashState(seed & _M64)
+    s.update_bytes(data)
+    hi, lo = s.final()
+    return (hi << 64) | lo
+
+
 def hash128(data: bytes, seed: int = 0) -> int:
     lib = get_lib()
     if lib is None:
-        import hashlib
-
-        d = hashlib.blake2b(data, digest_size=16, salt=seed.to_bytes(8, "little")).digest()
-        return int.from_bytes(d, "little")
+        return _py_hash128(data, seed)
     hi = ctypes.c_uint64()
     lo = ctypes.c_uint64()
     lib.pw_hash128(data, len(data), seed & 0xFFFFFFFFFFFFFFFF,
@@ -92,22 +147,51 @@ def hash128(data: bytes, seed: int = 0) -> int:
     return (hi.value << 64) | lo.value
 
 
+def _py_hash_rows(columns: list, seed: int) -> np.ndarray:
+    """Bit-identical Python mirror of pw_hash_rows."""
+    import struct
+
+    n = len(columns[0]) if columns else 0
+    prepared = []
+    for col in columns:
+        if isinstance(col, np.ndarray) and col.dtype == np.int64:
+            prepared.append((0, col))
+        elif isinstance(col, np.ndarray) and col.dtype == np.float64:
+            prepared.append((1, col))
+        else:
+            prepared.append(
+                (2, [v.encode() if isinstance(v, str) else bytes(v) for v in col])
+            )
+    out = np.empty(n, dtype=object)
+    for i in range(n):
+        s = _PyHashState(seed & _M64)
+        for kind, col in prepared:
+            s.update_u64(0x1000 + kind)
+            if kind == 0:
+                s.update_u64(int(col[i]) & _M64)
+            elif kind == 1:
+                s.update_u64(
+                    int.from_bytes(struct.pack("<d", float(col[i])), "little")
+                )
+            else:
+                s.update_bytes(col[i])
+        hi, lo = s.final()
+        out[i] = (hi << 64) | lo
+    return out
+
+
 def hash_rows(columns: list[np.ndarray | list], seed: int = 0) -> np.ndarray:
     """Batch-hash rows from typed columns -> uint128 as (n,) object array of ints.
 
-    Columns: int64 arrays, float64 arrays, or lists of bytes/str.
+    Columns: int64 arrays, float64 arrays, or lists of bytes/str.  The native
+    and Python paths produce identical hashes.
     """
     n = len(columns[0]) if columns else 0
     lib = get_lib()
     out_hi = np.empty(n, np.uint64)
     out_lo = np.empty(n, np.uint64)
     if lib is None or n == 0:
-        from ..internals.value import hash_values
-
-        return np.array(
-            [hash_values(*[_py_col_val(c, i) for c in columns]) for i in range(n)],
-            dtype=object,
-        )
+        return _py_hash_rows(columns, seed)
     kinds = []
     values = []
     offsets = []

@@ -140,43 +140,59 @@ def attach_persistence(runner, config: Config) -> None:
     lg = runner.lg
     for op, source in lg.input_ops:
         stream = f"input_{op.id}"
-        # replay journal through a wrapper source
+        # replay journal through a wrapper source; each journal record is
+        # (events, offsets_after) so journal+offsets commit atomically
         journaled = backend.read_all(stream)
         replayed: list = []
+        last_offsets = None
         for rec in journaled:
-            t, events = pickle.loads(rec)
+            events, offsets = pickle.loads(rec)
             replayed.extend(events)
-        _wrap_source_with_persistence(source, backend, stream, replayed)
+            if offsets is not None:
+                last_offsets = offsets
+        _wrap_source_with_persistence(source, backend, stream, replayed, last_offsets)
 
 
-def _wrap_source_with_persistence(source, backend: Backend, stream: str, replayed: list):
+def _wrap_source_with_persistence(source, backend: Backend, stream: str,
+                                  replayed: list, last_offsets) -> None:
     orig_static = source.static_events
     orig_poll = source.poll
-    n_replayed = len(replayed)
+
+    # restore the reader's offset frontier so already-consumed rows are not
+    # re-read (reference: rewind_from_disk_snapshot + frontier_for,
+    # src/connectors/mod.rs:319-388); offsets travel inside journal records,
+    # so a crash can never separate "journaled" from "offset-advanced"
+    if last_offsets is not None and hasattr(source, "seek"):
+        source.seek(last_offsets)
 
     def static_events():
         live = orig_static()
-        if live and not n_replayed:
-            backend.append(stream, pickle.dumps((0, live)))
+        if not replayed:
+            if live:
+                backend.append(stream, pickle.dumps((live, None)))
             return live
-        return replayed + [e for e in live if e not in replayed] if live else replayed
+        # resumed run over a static source that may have grown: journal wins
+        # for journaled keys, genuinely-new rows are appended + journaled
+        seen_keys = {e[1] for e in replayed}
+        fresh = [e for e in live if e[1] not in seen_keys]
+        if fresh:
+            backend.append(stream, pickle.dumps((fresh, None)))
+        return replayed + fresh
 
-    def poll():
+    def journaling_poll():
         events = orig_poll()
         if events:
-            backend.append(stream, pickle.dumps((0, events)))
+            offsets = source.get_offsets() if hasattr(source, "get_offsets") else None
+            backend.append(stream, pickle.dumps((events, offsets)))
         return events
 
     source.static_events = static_events
     if source.is_live():
-        # prepend replayed events as a static batch
-        pending = [replayed] if replayed else []
+        pending = [list(replayed)] if replayed else []
 
         def poll_with_replay():
             if pending:
                 return pending.pop()
-            return poll()
+            return journaling_poll()
 
         source.poll = poll_with_replay
-    else:
-        source.poll = poll

@@ -179,6 +179,7 @@ def windowby(
         **cols,
         _pw_window_assigned=win_expr,
         _pw_instance=inst_e,
+        _pw_t=time_e,
     )
     base = base.flatten(base._pw_window_assigned)
     base = base.with_columns(
@@ -186,7 +187,36 @@ def windowby(
         _pw_window_start=base._pw_window_assigned[0],
         _pw_window_end=base._pw_window_assigned[1],
     ).without("_pw_window_assigned")
+    base = _apply_behavior(base, behavior)
     return WindowedTable(self, base, ["_pw_instance", "_pw_window", "_pw_window_start", "_pw_window_end"])
+
+
+def _apply_behavior(base: Table, behavior: Behavior | None) -> Table:
+    """Lower windowby behaviors onto the engine's buffer/freeze/forget ops
+    (reference: temporal_behavior.py → time_column.rs)."""
+    if behavior is None:
+        return base
+    from .temporal_behavior import CommonBehavior, ExactlyOnceBehavior
+
+    if isinstance(behavior, ExactlyOnceBehavior):
+        shift = behavior.shift
+        thr = base._pw_window_end + shift if shift is not None else base._pw_window_end
+        out = base._buffer(thr, base._pw_t)
+        out = out._freeze(thr, out._pw_t)
+        return out
+    if isinstance(behavior, CommonBehavior):
+        out = base
+        if behavior.delay is not None:
+            out = out._buffer(out._pw_window_start + behavior.delay, out._pw_t)
+        if behavior.cutoff is not None:
+            out = out._freeze(out._pw_window_end + behavior.cutoff, out._pw_t)
+            if not behavior.keep_results:
+                out = out._forget(
+                    out._pw_window_end + behavior.cutoff, out._pw_t,
+                    mark_forgetting_records=False,
+                )
+        return out
+    return base
 
 
 def _session_windowby(table: Table, time_expr, window: SessionWindow, instance):

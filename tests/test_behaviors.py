@@ -1,0 +1,117 @@
+"""Temporal behaviors: buffer/forget/freeze + the forget-immediately idiom
+(reference model: time_column.rs tests + test_common behaviors)."""
+
+import pathway_tpu as pw
+from pathway_tpu.debug import table_from_markdown
+
+from .utils import captured_stream, run_and_squash
+
+
+def test_forget_immediately_and_filter():
+    queries = table_from_markdown(
+        """
+        | q | __time__
+        | a | 0
+        | b | 2
+        """
+    )
+    one_shot = queries._forget_immediately()
+    upper = one_shot.select(q=pw.this.q.str.upper())
+    final = upper._filter_out_results_of_forgetting()
+    entries = captured_stream(final)
+    # each query appears exactly once, never retracted (odd-time events dropped)
+    assert [(r, t, d) for _k, r, t, d in entries] == [
+        (("A",), 0, 1),
+        (("B",), 2, 1),
+    ]
+
+
+def test_buffer_delays_until_frontier():
+    t = table_from_markdown(
+        """
+        | v | thr | now | __time__
+        | 1 | 5   | 1   | 0
+        | 2 | 2   | 3   | 2
+        | 3 | 3   | 6   | 4
+        """
+    )
+    out = t._buffer(t.thr, t.now)
+    entries = captured_stream(out)
+    by_time = [(r[0], tm) for _k, r, tm, d in entries if d > 0]
+    # v=1 (thr 5) held until frontier (max now) reaches 6 at time 4
+    assert (1, 4) in by_time
+    # v=2 (thr 2 <= frontier 3) released at its arrival time 2
+    assert (2, 2) in by_time
+
+
+def test_freeze_drops_late_rows():
+    t = table_from_markdown(
+        """
+        | v | thr | now | __time__
+        | 1 | 10  | 4   | 0
+        | 2 | 3   | 5   | 2
+        """
+    )
+    # second row: threshold 3 <= frontier 4 -> dropped
+    out = t._freeze(t.thr, t.now)
+    state = run_and_squash(out)
+    assert sorted(r[0] for r in state.values()) == [1]
+
+
+def test_forget_retracts_expired():
+    t = table_from_markdown(
+        """
+        | v | thr | now | __time__
+        | 1 | 3   | 1   | 0
+        | 2 | 99  | 5   | 2
+        """
+    )
+    out = t._forget(t.thr, t.now, mark_forgetting_records=False)
+    state = run_and_squash(out)
+    # row v=1 expired when frontier hit 5
+    assert sorted(r[0] for r in state.values()) == [2]
+
+
+def test_windowby_cutoff_behavior():
+    t = table_from_markdown(
+        """
+        | t | v | __time__
+        | 1 | 1 | 0
+        | 2 | 1 | 2
+        | 25 | 1 | 4
+        | 3 | 1 | 6
+        """
+    )
+    # tumbling 10; cutoff 0: once the frontier passes window end (10 <= 25),
+    # the late row at t=3 must be ignored
+    out = t.windowby(
+        t.t,
+        window=pw.temporal.tumbling(duration=10),
+        behavior=pw.temporal.common_behavior(cutoff=0),
+    ).reduce(
+        start=pw.this._pw_window_start,
+        c=pw.reducers.count(),
+    )
+    state = run_and_squash(out)
+    assert sorted(state.values()) == [(0, 2), (20, 1)]
+
+
+def test_windowby_keep_results_false():
+    t = table_from_markdown(
+        """
+        | t | v | __time__
+        | 1 | 1 | 0
+        | 25 | 1 | 2
+        """
+    )
+    out = t.windowby(
+        t.t,
+        window=pw.temporal.tumbling(duration=10),
+        behavior=pw.temporal.common_behavior(cutoff=0, keep_results=False),
+    ).reduce(
+        start=pw.this._pw_window_start,
+        c=pw.reducers.count(),
+    )
+    state = run_and_squash(out)
+    # first window forgotten once cutoff passed; only the live window remains
+    assert sorted(state.values()) == [(20, 1)]
