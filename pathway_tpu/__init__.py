@@ -149,6 +149,13 @@ from .stdlib.temporal import (  # noqa: E402
 )
 from .stdlib.utils.async_transformer import AsyncTransformer  # noqa: E402
 from .internals.iterate import iterate, iteration_limit  # noqa: E402
+from .internals.row_transformer import (  # noqa: E402
+    ClassArg,
+    input_attribute,
+    method,
+    output_attribute,
+    transformer,
+)
 from .engine import time_ops as _time_ops  # noqa: E402
 
 _time_ops.install_table_methods()
@@ -201,5 +208,6 @@ __all__ = [
     "ordered", "utils", "udfs", "iterate", "sql", "load_yaml",
     "column_definition", "schema_from_types", "schema_from_dict",
     "schema_from_pandas", "AsyncTransformer", "ERROR", "PENDING",
-    "set_license_key", "MonitoringLevel",
+    "set_license_key", "MonitoringLevel", "transformer", "ClassArg",
+    "input_attribute", "output_attribute", "method",
 ]

@@ -85,12 +85,12 @@ def table_from_markdown(
             parts = [p.strip() for p in ln.split("|")]
             if parts and parts[0] == "":
                 parts = parts[1:]
-            if parts and parts[-1] == "":
-                parts = parts[:-1]
             return parts
         return ln.split()
 
     colnames = split(header)
+    while colnames and colnames[-1] == "":
+        colnames = colnames[:-1]
     has_id = False
     if colnames and colnames[0] in ("id", ""):
         has_id = True
@@ -103,9 +103,16 @@ def table_from_markdown(
     auto_id = itertools.count()
     for ln in rows_txt:
         parts = split(ln)
+        # a trailing pipe leaves one extra empty cell
+        if len(parts) > len(colnames) + 1 and parts[-1] == "":
+            parts = parts[:-1]
         if len(parts) == len(colnames) + 1:
-            rid = parts[0]
-            parts = parts[1:]
+            if parts[-1] == "" and not has_id:
+                rid = None
+                parts = parts[:-1]
+            else:
+                rid = parts[0]
+                parts = parts[1:]
         elif len(parts) == len(colnames):
             rid = None
         else:

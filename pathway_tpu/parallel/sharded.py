@@ -1,0 +1,283 @@
+"""Sharded engine execution: the data-plane parallelism tier.
+
+Re-design of the reference's timely worker sharding (SURVEY.md §2c):
+collections are partitioned by key across S shards
+(src/engine/dataflow/shard.rs — masked key bits); operators exchange records
+at re-key boundaries.  Here each operator gets S replicas; every edge has a
+router deciding the owning shard of each update:
+
+  - key-partitioned ops (rowwise/filter/output-merge): route by row key
+  - groupby: route by the group key (computed from the same exprs the
+    operator uses) — the exchange the reference performs at dataflow.rs:3775
+  - join: route by join-key hash (both sides use the same hash, so matching
+    rows collide on one shard)
+  - non-shardable ops (ix, iterate, external index, temporal buffers):
+    centralized on shard 0, like the reference centralizes its time buffer
+    (time_column.rs:49-50 shard=1)
+
+Execution walks (time, topo-op, shard) deterministically, so results are
+bit-identical to the single-shard engine.  On one host the shards model the
+reference's threads; across hosts the same routing becomes an all-to-all
+key exchange over the interconnect.
+"""
+
+from __future__ import annotations
+
+from collections import defaultdict
+from typing import Any, Callable
+
+from ..engine import operators as ops
+from ..engine import runner as runner_mod
+from ..engine.graph import Operator, Scheduler
+from ..engine.types import CapturedStream, Update
+from ..internals import parse_graph as pg
+from ..internals.value import ref_scalar
+
+_SHARD_BY_KEY = "key"
+_CENTRAL = "central"
+
+
+def _route_all_shard0(update, n):
+    return 0
+
+
+class ShardRouter:
+    """Per-edge routing: update -> shard id."""
+
+    def __init__(self, kind: str, n_shards: int, fn: Callable | None = None):
+        self.kind = kind
+        self.n = n_shards
+        self.fn = fn
+
+    def shard_of(self, update: Update) -> int:
+        if self.kind == _CENTRAL:
+            return 0
+        if self.fn is not None:
+            return self.fn(update) % self.n
+        return update[0] % self.n  # route by row key
+
+
+def _groupby_router(node: pg.OpNode, n: int) -> ShardRouter:
+    p = node.params
+    src = node.input_tables[0]
+    env = runner_mod._env_for(src)
+    gb_fns = [runner_mod._compile(e) for e in p["gb_exprs"]]
+    if p.get("instance") is not None:
+        gb_fns.append(runner_mod._compile(p["instance"]))
+    key_fn = (
+        runner_mod._compile(p["id_expr"]) if p.get("id_expr") is not None else None
+    )
+
+    def fn(update):
+        key, row, _d = update
+        e = env.build(key, row)
+        if key_fn is not None:
+            return int(key_fn(e))
+        gvals = tuple(f(e) for f in gb_fns)
+        return int(ref_scalar(*gvals))
+
+    return ShardRouter("fn", n, fn)
+
+
+def _join_router(node: pg.OpNode, port: int, n: int) -> ShardRouter:
+    p = node.params
+    side = node.input_tables[port]
+    env = runner_mod._env_for(side)
+    on = p["left_on"] if port == 0 else p["right_on"]
+    fns = [runner_mod._compile(e) for e in on]
+
+    def fn(update):
+        key, row, _d = update
+        e = env.build(key, row)
+        from ..internals.value import hash_values
+
+        return int(hash_values(*[f(e) for f in fns]))
+
+    return ShardRouter("fn", n, fn)
+
+
+_SHARDABLE = {"rowwise", "filter", "reindex", "concat", "flatten", "input",
+              "groupby", "join", "update_rows", "update_cells", "difference",
+              "intersect", "deduplicate"}
+
+
+def edge_router(down_node: pg.OpNode, port: int, n: int) -> ShardRouter:
+    kind = down_node.kind
+    if kind == "groupby":
+        return _groupby_router(down_node, n)
+    if kind == "join":
+        return _join_router(down_node, port, n)
+    if kind == "deduplicate":
+        # route by instance so per-instance state is local
+        p = down_node.params
+        src = down_node.input_tables[0]
+        env = runner_mod._env_for(src)
+        inst_fns = [runner_mod._compile(e) for e in p["instance_exprs"]]
+
+        def fn(update):
+            key, row, _d = update
+            e = env.build(key, row)
+            ivals = tuple(f(e) for f in inst_fns)
+            return int(ref_scalar(*ivals)) if ivals else 0
+
+        return ShardRouter("fn", n, fn)
+    if kind in _SHARDABLE:
+        return ShardRouter(_SHARD_BY_KEY, n)
+    if kind in ("capture", "subscribe", "output", "raw_output"):
+        return ShardRouter(_CENTRAL, n)
+    return ShardRouter(_CENTRAL, n)
+
+
+class ShardedGraphRunner:
+    """Runs the lowered graph over n shards with exchange routing.
+
+    Deterministic schedule: for each logical time, walk operators in topo
+    order; for each operator, process all shards' pending batches, routing
+    emissions through the edge routers.
+    """
+
+    def __init__(self, sinks: list[pg.OpNode], n_shards: int = 2):
+        self.n = n_shards
+        self.node_by_op: dict[int, pg.OpNode] = {}
+        self.replicas: dict[int, list[Operator]] = {}
+        self.captures: dict[int, CapturedStream] = {}
+        self.input_ops: list[tuple[list[Operator], Any]] = []
+        # build one LoweredGraph per shard from the same parse graph
+        self.shard_graphs = []
+        for s in range(n_shards):
+            lg = runner_mod.lower(sinks)
+            self.shard_graphs.append(lg)
+        base = self.shard_graphs[0]
+        self.topo = base.scheduler.topo_order()
+        # map operator-position -> node for routing (lower() builds ops in
+        # the same order per shard)
+        for lg in self.shard_graphs[1:]:
+            assert len(lg.scheduler.topo_order()) == len(self.topo)
+        # node lookup: by_node maps node.id -> op; invert for shard 0
+        self.node_of_op0: dict[int, pg.OpNode] = {}
+        node_by_opid = {}
+        for nid, op in base.by_node.items():
+            node_by_opid[op.id] = nid
+        self.nodes = {nid: self._find_node(sinks, nid) for nid in base.by_node}
+        # per (downstream op pos, port) routers
+        self.routers: dict[tuple[int, int], ShardRouter] = {}
+        self.pos_of = {op.id: i for i, op in enumerate(self.topo)}
+        for nid, op in base.by_node.items():
+            node = self.nodes[nid]
+            if node is None:
+                continue
+            pos = self.pos_of[op.id]
+            for port in range(max(1, len(node.input_tables))):
+                self.routers[(pos, port)] = edge_router(node, port, n_shards)
+        # captures merge across shards: use shard-0 capture + feed others in
+        for nid, cap in base.captures.items():
+            self.captures[nid] = cap
+
+    @staticmethod
+    def _find_node(sinks, nid):
+        seen = set()
+        stack = list(sinks)
+        while stack:
+            node = stack.pop()
+            if node.id in seen:
+                continue
+            seen.add(node.id)
+            if node.id == nid:
+                return node
+            stack.extend(t._node for t in node.input_tables)
+        return None
+
+    def run_batch(self) -> dict[int, CapturedStream]:
+        # collect events per time, partitioned into shards by input routing
+        by_time: dict[int, dict[int, dict[int, list[Update]]]] = defaultdict(
+            lambda: defaultdict(lambda: defaultdict(list))
+        )  # time -> op_pos -> shard -> updates
+        base = self.shard_graphs[0]
+        for idx, (op, source) in enumerate(base.input_ops):
+            pos = self.pos_of[op.id]
+            router = ShardRouter(_SHARD_BY_KEY, self.n)
+            for t, key, row, diff in source.static_events():
+                s = router.shard_of((key, row, diff))
+                by_time[t][pos][s].append((key, row, diff))
+
+        pending: dict[int, dict[tuple[int, int], list[tuple[int, list[Update]]]]] = (
+            defaultdict(lambda: defaultdict(list))
+        )  # time -> (op_pos, shard) -> [(port, updates)]
+        for t, per_op in by_time.items():
+            for pos, per_shard in per_op.items():
+                for s, updates in per_shard.items():
+                    pending[t][(pos, s)].append((0, updates))
+
+        times = sorted(pending.keys())
+        ti = 0
+        while ti < len(times):
+            t = times[ti]
+            self._run_time(t, pending, times)
+            ti += 1
+        # on_end pass
+        for s in range(self.n):
+            for op in self.shard_graphs[s].scheduler.topo_order():
+                op.on_end()
+        return self.captures
+
+    def _run_time(self, t, pending, times) -> None:
+        bucket = pending.get(t, {})
+        for pos, base_op in enumerate(self.topo):
+            for s in range(self.n):
+                shard_sched = self.shard_graphs[s].scheduler
+                op = shard_sched.topo_order()[pos]
+                batches = bucket.pop((pos, s), None)
+                emitted: list[tuple[int, list[Update]]] = []
+                self._hook_emit(op, t, emitted)
+                if batches:
+                    for port, updates in batches:
+                        op.rows_in += len(updates)
+                        op.process(port, updates, t)
+                op.flush(t)
+                self._route_emissions(op, s, emitted, pending, times, t)
+
+    def _hook_emit(self, op: Operator, t, sink_list):
+        def emit(time, updates, _op=op, _sink=sink_list):
+            if updates:
+                _op.rows_out += len(updates)
+                _sink.append((time, updates))
+
+        op.emit = emit  # type: ignore[method-assign]
+
+    def _route_emissions(self, op, shard, emitted, pending, times, cur_t):
+        node_id = None
+        for nid, o in self.shard_graphs[shard].by_node.items():
+            if o is op:
+                node_id = nid
+                break
+        if node_id is None:
+            return
+        node = self.nodes.get(node_id)
+        if node is None:
+            return
+        # find downstream consumers via the shard-0 graph topology
+        base_op = self.shard_graphs[0].by_node[node_id]
+        for time, updates in emitted:
+            for down, port in base_op.downstream:
+                pos = self.pos_of[down.id]
+                router = self.routers.get((pos, port), ShardRouter(_CENTRAL, self.n))
+                per_shard: dict[int, list[Update]] = defaultdict(list)
+                for u in updates:
+                    per_shard[router.shard_of(u)].append(u)
+                for s2, us in per_shard.items():
+                    pending[time][(pos, s2)].append((port, us))
+                if time > cur_t and time not in pending:
+                    pass
+            if time > cur_t and time not in times:
+                import bisect
+
+                bisect.insort(times, time)
+            if not base_op.downstream and node.kind in ("capture",):
+                pass
+
+
+def run_tables_sharded(*tables, n_shards: int = 4) -> list[CapturedStream]:
+    sinks = [t._materialize_capture() for t in tables]
+    runner = ShardedGraphRunner(sinks, n_shards=n_shards)
+    caps = runner.run_batch()
+    return [caps[s.id] for s in sinks]

@@ -81,8 +81,24 @@ class ExternalIndexOperator(DiffOutputOperator):
             if port == 1:
                 self._dirty.update(self.state[0].keys())
             return
-        # as-of-now: answer query inserts immediately, never revise
+        # as-of-now: answer query inserts immediately, never revise.
+        # Inserts are answered in arrival order (batched per consecutive run)
+        # so a same-batch insert+delete cancels correctly.
         out = []
+        pending_inserts: list = []
+
+        def flush_inserts():
+            if not pending_inserts:
+                return
+            if len(pending_inserts) >= 4:
+                answers = self._answer_batch(pending_inserts)
+            else:
+                answers = [self._answer(k, r) for k, r in pending_inserts]
+            for (key, _row), ans in zip(pending_inserts, answers):
+                out.append((key, ans, 1))
+                self.emitted[key] = ans
+            pending_inserts.clear()
+
         for key, row, diff in updates:
             if port == 1:
                 self.pre_apply(1, key, row, diff)
@@ -90,25 +106,55 @@ class ExternalIndexOperator(DiffOutputOperator):
                 continue
             if diff > 0:
                 self.state[0].apply(key, row, diff)
-                ans = self._answer(key, row)
-                out.append((key, ans, 1))
-                self.emitted[key] = ans
+                pending_inserts.append((key, row))
             else:
+                flush_inserts()
                 self.state[0].apply(key, row, diff)
                 prev = self.emitted.pop(key, None)
                 if prev is not None:
                     out.append((key, prev, -1))
+        flush_inserts()
         if out:
             self.emit(time, consolidate(out))
 
-    def _answer(self, key, row) -> tuple:
-        env = self.query_env.build(key, row)
-        q = self.query_item_fn(env)
-        if q is None or isinstance(q, Error):
-            return ((), ()) + ((),) * self.n_data_cols
-        k = self.k_fn(env)
-        mf = self.filter_fn(env) if self.filter_fn else None
-        matches = self.index.search(q, int(k), mf)
+    def _answer_batch(self, inserts: list) -> list[tuple]:
+        """Batched as-of-now answers: one device dispatch when the index
+        supports it; per-query filters or odd rows fall back individually."""
+        if not hasattr(self.index, "search_batch") or self.filter_fn is not None:
+            return [self._answer(k, r) for k, r in inserts]
+        metas = []
+        for key, row in inserts:
+            env = self.query_env.build(key, row)
+            q = self.query_item_fn(env)
+            k = self.k_fn(env)
+            metas.append((q, k))
+        empty = ((), ()) + ((),) * self.n_data_cols
+        valid = [
+            i for i, (q, k) in enumerate(metas)
+            if q is not None and not isinstance(q, Error) and not isinstance(k, Error)
+        ]
+        ks = {int(metas[i][1]) for i in valid}
+        answers: list = [empty] * len(inserts)
+        if not valid:
+            return answers
+        if len(ks) != 1:
+            for i in valid:
+                answers[i] = self._pack(
+                    self.index.search(metas[i][0], int(metas[i][1]), None)
+                )
+            return answers
+        k = ks.pop()
+        try:
+            results = self.index.search_batch([metas[i][0] for i in valid], k)
+        except Exception:
+            for i in valid:
+                answers[i] = self._pack(self.index.search(metas[i][0], k, None))
+            return answers
+        for i, matches in zip(valid, results):
+            answers[i] = self._pack(matches)
+        return answers
+
+    def _pack(self, matches: list) -> tuple:
         keys = tuple(m[0] for m in matches)
         scores = tuple(float(m[1]) for m in matches)
         cols = []
@@ -119,6 +165,15 @@ class ExternalIndexOperator(DiffOutputOperator):
                 vals.append(drow[i] if drow is not None else None)
             cols.append(tuple(vals))
         return (keys, scores) + tuple(cols)
+
+    def _answer(self, key, row) -> tuple:
+        env = self.query_env.build(key, row)
+        q = self.query_item_fn(env)
+        if q is None or isinstance(q, Error):
+            return ((), ()) + ((),) * self.n_data_cols
+        k = self.k_fn(env)
+        mf = self.filter_fn(env) if self.filter_fn else None
+        return self._pack(self.index.search(q, int(k), mf))
 
     def compute(self, key):
         row = self.state[0].get_row(key)

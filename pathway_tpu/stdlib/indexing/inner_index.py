@@ -113,6 +113,24 @@ class BruteForceKnn(InnerIndex):
             return -np.sum((m - q) ** 2, axis=1)
         return m @ q  # dot
 
+    def search_batch(self, queries, k: int) -> list[list[tuple[int, float]]]:
+        """Batched search (no metadata filter): one device dispatch for the
+        whole micro-batch — Pallas matmul + top-k on TPU.  Below the device
+        threshold the per-query numpy path runs so single/batched results
+        are identical (both f32)."""
+        if self.n == 0:
+            return [[] for _ in queries]
+        if self.n < self.device_threshold:
+            return [self.search(q, k) for q in queries]
+        qs = np.asarray([np.asarray(q, np.float32).reshape(-1) for q in queries])
+        from ...ops.knn_pallas import knn_topk
+
+        vals, idx = knn_topk(self.matrix[: self.n], qs, k, self.metric)
+        out = []
+        for vi, ii in zip(vals, idx):
+            out.append([(self.keys[int(i)], float(v)) for v, i in zip(vi, ii)])
+        return out
+
     def search(self, query: Any, k: int, metadata_filter: str | None = None) -> list[tuple[int, float]]:
         if self.n == 0:
             return []

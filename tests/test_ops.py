@@ -1,0 +1,43 @@
+"""Device kernels: Pallas KNN scoring (interpreted on CPU) + batched top-k."""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+
+def test_pallas_scores_matches_matmul():
+    import jax.numpy as jnp
+
+    from pathway_tpu.ops.knn_pallas import pallas_scores
+
+    rng = np.random.default_rng(0)
+    q = rng.normal(size=(5, 64)).astype(np.float32)
+    m = rng.normal(size=(37, 64)).astype(np.float32)
+    out = np.asarray(pallas_scores(jnp.asarray(q), jnp.asarray(m), interpret=True))
+    ref = (q.astype(np.float32) @ m.T)
+    # bf16 inputs: tolerances follow bf16 mantissa
+    np.testing.assert_allclose(out, ref, rtol=2e-2, atol=2e-1)
+    assert out.shape == (5, 37)
+
+
+def test_knn_topk_cosine():
+    from pathway_tpu.ops.knn_pallas import knn_topk
+
+    rng = np.random.default_rng(1)
+    m = rng.normal(size=(200, 32)).astype(np.float32)
+    q = m[[3, 77]] + 0.001 * rng.normal(size=(2, 32)).astype(np.float32)
+    vals, idx = knn_topk(m, q, k=3, metric="cos", use_pallas=True)
+    assert idx[0, 0] == 3
+    assert idx[1, 0] == 77
+    assert vals.shape == (2, 3)
+
+
+def test_knn_topk_l2():
+    from pathway_tpu.ops.knn_pallas import knn_topk
+
+    rng = np.random.default_rng(2)
+    m = rng.normal(size=(50, 16)).astype(np.float32)
+    q = m[[10]]
+    vals, idx = knn_topk(m, q, k=1, metric="l2sq", use_pallas=False)
+    assert idx[0, 0] == 10

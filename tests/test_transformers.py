@@ -1,0 +1,72 @@
+"""Row transformer tests (reference model: tests/test_transformers.py)."""
+
+import pathway_tpu as pw
+from pathway_tpu.debug import table_from_markdown
+from pathway_tpu.engine.runner import run_tables
+
+
+def test_transformer_tree_sum_pointer_chasing():
+    t = table_from_markdown(
+        """
+      n | val | left | right
+      1 | 1   | 2    | 3
+      2 | 2   |      |
+      3 | 3   | 4    |
+      4 | 4   |      |
+        """,
+        id_from=["n"],
+    )
+    t2 = t.select(
+        val=t.val,
+        left=pw.if_else(t.left.is_none(), None, t.pointer_from(t.left)),
+        right=pw.if_else(t.right.is_none(), None, t.pointer_from(t.right)),
+    )
+
+    @pw.transformer
+    class tree_sum:
+        class tree(pw.ClassArg):
+            val: pw.input_attribute
+            left: pw.input_attribute
+            right: pw.input_attribute
+
+            @pw.output_attribute
+            def total(self) -> int:
+                s = self.val
+                if self.left is not None:
+                    s += self.transformer.tree[self.left].total
+                if self.right is not None:
+                    s += self.transformer.tree[self.right].total
+                return s
+
+    res = tree_sum(tree=t2).tree
+    [cap] = run_tables(res)
+    vals = sorted(r[0] for r in cap.squash().values())
+    assert vals == [2, 4, 7, 10]
+
+
+def test_transformer_intra_row_dependency():
+    t = table_from_markdown(
+        """
+        | a
+      1 | 2
+      2 | 5
+        """
+    )
+
+    @pw.transformer
+    class derive:
+        class rows(pw.ClassArg):
+            a: pw.input_attribute
+
+            @pw.output_attribute
+            def doubled(self) -> int:
+                return self.a * 2
+
+            @pw.output_attribute
+            def plus_one(self) -> int:
+                return self.doubled + 1
+
+    res = derive(rows=t).rows
+    [cap] = run_tables(res)
+    rows = sorted(cap.squash().values())
+    assert rows == [(4, 5), (10, 11)]
