@@ -83,6 +83,16 @@ def _make_operator(node: pg.OpNode, lg: LoweredGraph) -> Operator:
         return op
 
     if kind == "rowwise":
+        if p.get("fully_async"):
+            from .async_ops import lower_fully_async
+
+            return lower_fully_async(node, lg)
+        if len(tables) == 1 and any(
+            getattr(e, "_async_spec", None) is not None for e in p["exprs"]
+        ):
+            from .async_ops import lower_async_batch
+
+            return lower_async_batch(node, lg)
         exprs = [_compile(e) for e in p["exprs"]]
         if p.get("deterministic", True) and len(tables) == 1:
             return ops.StatelessRowwise(
@@ -293,7 +303,15 @@ class GraphRunner:
                     got_any = True
                     updates = [(key, row, diff) for _, key, row, diff in events]
                     sched.push_input(op, logical, updates)
-            if got_any:
+            # async completions need a tick so their flush runs
+            has_completions = any(
+                getattr(op, "_completions", None) for op in sched.operators
+            )
+            if got_any or has_completions:
+                if not got_any:
+                    # schedule an empty time so every operator's flush runs
+                    sched.pending[logical]  # touch: creates the bucket
+                    sched._note_time(logical)
                 sched.run_until_idle()
                 logical += 2
                 last_event = _time.monotonic()

@@ -1,7 +1,8 @@
 """pw.run() — execute every registered output sink.
 
 Reference: python/pathway/internals/run.py:13.  Batch graphs execute to
-completion; graphs with live sources run the streaming poll loop.
+completion; graphs with live sources run the streaming poll loop;
+PATHWAY_THREADS>1 routes batch graphs through the sharded data-plane.
 """
 
 from __future__ import annotations
@@ -32,7 +33,28 @@ def run(
     from ..engine.telemetry import global_error_log
 
     global_error_log.clear()
-    runner = GraphRunner(sinks, terminate_on_error=terminate_on_error)
+    from .config import pathway_config
+
+    n_shards = max(1, pathway_config.threads)
+    streaming = has_live_sources(sinks)
+
+    # exactly one runner is built and instrumented
+    if not streaming and n_shards > 1:
+        from ..parallel.sharded import ShardedGraphRunner
+
+        runner: Any = ShardedGraphRunner(sinks, n_shards=n_shards)
+        if terminate_on_error:
+            from ..engine import operators as _o
+
+            for lg in runner.shard_graphs:
+                for op in lg.scheduler.operators:
+                    if isinstance(op, _o.OutputOperator):
+                        op.terminate_on_error = True
+        scheduler = runner.lg.scheduler  # shard-0 replicas carry the counters
+    else:
+        runner = GraphRunner(sinks, terminate_on_error=terminate_on_error)
+        scheduler = runner.lg.scheduler
+
     if persistence_config is not None:
         from ..persistence import attach_persistence
 
@@ -42,17 +64,17 @@ def run(
     if with_http_server:
         from ..engine.telemetry import MetricsServer
 
-        metrics = MetricsServer(runner.lg.scheduler)
+        metrics = MetricsServer(scheduler)
         metrics.start()
     from ..internals.monitoring import MonitoringLevel
 
     if monitoring_level not in (None, MonitoringLevel.NONE):
         from ..engine.telemetry import ProgressReporter
 
-        reporter = ProgressReporter(runner.lg.scheduler)
+        reporter = ProgressReporter(scheduler)
         reporter.start()
     try:
-        if has_live_sources(sinks):
+        if streaming:
             runner.run_streaming(
                 autocommit_ms=autocommit_duration_ms,
                 timeout_s=timeout_s,

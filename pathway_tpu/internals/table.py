@@ -184,14 +184,40 @@ class Table:
     ) -> "Table":
         exprs = dict(out_exprs)
         extras = self._collect_dep_tables(exprs.values())
+        fully_async = any(
+            isinstance(node, FullyAsyncApplyExpression)
+            for e in exprs.values()
+            for node in walk(e)
+        )
+        if fully_async:
+            if extras:
+                raise ValueError(
+                    "fully-async expressions cannot reference other tables; "
+                    "select them from a single table"
+                )
+            for n, e in exprs.items():
+                if not isinstance(e, FullyAsyncApplyExpression) and any(
+                    isinstance(node, FullyAsyncApplyExpression) for node in walk(e)
+                ):
+                    raise ValueError(
+                        f"column {n!r}: a fully-async UDF must be the whole "
+                        "column expression (select it, then compute over the "
+                        "resolved column in a following select)"
+                    )
         node = pg.new_node(
             "rowwise",
             [self, *extras],
             out_names=list(exprs.keys()),
             exprs=list(exprs.values()),
             deterministic=self._is_deterministic(exprs.values()) and not extras,
+            fully_async=fully_async,
         )
-        dtypes = {n: infer_dtype(e) for n, e in exprs.items()}
+        dtypes = {}
+        for n, e in exprs.items():
+            d = infer_dtype(e)
+            if isinstance(e, FullyAsyncApplyExpression):
+                d = dt.Future(d)
+            dtypes[n] = d
         return Table(node, list(exprs.keys()), dtypes, universe or self._universe)
 
     # ------------------------------------------------------------------
@@ -382,9 +408,14 @@ class Table:
     def ix(self, expression, *, optional: bool = False, context=None) -> "Table":
         expr = wrap(expression)
         dep_tables = [r.table for r in expr._dependencies() if isinstance(r.table, Table)]
-        if not dep_tables:
-            raise ValueError("ix() needs a pointer expression over some table")
-        src = dep_tables[0]
+        if context is not None:
+            src = context
+        elif dep_tables:
+            src = dep_tables[0]
+        else:
+            raise ValueError(
+                "ix() needs a pointer expression over some table (or context=)"
+            )
         expr = substitute(expr, {this_ph: src})
         node = pg.new_node("ix", [src, self], ptr_expr=expr, optional=optional)
         dtypes = (

@@ -66,7 +66,19 @@ bigquery = _make_stub("bigquery", "google-cloud-bigquery")
 redpanda = kafka
 questdb = _make_stub("questdb", "questdb client")
 airbyte = _make_stub("airbyte", "airbyte-serverless runtime")
-debezium = _make_stub("debezium", "kafka + debezium format wiring")
+
+# debezium CDC rides the kafka connector with format="debezium"
+debezium = types.ModuleType("pathway_tpu.io.debezium")
+
+
+def _debezium_read(rdkafka_settings, topic_name=None, *, schema=None, **kw):
+    kw.pop("format", None)
+    return kafka.read(rdkafka_settings, topic_name, schema=schema,
+                      format="debezium", **kw)
+
+
+debezium.read = _debezium_read
+sys.modules["pathway_tpu.io.debezium"] = debezium
 logstash = _make_stub("logstash", "http wiring")
 null = types.ModuleType("pathway_tpu.io.null")
 null.write = lambda table, **kwargs: None

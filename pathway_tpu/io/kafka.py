@@ -79,6 +79,13 @@ class KafkaSource(DataSource):
             for batch in polled.values():
                 msgs.extend(r.value for r in batch)
         for raw in msgs:
+            if self.format == "debezium":
+                events.extend(
+                    (0, k, r, d)
+                    for k, r, d in parse_debezium(raw, colnames, dtypes, pk)
+                )
+                self._n += 1
+                continue
             if self.format == "json":
                 try:
                     d = json.loads(raw)
@@ -104,6 +111,41 @@ class KafkaSource(DataSource):
                 self._consumer.close()
             except Exception:
                 pass
+
+
+def parse_debezium(raw: bytes, colnames, dtypes, pk) -> list:
+    """Debezium CDC envelope -> Z-set deltas (reference:
+    src/connectors/data_format/debezium.rs).
+
+    op c/r -> +after; d -> -before; u -> -before, +after.
+    """
+    try:
+        msg = json.loads(raw)
+    except Exception:
+        return []
+    payload = msg.get("payload", msg)
+    op = payload.get("op", "c")
+    out = []
+
+    def ev(record, diff):
+        if record is None:
+            return
+        row = tuple(coerce_value(record.get(c), dtypes[c]) for c in colnames)
+        key = (
+            ref_scalar(*[record.get(c) for c in pk])
+            if pk
+            else ref_scalar("dbz", tuple(sorted(record.items(), key=lambda kv: kv[0])))
+        )
+        out.append((key, row, diff))
+
+    if op in ("c", "r"):
+        ev(payload.get("after"), 1)
+    elif op == "d":
+        ev(payload.get("before"), -1)
+    elif op == "u":
+        ev(payload.get("before"), -1)
+        ev(payload.get("after"), 1)
+    return out
 
 
 def read(

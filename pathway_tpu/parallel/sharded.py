@@ -148,6 +148,7 @@ class ShardedGraphRunner:
             lg = runner_mod.lower(sinks)
             self.shard_graphs.append(lg)
         base = self.shard_graphs[0]
+        self.lg = base  # persistence and telemetry attach to the base graph
         self.topo = base.scheduler.topo_order()
         # map operator-position -> node for routing (lower() builds ops in
         # the same order per shard)
@@ -214,10 +215,26 @@ class ShardedGraphRunner:
             t = times[ti]
             self._run_time(t, pending, times)
             ti += 1
-        # on_end pass
-        for s in range(self.n):
-            for op in self.shard_graphs[s].scheduler.topo_order():
+        # on_end pass: emissions (e.g. fully-async resolutions) are routed
+        # like any other batch, then unconsumed buckets drain (consumed
+        # buckets were popped by _run_time, so re-running a time only
+        # delivers the new batches)
+        end_t = (times[-1] + 2) if times else 0
+        for pos, _base_op in enumerate(self.topo):
+            for s in range(self.n):
+                op = self.shard_graphs[s].scheduler.topo_order()[pos]
+                emitted: list = []
+                self._hook_emit(op, end_t, emitted)
                 op.on_end()
+                self._route_emissions(op, s, emitted, pending, times, 0)
+        while True:
+            leftover = sorted(t for t, b in list(pending.items()) if b)
+            if not leftover:
+                break
+            for t in leftover:
+                if t not in times:
+                    times.append(t)
+                self._run_time(t, pending, times)
         return self.captures
 
     def _run_time(self, t, pending, times) -> None:

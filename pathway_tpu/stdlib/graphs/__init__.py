@@ -35,7 +35,7 @@ def bellman_ford(vertices: Table, edges: Table) -> Table:
             v=edges.v, d=state.dist + edges.dist
         )
         best = relaxed.groupby(relaxed.v).reduce(relaxed.v, d=R.min(relaxed.d))
-        best = best.with_id(best.v).select(d=best.d)
+        best = best.with_id(best.v)
         looked = best.ix(state.id, optional=True)
         cand = coalesce(looked.d, math.inf)
         return state.select(dist=if_else(cand < state.dist, cand, state.dist))
@@ -43,7 +43,90 @@ def bellman_ford(vertices: Table, edges: Table) -> Table:
     return iterate(lambda state: step(state), state=init)
 
 
-def louvain_level(G: Graph, total_weight=None) -> Table:  # pragma: no cover
-    raise NotImplementedError(
-        "louvain: planned (reference stdlib/graphs/louvain_communities)"
-    )
+def louvain_level(vertices: Table, edges: Table, iteration_limit: int = 20) -> Table:
+    """One Louvain level: each vertex joins the neighbor community with the
+    best modularity gain, iterated to stability.
+
+    vertices: any columns (ids used); edges: columns [u, v, weight] with u/v
+    vertex pointers (symmetric edge list).  Returns a table keyed by vertex
+    with a `community` column.
+
+    Reference: stdlib/graphs/louvain_communities/impl.py (385 LoC).  This is
+    the synchronous (parallel-update) variant — all vertices re-evaluate
+    against the previous assignment each round, the dataflow-friendly
+    formulation (the reference randomizes move order instead).
+    """
+    from ... import coalesce
+    from ...internals import reducers as R
+    from ...internals.iterate import iterate
+
+    m2 = edges.reduce(w=R.sum(edges.weight))  # single row: 2m for symmetric edges
+
+    init = vertices.select(community=vertices.id)
+
+    def step(state: Table) -> Table:
+        # two half-steps per round (even-hash vertices move first, then odd):
+        # sequential-like updates avoid the 2-cycle oscillation of fully
+        # synchronous label moves
+        return _half_step(_half_step(state, 0), 1)
+
+    def _half_step(state: Table, parity: int) -> Table:
+        cv = state.ix(edges.v)  # community of each edge target
+        cu = state.ix(edges.u)  # vertex's own community
+        contrib = edges.select(
+            u=edges.u, com=cv.community, w=edges.weight, ucom=cu.community
+        )
+        # edge mass from each vertex into each neighboring community
+        per = contrib.groupby(contrib.u, contrib.com).reduce(
+            contrib.u, contrib.com, w=R.sum(contrib.w), ucom=R.any(contrib.ucom)
+        )
+        # weighted degree per vertex, keyed by the vertex pointer
+        deg = contrib.groupby(contrib.u).reduce(contrib.u, k=R.sum(contrib.w))
+        deg = deg.with_id(deg.u)
+        # total degree per community
+        com_k = state.select(
+            community=state.community,
+            k=coalesce(deg.ix(state.id, optional=True).k, 0.0),
+        )
+        sigma = com_k.groupby(com_k.community).reduce(
+            com_k.community, tot=R.sum(com_k.k)
+        )
+        sigma = sigma.with_id(sigma.community)
+        perk = per.with_columns(
+            ku=coalesce(deg.ix(per.u, optional=True).k, 0.0),
+            sig=coalesce(sigma.ix(per.com, optional=True).tot, 0.0),
+            m2=coalesce(m2.ix(per.pointer_from(), optional=True, context=per).w, 1.0),
+        )
+        # modularity gain of joining community C: w(u->C) - k_u*sigma_{C\u}/2m.
+        # For the vertex's own community, sigma must exclude k_u (standard
+        # Louvain: the vertex is removed before evaluating moves); a tiny
+        # stay-bonus breaks exact ties toward not moving.
+        from ... import if_else as _if_else
+
+        perk = perk.with_columns(
+            gain=perk.w
+            - perk.ku
+            * (perk.sig - _if_else(perk.com == perk.ucom, perk.ku, 0.0))
+            / (perk.m2 + 1e-12)
+            + _if_else(perk.com == perk.ucom, 1e-9, 0.0)
+        )
+        best = perk.groupby(perk.u).reduce(
+            perk.u,
+            best_com=R.argmax(perk.gain, perk.com),
+        )
+        best = best.with_id(best.u)
+        looked = best.ix(state.id, optional=True)
+        from ... import apply_with_type, if_else
+        from ...internals import dtype as dt
+
+        my_parity = apply_with_type(lambda p: int(p) % 2, dt.INT, state.id)
+        return state.select(
+            community=if_else(
+                my_parity == parity,
+                coalesce(looked.best_com, state.community),
+                state.community,
+            )
+        )
+
+    return iterate(lambda state: step(state), iteration_limit=iteration_limit,
+                   state=init)

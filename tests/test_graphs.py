@@ -1,0 +1,70 @@
+"""Graph algorithms (reference model: stdlib/graphs tests)."""
+
+import math
+
+import pathway_tpu as pw
+from pathway_tpu.debug import table_from_markdown
+from pathway_tpu.engine.runner import run_tables
+from pathway_tpu.stdlib.graphs import bellman_ford, louvain_level
+
+from .utils import run_and_squash
+
+
+def _vertices(names):
+    rows = "\n".join(f"{n} | {str(n == names[0])}" for n in names)
+    return table_from_markdown(
+        f"""
+        n | is_source
+        {rows}
+        """,
+        id_from=["n"],
+    )
+
+
+def test_bellman_ford():
+    v = _vertices(["a", "b", "c", "d"])
+    e = table_from_markdown(
+        """
+        | su | sv | dist
+      1 | a  | b  | 1.0
+      2 | b  | c  | 2.0
+      3 | a  | c  | 5.0
+        """
+    )
+    e2 = e.select(u=v.pointer_from(e.su), v=v.pointer_from(e.sv), dist=e.dist)
+    out = bellman_ford(v, e2)
+    state = run_and_squash(out)
+    dists = sorted(r[0] for r in state.values())
+    assert dists == [0.0, 1.0, 3.0, math.inf]
+
+
+def test_louvain_two_cliques():
+    # two triangles joined by one weak edge -> two communities
+    names = ["a", "b", "c", "x", "y", "z"]
+    v = table_from_markdown(
+        "\n".join(["n"] + names), id_from=["n"]
+    )
+    edges = [
+        ("a", "b"), ("b", "c"), ("a", "c"),
+        ("x", "y"), ("y", "z"), ("x", "z"),
+        ("c", "x"),
+    ]
+    lines = ["su | sv"] + [f"{u} | {w}" for u, w in edges] + [f"{w} | {u}" for u, w in edges]
+    e = table_from_markdown("\n".join(lines))
+    e2 = e.select(u=v.pointer_from(e.su), v=v.pointer_from(e.sv), weight=1.0)
+    out = louvain_level(v, e2)
+    [cap] = run_tables(out)
+    state = cap.squash()
+    assert len(state) == 6
+    communities = {}
+    key_of = {}
+    from pathway_tpu.internals.value import ref_scalar
+
+    for n in names:
+        key_of[ref_scalar(n)] = n
+    by_name = {key_of[k]: r[0] for k, r in state.items()}
+    left = {by_name["a"], by_name["b"], by_name["c"]}
+    right = {by_name["x"], by_name["y"], by_name["z"]}
+    assert len(left) == 1, by_name  # each triangle collapses to one community
+    assert len(right) == 1, by_name
+    assert left != right  # cliques separated

@@ -1,0 +1,134 @@
+"""UDF subsystem tests (reference model: tests/test_udf.py)."""
+
+import asyncio
+import random
+import time as _t
+
+import pathway_tpu as pw
+from pathway_tpu.debug import table_from_markdown, table_from_rows
+from pathway_tpu.engine.runner import run_tables
+
+from .utils import run_and_squash
+
+
+def test_sync_udf_with_cache(tmp_path):
+    calls = []
+
+    @pw.udf(cache_strategy=pw.udfs.InMemoryCache())
+    def expensive(x: int) -> int:
+        calls.append(x)
+        return x * 10
+
+    t = table_from_markdown(
+        """
+        | a
+      1 | 3
+      2 | 3
+      3 | 4
+        """
+    )
+    out = t.select(r=expensive(t.a))
+    state = run_and_squash(out)
+    assert sorted(r[0] for r in state.values()) == [30, 30, 40]
+    assert sorted(calls) == [3, 4]  # second 3 came from cache
+
+
+def test_async_udf_batched_gather():
+    @pw.udf(executor=pw.udfs.async_executor(capacity=64))
+    async def slow(x: int) -> int:
+        await asyncio.sleep(0.05)
+        return x + 1
+
+    class S(pw.Schema):
+        a: int
+
+    t = table_from_rows(S, [(i,) for i in range(30)])
+    out = t.select(r=slow(t.a))
+    t0 = _t.time()
+    state = run_and_squash(out)
+    elapsed = _t.time() - t0
+    assert sorted(r[0] for r in state.values()) == list(range(1, 31))
+    assert elapsed < 1.0  # gathered, not 30 * 0.05 sequential
+
+
+def test_async_udf_retry():
+    attempts = []
+
+    @pw.udf(executor=pw.udfs.async_executor(
+        retry_strategy=pw.udfs.FixedDelayRetryStrategy(max_retries=3, delay_ms=1)
+    ))
+    async def flaky(x: int) -> int:
+        attempts.append(x)
+        if len(attempts) < 2:
+            raise RuntimeError("transient")
+        return x
+
+    t = table_from_markdown(
+        """
+        | a
+      1 | 5
+        """
+    )
+    state = run_and_squash(t.select(r=flaky(t.a)))
+    assert list(state.values()) == [(5,)]
+    assert len(attempts) >= 2
+
+
+def test_fully_async_pending_flow():
+    @pw.udf(executor=pw.udfs.fully_async_executor())
+    async def slow_double(x: int) -> int:
+        await asyncio.sleep(0.02)
+        return x * 2
+
+    t = table_from_markdown(
+        """
+        | a
+      1 | 1
+      2 | 5
+        """
+    )
+    out = t.select(a=t.a, d=slow_double(t.a))
+    [cap] = run_tables(out)
+    entries = cap.as_list()
+    pend = [e for e in entries if isinstance(e[1][1], type(pw.PENDING)) and e[3] > 0]
+    assert len(pend) == 2
+    assert sorted(cap.squash().values()) == [(1, 2), (5, 10)]
+
+
+def test_nondeterministic_async_retraction_cancels():
+    @pw.udf(executor=pw.udfs.async_executor())
+    async def rand_val(x: int) -> float:
+        return random.random()
+
+    t = table_from_markdown(
+        """
+        a | __time__ | __diff__
+        7 | 0        | 1
+        7 | 2        | -1
+        """,
+        id_from=["a"],
+    )
+    out = t.select(r=rand_val(t.a))
+    [cap] = run_tables(out)
+    assert cap.squash() == {}
+
+
+def test_nondeterministic_sync_udf_stateful_path():
+    counter = [0]
+
+    @pw.udf(deterministic=False)
+    def seq(x: int) -> int:
+        counter[0] += 1
+        return counter[0]
+
+    t = table_from_markdown(
+        """
+        a | __time__ | __diff__
+        1 | 0        | 1
+        1 | 2        | -1
+        """,
+        id_from=["a"],
+    )
+    out = t.select(r=seq(t.a))
+    [cap] = run_tables(out)
+    assert cap.squash() == {}
