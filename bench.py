@@ -12,9 +12,37 @@ Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...extras}.
 from __future__ import annotations
 
 import json
+import os
 import random
 import statistics
+import subprocess
+import sys
 import time
+
+
+def _ensure_healthy_backend() -> None:
+    """The axon TPU tunnel can wedge (PJRT claim never granted); probe it in
+    a subprocess and fall back to CPU rather than hanging the bench."""
+    if os.environ.get("PW_BENCH_BACKEND_CHECKED"):
+        return
+    try:
+        probe = subprocess.run(
+            [sys.executable, "-c", "import jax; print(jax.devices()[0].platform)"],
+            capture_output=True, timeout=90,
+        )
+        ok = probe.returncode == 0
+    except subprocess.TimeoutExpired:
+        ok = False
+    if not ok:
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["PYTHONPATH"] = os.pathsep.join(
+            p for p in env.get("PYTHONPATH", "").split(os.pathsep)
+            if "axon" not in p
+        )
+        env["PW_BENCH_BACKEND_CHECKED"] = "1"
+        os.execve(sys.executable, [sys.executable, os.path.abspath(__file__)], env)
+    os.environ["PW_BENCH_BACKEND_CHECKED"] = "1"
 
 
 def make_corpus(n_docs: int, words_per_doc: int = 48, seed: int = 0) -> list[str]:
@@ -26,6 +54,7 @@ def make_corpus(n_docs: int, words_per_doc: int = 48, seed: int = 0) -> list[str
 
 
 def main() -> None:
+    _ensure_healthy_backend()
     import jax
 
     from pathway_tpu.models.encoder import EncoderConfig, JaxEncoder

@@ -1,0 +1,38 @@
+"""Live RAG server over a document directory — the adaptive-RAG template
+(reference: demo-question-answering app, xpacks/llm question_answering).
+
+Usage:
+    python examples/rag_app.py --docs ./docs --host 0.0.0.0 --port 8080
+Then:
+    curl -X POST localhost:8080/v1/pw_ai_answer -d '{"prompt": "..."}'
+    curl -X POST localhost:8080/v1/retrieve -d '{"query": "...", "k": 3}'
+"""
+
+import argparse
+
+import pathway_tpu as pw
+from pathway_tpu.xpacks.llm.document_store import DocumentStore
+from pathway_tpu.xpacks.llm.llms import JaxChat
+from pathway_tpu.xpacks.llm.question_answering import AdaptiveRAGQuestionAnswerer
+from pathway_tpu.xpacks.llm.splitters import TokenCountSplitter
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--docs", required=True)
+    ap.add_argument("--host", default="0.0.0.0")
+    ap.add_argument("--port", type=int, default=8080)
+    ap.add_argument("--timeout", type=float, default=None)
+    args = ap.parse_args()
+
+    docs = pw.io.fs.read(args.docs, format="binary", with_metadata=True)
+    store = DocumentStore(
+        docs, splitter=TokenCountSplitter(min_tokens=30, max_tokens=300)
+    )
+    rag = AdaptiveRAGQuestionAnswerer(JaxChat(), store)
+    rag.build_server(args.host, args.port)
+    rag.run_server(timeout_s=args.timeout)
+
+
+if __name__ == "__main__":
+    main()

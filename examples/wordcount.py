@@ -1,0 +1,42 @@
+"""Streaming wordcount with persistence — the reference's perf/recovery
+harness program (integration_tests/wordcount/pw_wordcount.py equivalent).
+
+Usage:
+    python examples/wordcount.py --input ./words --output counts.jsonl \
+        --pstorage ./pstore [--timeout 30]
+"""
+
+import argparse
+
+import pathway_tpu as pw
+
+
+class InputSchema(pw.Schema):
+    word: str
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--input", required=True)
+    ap.add_argument("--output", required=True)
+    ap.add_argument("--pstorage", default=None)
+    ap.add_argument("--mode", default="streaming")
+    ap.add_argument("--timeout", type=float, default=None)
+    args = ap.parse_args()
+
+    words = pw.io.csv.read(args.input, schema=InputSchema, mode=args.mode)
+    counts = words.groupby(words.word).reduce(
+        words.word, count=pw.reducers.count()
+    )
+    pw.io.jsonlines.write(counts, args.output)
+
+    pconfig = None
+    if args.pstorage:
+        pconfig = pw.persistence.Config(
+            pw.persistence.Backend.filesystem(args.pstorage)
+        )
+    pw.run(persistence_config=pconfig, timeout_s=args.timeout, idle_stop_s=5.0)
+
+
+if __name__ == "__main__":
+    main()

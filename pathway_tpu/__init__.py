@@ -190,11 +190,7 @@ Table.show = utils.viz_show
 Table.plot = utils.viz_plot
 Table.sort = temporal.sort
 
-universes = type("universes", (), {})()
-universes.promise_are_pairwise_disjoint = staticmethod(lambda *tables: tables[0] if tables else None)
-universes.promise_are_equal = staticmethod(
-    lambda *tables: [t.promise_universes_are_equal(tables[0]) for t in tables[1:]] and None
-)
+from .internals import universes  # noqa: E402
 
 __version__ = "0.1.0"
 

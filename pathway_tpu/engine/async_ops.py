@@ -32,12 +32,19 @@ class FullyAsyncRowwise(Operator):
         self.env = env
         self.plan = sync_exprs
         self.async_specs = async_specs  # list of (fun, arg_fns, kwarg_fns, capacity)
-        self.pool = ThreadPoolExecutor(max_workers=8, thread_name_prefix="pw-async")
+        caps = [c for _f, _a, _k, c in async_specs if c]
+        workers = min(caps) if caps else 8
+        self.pool = ThreadPoolExecutor(
+            max_workers=max(1, workers), thread_name_prefix="pw-async"
+        )
         self._lock = threading.Lock()
         self._completions: list[tuple[Any, tuple, tuple]] = []  # key, old_row, new_row
         self._outstanding = 0
         self._done = threading.Condition(self._lock)
-        self._inflight: set = set()  # keys awaiting resolution
+        # completions are only accepted when their generation matches, so
+        # retract-then-reinsert never resolves with a stale row's result
+        self._gen_counter = 0
+        self._inflight: dict[Any, int] = {}  # key -> generation awaiting
         self._resolved: dict[Any, tuple] = {}  # key -> emitted resolved row
 
     def process(self, port, updates, time):
@@ -48,7 +55,7 @@ class FullyAsyncRowwise(Operator):
                 with self._lock:
                     if key in self._inflight:
                         # cancel: the completion will be dropped; retract Pending
-                        self._inflight.discard(key)
+                        del self._inflight[key]
                         out.append((key, self._pending_row(e), diff))
                         continue
                     resolved = self._resolved.pop(key, None)
@@ -65,8 +72,9 @@ class FullyAsyncRowwise(Operator):
                 kwargs = {k: f(e) for k, f in kwarg_fns.items()}
                 async_args.append((fun, args, kwargs))
             with self._lock:
-                self._inflight.add(key)
-            self._submit(key, pending_row, e, async_args)
+                self._gen_counter += 1
+                self._inflight[key] = self._gen_counter
+            self._submit(key, self._gen_counter, pending_row, e, async_args)
         self.emit(time, out)
 
     def _pending_row(self, e) -> tuple:
@@ -78,7 +86,7 @@ class FullyAsyncRowwise(Operator):
                 vals.append(PENDING)
         return tuple(vals)
 
-    def _submit(self, key, pending_row, env, async_args):
+    def _submit(self, key, gen, pending_row, env, async_args):
         with self._lock:
             self._outstanding += 1
 
@@ -100,9 +108,9 @@ class FullyAsyncRowwise(Operator):
                 else:
                     new_vals.append(next(ri))
             with self._done:
-                if key in self._inflight:
-                    self._completions.append((key, pending_row, tuple(new_vals)))
-                # else: the row was retracted before resolution — drop it
+                if self._inflight.get(key) == gen:
+                    self._completions.append((key, gen, pending_row, tuple(new_vals)))
+                # else: retracted or superseded before resolution — drop
                 self._outstanding -= 1
                 self._done.notify_all()
 
@@ -115,10 +123,10 @@ class FullyAsyncRowwise(Operator):
         with self._lock:
             comps, self._completions = self._completions, []
             out = []
-            for key, old_row, new_row in comps:
-                if key not in self._inflight:
-                    continue  # retracted since completion was queued
-                self._inflight.discard(key)
+            for key, gen, old_row, new_row in comps:
+                if self._inflight.get(key) != gen:
+                    continue  # retracted/superseded since completion was queued
+                del self._inflight[key]
                 self._resolved[key] = new_row
                 out.append((key, old_row, -1))
                 out.append((key, new_row, 1))
@@ -140,13 +148,18 @@ class AsyncBatchRowwise(Operator):
     micro-batch (reference: async executor with capacity,
     udfs/executors.py:226) — one event loop run per batch, not per row."""
 
-    def __init__(self, env, plan, async_specs, name="select-async"):
+    def __init__(self, env, plan, async_specs, deterministic: bool = False,
+                 name="select-async"):
         super().__init__(name)
         self.env = env
         self.plan = plan  # per column: ("sync", fn) | ("async", spec_idx)
-        self.async_specs = async_specs  # (coro_fun, arg_fns, kwarg_fns, capacity, timeout, retry)
+        # spec: (coro_fun, arg_fns, kwarg_fns, capacity, timeout, retry,
+        #        cache_strategy, cache_name)
+        self.async_specs = async_specs
+        self.deterministic = deterministic
         # non-deterministic results memoized per key so retractions cancel
-        # (reference: expression_cache.rs)
+        # (reference: expression_cache.rs); deterministic UDFs recompute
+        # instead, keeping memory proportional to nothing
         self._result_cache: dict[Any, tuple] = {}
 
     def process(self, port, updates, time):
@@ -154,7 +167,7 @@ class AsyncBatchRowwise(Operator):
 
         from ..internals.udfs import run_coroutine_batch
 
-        todo = []  # (update_index,) needing async evaluation
+        todo = []  # update indices needing async evaluation
         out_rows: list = [None] * len(updates)
         envs: list = [None] * len(updates)
         for i, (key, row, diff) in enumerate(updates):
@@ -164,14 +177,36 @@ class AsyncBatchRowwise(Operator):
                 envs[i] = self.env.build(key, row)
                 todo.append(i)
         resolved: dict[int, dict[int, Any]] = {}
-        for si, (fun, arg_fns, kwarg_fns, capacity, timeout, retry) in enumerate(
-            self.async_specs
-        ):
+        for si, spec in enumerate(self.async_specs):
+            (fun, arg_fns, kwarg_fns, capacity, timeout, retry,
+             cache, cache_name) = spec
             coros = []
+            coro_idx = []  # representative update index per coroutine
+            hits: dict[int, Any] = {}
+            call_keys: dict[int, str] = {}
+            dedup: dict[str, list[int]] = {}  # cache key -> follower indices
             for i in todo:
                 e = envs[i]
                 args = tuple(f(e) for f in arg_fns)
                 kwargs = {k: f(e) for k, f in kwarg_fns.items()}
+                if cache is not None:
+                    from ..internals.udfs import _cache_key
+
+                    try:
+                        ck = _cache_key(cache_name, args, kwargs)
+                        hit = cache.lookup(ck)
+                    except Exception:
+                        ck, hit = None, None
+                    if hit is not None:
+                        hits[i] = hit[0]
+                        continue
+                    if ck is not None:
+                        if ck in dedup:
+                            # identical in-batch call: share one invocation
+                            dedup[ck].append(i)
+                            continue
+                        dedup[ck] = []
+                        call_keys[i] = ck
 
                 async def one(args=args, kwargs=kwargs):
                     if any(isinstance(a, Error) for a in args):
@@ -182,8 +217,20 @@ class AsyncBatchRowwise(Operator):
                     return await c
 
                 coros.append(one())
-            results = run_coroutine_batch(coros, capacity)
-            resolved[si] = dict(zip(todo, results))
+                coro_idx.append(i)
+            results = dict(zip(coro_idx, run_coroutine_batch(coros, capacity)))
+            if cache is not None:
+                for i, ck in call_keys.items():
+                    v = results.get(i)
+                    for follower in dedup.get(ck, ()):
+                        results[follower] = v
+                    if v is not None and not isinstance(v, Error):
+                        try:
+                            cache.store(ck, (v,))
+                        except Exception:
+                            pass
+            results.update(hits)
+            resolved[si] = results
         for i in todo:
             key, _row, diff = updates[i]
             vals = []
@@ -193,7 +240,7 @@ class AsyncBatchRowwise(Operator):
                 else:
                     vals.append(resolved[payload][i])
             out_rows[i] = tuple(vals)
-            if diff > 0:
+            if diff > 0 and not self.deterministic:
                 self._result_cache[key] = out_rows[i]
         self.emit(
             time,
@@ -209,20 +256,22 @@ def lower_async_batch(node, lg):
     env = _env_for(src)
     plan = []
     specs = []
+    deterministic = True
     for e in p["exprs"]:
         spec = getattr(e, "_async_spec", None)
         if spec is not None:
-            fun, ex, _cache, _name = spec
+            fun, ex, cache, name = spec
             idx = len(specs)
             specs.append(
                 (fun, [a._eval for a in e._args],
                  {k: a._eval for k, a in e._kwargs.items()},
-                 ex.capacity, ex.timeout, ex.retry_strategy)
+                 ex.capacity, ex.timeout, ex.retry_strategy, cache, name)
             )
             plan.append(("async", idx))
+            deterministic = deterministic and e._deterministic
         else:
             plan.append(("sync", e._eval))
-    return AsyncBatchRowwise(env, plan, specs)
+    return AsyncBatchRowwise(env, plan, specs, deterministic=deterministic)
 
 
 def lower_fully_async(node, lg):
@@ -238,9 +287,11 @@ def lower_fully_async(node, lg):
     for e in p["exprs"]:
         if isinstance(e, FullyAsyncApplyExpression):
             idx = len(specs)
+            spec = getattr(e, "_async_spec", None)
+            capacity = spec[1].capacity if spec is not None else None
             specs.append(
                 (e._fun, [a._eval for a in e._args],
-                 {k: a._eval for k, a in e._kwargs.items()}, None)
+                 {k: a._eval for k, a in e._kwargs.items()}, capacity)
             )
             plan.append(("async", idx))
         else:

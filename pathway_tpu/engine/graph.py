@@ -167,7 +167,17 @@ class Scheduler:
 
     def finish(self) -> None:
         self.run_until_idle()
+        # two-phase shutdown: interior operators first (they may emit final
+        # batches, e.g. async resolutions / buffered releases), drain, THEN
+        # sinks — so a subscriber's on_end truly means end-of-stream
+        sinks = []
         for op in self.topo_order():
+            if op.downstream:
+                op.on_end()
+            else:
+                sinks.append(op)
+        self.run_until_idle()
+        for op in sinks:
             op.on_end()
         self.run_until_idle()
 

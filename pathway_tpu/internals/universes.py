@@ -1,0 +1,23 @@
+"""pw.universes — key-set promises (reference: python/pathway/internals/
+universes.py + universe_solver.py)."""
+
+from __future__ import annotations
+
+from .table import Table, promise_universes_equal
+
+_disjoint_groups: list[tuple[int, ...]] = []
+
+
+def promise_are_pairwise_disjoint(*tables: Table) -> None:
+    """Assert the tables' key sets never overlap (enables concat)."""
+    _disjoint_groups.append(tuple(t._universe.id for t in tables))
+
+
+def promise_are_equal(*tables: Table) -> None:
+    """Assert the tables share a key set (enables same-universe column use)."""
+    for t in tables[1:]:
+        promise_universes_equal(tables[0], t)
+
+
+def promise_is_subset_of(subset: Table, superset: Table) -> None:
+    promise_universes_equal(subset, superset)

@@ -123,7 +123,11 @@ def parse_debezium(raw: bytes, colnames, dtypes, pk) -> list:
         msg = json.loads(raw)
     except Exception:
         return []
+    if not isinstance(msg, dict):
+        return []  # tombstone (b"null") or non-envelope payload
     payload = msg.get("payload", msg)
+    if not isinstance(payload, dict):
+        return []
     op = payload.get("op", "c")
     out = []
 

@@ -104,24 +104,52 @@ def init_opt_state(params):
 
 
 class JaxDecoderLM:
-    """Host-facing text generator (greedy, bucketed shapes)."""
+    """Host-facing text generator.
 
-    def __init__(self, cfg: DecoderConfig | None = None, seed: int = 0):
+    Greedy decoding over a FIXED padded shape per bucket: causal attention
+    ignores positions after the cursor, so padding the tail keeps results
+    exact while XLA compiles once per bucket instead of once per token.
+    """
+
+    def __init__(self, cfg: DecoderConfig | None = None, seed: int = 0,
+                 seq_buckets=(64, 256, 1024)):
         self.cfg = cfg or DecoderConfig()
         self.params = init_decoder_params(self.cfg, jax.random.PRNGKey(seed))
         from .tokenizer import HashTokenizer
 
         self.tokenizer = HashTokenizer(self.cfg.vocab_size)
-        self._logits = jax.jit(functools.partial(forward_logits, cfg=self.cfg))
+        self.seq_buckets = [b for b in seq_buckets if b <= self.cfg.max_len] or [
+            self.cfg.max_len
+        ]
+
+        def next_token(params, token_ids, pos):
+            logits = forward_logits(params, self.cfg, token_ids)
+            return jnp.argmax(logits[0, pos])
+
+        self._next_token = jax.jit(next_token)
+
+    def _bucket(self, n: int) -> int:
+        for b in self.seq_buckets:
+            if n <= b:
+                return b
+        return self.seq_buckets[-1]
 
     def generate(self, prompt: str, max_new_tokens: int = 32) -> str:
-        ids = self.tokenizer.encode(prompt)[-self.cfg.max_len + max_new_tokens:]
+        ids = self.tokenizer.encode(prompt)
+        keep = self.cfg.max_len - max_new_tokens
+        ids = ids[-max(keep, 1):] or [4]
+        L = self._bucket(len(ids) + max_new_tokens)
+        buf = np.zeros((1, L), np.int32)
+        n = min(len(ids), L)
+        buf[0, :n] = ids[-n:]  # most recent context wins when truncating
         out = []
-        cur = list(ids) or [4]
         for _ in range(max_new_tokens):
-            arr = jnp.asarray([cur[-min(len(cur), self.cfg.max_len):]], jnp.int32)
-            logits = self._logits(self.params, token_ids=arr)
-            nxt = int(jnp.argmax(logits[0, -1]))
+            nxt = int(self._next_token(self.params, jnp.asarray(buf), n - 1))
             out.append(nxt)
-            cur.append(nxt)
+            if n < L:
+                buf[0, n] = nxt
+                n += 1
+            else:
+                buf[0, :-1] = buf[0, 1:]
+                buf[0, -1] = nxt
         return " ".join(f"<{t}>" for t in out)

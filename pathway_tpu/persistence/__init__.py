@@ -58,6 +58,16 @@ class FilesystemBackend(Backend):
         safe = stream.replace("/", "_")
         return os.path.join(self.path, f"{safe}.journal")
 
+    def replace_all(self, stream: str, records: list[bytes]) -> None:
+        """Atomically rewrite a stream (journal compaction)."""
+        p = self._stream_path(stream)
+        tmp = f"{p}.tmp"
+        with open(tmp, "wb") as f:
+            for record in records:
+                f.write(len(record).to_bytes(8, "little"))
+                f.write(record)
+        os.replace(tmp, p)
+
     def append(self, stream: str, record: bytes) -> None:
         with open(self._stream_path(stream), "ab") as f:
             f.write(len(record).to_bytes(8, "little"))
@@ -103,6 +113,9 @@ class MockBackend(Backend):
     def read_all(self, stream):
         return list(self.streams.get(stream, []))
 
+    def replace_all(self, stream, records):
+        self.streams[stream] = list(records)
+
     def put_metadata(self, key, value):
         self.meta[key] = value
 
@@ -138,8 +151,8 @@ def attach_persistence(runner, config: Config) -> None:
     if backend is None:
         return
     lg = runner.lg
-    for op, source in lg.input_ops:
-        stream = f"input_{op.id}"
+    for idx, (op, source) in enumerate(lg.input_ops):
+        stream = _stream_name(idx, source)
         # replay journal through a wrapper source; each journal record is
         # (events, offsets_after) so journal+offsets commit atomically
         journaled = backend.read_all(stream)
@@ -150,7 +163,47 @@ def attach_persistence(runner, config: Config) -> None:
             replayed.extend(events)
             if offsets is not None:
                 last_offsets = offsets
+        # journal compaction (reference: operator_snapshot.rs background
+        # merging): squash the replay into one consolidated record so the
+        # journal doesn't grow with history
+        if len(journaled) > 8 and hasattr(backend, "replace_all"):
+            compacted = _compact_events(replayed)
+            backend.replace_all(
+                stream, [pickle.dumps((compacted, last_offsets))]
+            )
+            replayed = compacted
         _wrap_source_with_persistence(source, backend, stream, replayed, last_offsets)
+
+
+def _stream_name(idx: int, source) -> str:
+    """Stable journal-stream identity across restarts: position among the
+    graph's input operators + the source's descriptor.  (Operator ids are a
+    process-global counter and MUST NOT leak into stream names.)"""
+    import re
+
+    desc = getattr(source, "path", None) or type(source).__name__
+    safe = re.sub(r"[^A-Za-z0-9_.-]", "_", str(desc))[-80:]
+    return f"input_{idx}_{safe}"
+
+
+def _compact_events(events: list) -> list:
+    """Net out insert/retract pairs, keeping the earliest time per survivor."""
+    acc: dict = {}
+    order: list = []
+    for t, key, row, diff in events:
+        entry = acc.get(key)
+        if entry is None:
+            acc[key] = [t, row, diff]
+            order.append(key)
+        else:
+            entry[2] += diff
+            entry[1] = row if diff > 0 else entry[1]
+    out = []
+    for key in order:
+        t, row, diff = acc[key]
+        if diff != 0:
+            out.append((t, key, row, diff))
+    return out
 
 
 def _wrap_source_with_persistence(source, backend: Backend, stream: str,

@@ -72,6 +72,21 @@ def test_wordcount_kill_and_recover(tmp_path):
     assert final == {"alpha": 3, "beta": 2, "gamma": 1}, final
 
 
+def test_replay_survives_source_loss(tmp_path):
+    """After a run with persistence, the journal alone must reproduce the
+    data even if the source file disappears (reference: CachedObjectStorage
+    semantics — re-parsing survives source disappearance)."""
+    src = tmp_path / "words.csv"
+    out1 = tmp_path / "o1.jsonl"
+    out2 = tmp_path / "o2.jsonl"
+    backend = pw.persistence.Backend.filesystem(str(tmp_path / "ps"))
+    src.write_text("word\nalpha\nbeta\nalpha\n")
+    _run_wordcount(src, out1, backend, timeout_s=1.2)
+    src.unlink()  # source gone; journal must carry the rows
+    _run_wordcount(src, out2, backend, timeout_s=1.2)
+    assert _squash_jsonl(out2) == {"alpha": 2, "beta": 1}
+
+
 def test_offsets_prevent_duplicate_reads(tmp_path):
     """Appending to a streamed CSV must not re-emit earlier rows."""
     pg.G.clear()
