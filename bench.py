@@ -53,6 +53,31 @@ def make_corpus(n_docs: int, words_per_doc: int = 48, seed: int = 0) -> list[str
     ]
 
 
+def bench_wordcount(n_rows: int = 200_000, n_words: int = 5_000) -> float:
+    """Engine-side throughput: streaming-wordcount-class groupby ingest
+    (reference headline: integration_tests/wordcount)."""
+    import pathway_tpu as pw
+    from pathway_tpu.debug import table_from_rows
+    from pathway_tpu.engine.runner import run_tables
+    from pathway_tpu.internals import parse_graph as pg
+
+    pg.G.clear()
+    rng = random.Random(0)
+
+    class S(pw.Schema):
+        word: str
+
+    rows = [(f"w{rng.randrange(n_words)}",) for _ in range(n_rows)]
+    t = table_from_rows(S, rows)
+    out = t.groupby(t.word).reduce(t.word, c=pw.reducers.count())
+    t0 = time.perf_counter()
+    [cap] = run_tables(out)
+    el = time.perf_counter() - t0
+    assert len(cap.squash()) == n_words
+    pg.G.clear()
+    return n_rows / el
+
+
 def main() -> None:
     _ensure_healthy_backend()
     import jax
@@ -95,6 +120,8 @@ def main() -> None:
     p50 = statistics.median(lat)
     p95 = sorted(lat)[int(0.95 * len(lat)) - 1]
 
+    wordcount_rps = bench_wordcount()
+
     print(
         json.dumps(
             {
@@ -104,6 +131,7 @@ def main() -> None:
                 "vs_baseline": 1.0,
                 "query_p50_ms": round(p50, 2),
                 "query_p95_ms": round(p95, 2),
+                "wordcount_rows_per_sec": round(wordcount_rps),
                 "n_docs": n_docs,
                 "embed_dim": enc.dimensions,
                 "backend": backend,

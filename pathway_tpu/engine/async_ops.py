@@ -32,11 +32,14 @@ class FullyAsyncRowwise(Operator):
         self.env = env
         self.plan = sync_exprs
         self.async_specs = async_specs  # list of (fun, arg_fns, kwarg_fns, capacity)
-        caps = [c for _f, _a, _k, c in async_specs if c]
-        workers = min(caps) if caps else 8
+        # pool provisions for the sum of per-spec capacities; each spec's
+        # concurrency is bounded by its own semaphore
+        caps = [c if c else 8 for _f, _a, _k, c in async_specs]
         self.pool = ThreadPoolExecutor(
-            max_workers=max(1, workers), thread_name_prefix="pw-async"
+            max_workers=max(1, min(sum(caps) or 8, 64)),
+            thread_name_prefix="pw-async",
         )
+        self._spec_sems = [threading.Semaphore(c) for c in caps]
         self._lock = threading.Lock()
         self._completions: list[tuple[Any, tuple, tuple]] = []  # key, old_row, new_row
         self._outstanding = 0
@@ -92,12 +95,13 @@ class FullyAsyncRowwise(Operator):
 
         def work():
             results = []
-            for fun, args, kwargs in async_args:
+            for si, (fun, args, kwargs) in enumerate(async_args):
                 try:
                     if any(isinstance(a, Error) for a in args):
                         results.append(ERROR)
                         continue
-                    results.append(fun(*args, **kwargs))
+                    with self._spec_sems[si]:
+                        results.append(fun(*args, **kwargs))
                 except Exception:
                     results.append(ERROR)
             new_vals = []
@@ -256,7 +260,6 @@ def lower_async_batch(node, lg):
     env = _env_for(src)
     plan = []
     specs = []
-    deterministic = True
     for e in p["exprs"]:
         spec = getattr(e, "_async_spec", None)
         if spec is not None:
@@ -268,10 +271,13 @@ def lower_async_batch(node, lg):
                  ex.capacity, ex.timeout, ex.retry_strategy, cache, name)
             )
             plan.append(("async", idx))
-            deterministic = deterministic and e._deterministic
         else:
             plan.append(("sync", e._eval))
-    return AsyncBatchRowwise(env, plan, specs, deterministic=deterministic)
+    # determinism must cover ALL columns (a non-deterministic sync column
+    # recomputed on retraction would fail to cancel its insertion)
+    return AsyncBatchRowwise(
+        env, plan, specs, deterministic=p.get("deterministic", False)
+    )
 
 
 def lower_fully_async(node, lg):

@@ -167,16 +167,17 @@ class Scheduler:
 
     def finish(self) -> None:
         self.run_until_idle()
-        # two-phase shutdown: interior operators first (they may emit final
-        # batches, e.g. async resolutions / buffered releases), drain, THEN
-        # sinks — so a subscriber's on_end truly means end-of-stream
+        # two-phase shutdown: interior operators first in topo order, draining
+        # after each so downstream operators see upstream final batches BEFORE
+        # their own on_end (async resolutions feeding a buffer, etc.); sinks
+        # last — a subscriber's on_end truly means end-of-stream
         sinks = []
         for op in self.topo_order():
             if op.downstream:
                 op.on_end()
+                self.run_until_idle()
             else:
                 sinks.append(op)
-        self.run_until_idle()
         for op in sinks:
             op.on_end()
         self.run_until_idle()

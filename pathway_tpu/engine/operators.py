@@ -429,6 +429,7 @@ class GroupbyOperator(Operator):
         n_out_gvals: int | None = None,
         key_fn: Callable | None = None,
         sort_fn: Callable | None = None,
+        simple_spec: tuple | None = None,
         name: str = "",
     ):
         super().__init__(name)
@@ -438,14 +439,69 @@ class GroupbyOperator(Operator):
         self.key_fn = key_fn
         self.sort_fn = sort_fn
         self.reducer_specs = reducers
+        # columnar fast path: (gb_positions, [("count",)|("sum",pos)|("avg",pos)])
+        self.simple_spec = simple_spec
+        self._gkey_cache: dict[tuple, Key] = {}
         # gkey -> (gvals, [ReducerState], count)
         self.groups: dict[Key, list] = {}
         self.last_out: dict[Key, Row] = {}
         self._dirty: set[Key] = set()
 
+    def _process_bulk(self, updates) -> bool:
+        """Columnar ingest for plain-column groupings with count/sum/avg
+        reducers: one state update per touched group per batch instead of
+        one per row (the wordcount hot path)."""
+        gb_pos, red_plan = self.simple_spec
+        acc: dict[tuple, list] = {}
+        try:
+            for key, row, diff in updates:
+                gvals = tuple(row[p] for p in gb_pos)
+                entry = acc.get(gvals)
+                if entry is None:
+                    # int zeros so integer sums stay int (type parity with
+                    # the row path)
+                    entry = acc[gvals] = [0, [0] * len(red_plan)]
+                entry[0] += diff
+                sums = entry[1]
+                for i, spec in enumerate(red_plan):
+                    if spec[0] != "count":
+                        v = row[spec[1]]
+                        if v is None or isinstance(v, Error):
+                            return False  # slow path handles skips/poison
+                        sums[i] += v * diff
+        except TypeError:
+            return False  # unhashable group values
+        from . import reducers_impl
+
+        for gvals, (total_diff, sums) in acc.items():
+            gkey = self._gkey_cache.get(gvals)
+            if gkey is None:
+                gkey = ref_scalar(*gvals)
+                if len(self._gkey_cache) < 1_000_000:
+                    self._gkey_cache[gvals] = gkey
+            group = self.groups.get(gkey)
+            if group is None:
+                states = [
+                    reducers_impl.make_state(rid, kw)
+                    for rid, _, kw in self.reducer_specs
+                ]
+                group = [gvals, states, 0]
+                self.groups[gkey] = group
+            group[2] += total_diff
+            for st, spec, ws in zip(group[1], red_plan, sums):
+                st.bulk_add(total_diff, ws if spec[0] != "count" else None)
+            self._dirty.add(gkey)
+        return True
+
     def process(self, port, updates, time):
         from . import reducers_impl
 
+        if (
+            self.simple_spec is not None
+            and len(updates) >= 64
+            and self._process_bulk(updates)
+        ):
+            return
         for key, row, diff in updates:
             e = self.env.build(key, row)
             gvals = tuple(f(e) for f in self.gb_fns)

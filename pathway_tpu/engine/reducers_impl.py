@@ -64,6 +64,10 @@ class CountState(ReducerState):
     def _update(self, args, diff, time, key):
         self.count += diff
 
+    def bulk_add(self, total_diff: int, _weighted_sum=None) -> None:
+        """Columnar fast path: fold a whole batch's net diff at once."""
+        self.count += total_diff
+
     def _value(self):
         return self.count
 
@@ -90,6 +94,13 @@ class SumState(ReducerState):
         else:
             self.total += v * diff
         self.count += diff
+
+    def bulk_add(self, total_diff: int, weighted_sum) -> None:
+        """Columnar fast path: weighted_sum = sum(v_i * diff_i) for the batch."""
+        if isinstance(self.total, int) and isinstance(weighted_sum, float):
+            self.total = float(self.total)
+        self.total += weighted_sum
+        self.count += total_diff
 
     def _value(self):
         return self.total

@@ -34,6 +34,42 @@ class LoweredGraph:
         self.output_callbacks: list[Callable[[], None]] = []
 
 
+def _groupby_simple_spec(src: Table, p: dict):
+    """Columnar-ingest plan for plain-column groupbys with count/sum/avg
+    reducers; None when anything needs the row interpreter."""
+    from ..internals.expression import ColumnReference
+
+    if p.get("id_expr") is not None or p.get("sort_by") is not None:
+        return None
+    if p.get("instance") is not None:
+        return None
+    positions = {n: i for i, n in enumerate(src._colnames)}
+
+    def pos_of(e):
+        if isinstance(e, ColumnReference) and e._table is src and e._name in positions:
+            return positions[e._name]
+        return None
+
+    gb_pos = []
+    for e in p["gb_exprs"]:
+        i = pos_of(e)
+        if i is None:
+            return None
+        gb_pos.append(i)
+    red_plan = []
+    for rid, args, kw in p["reducers"]:
+        if rid == "count":
+            red_plan.append(("count",))
+        elif rid in ("sum", "avg") and len(args) == 1:
+            i = pos_of(args[0])
+            if i is None:
+                return None
+            red_plan.append((rid, i))
+        else:
+            return None
+    return (gb_pos, red_plan)
+
+
 def _env_for(table: Table) -> ops.EnvBuilder:
     positions = {(id(table), n): i for i, n in enumerate(table._colnames)}
     if table._aliases:
@@ -149,6 +185,7 @@ def _make_operator(node: pg.OpNode, lg: LoweredGraph) -> Operator:
             n_out_gvals=n_out,
             key_fn=_compile(p["id_expr"]) if p.get("id_expr") is not None else None,
             sort_fn=_compile(p["sort_by"]) if p.get("sort_by") is not None else None,
+            simple_spec=_groupby_simple_spec(src, p),
             name="groupby",
         )
 

@@ -5,12 +5,13 @@ from __future__ import annotations
 
 from .table import Table, promise_universes_equal
 
-_disjoint_groups: list[tuple[int, ...]] = []
-
 
 def promise_are_pairwise_disjoint(*tables: Table) -> None:
-    """Assert the tables' key sets never overlap (enables concat)."""
-    _disjoint_groups.append(tuple(t._universe.id for t in tables))
+    """Advisory promise that the tables' key sets never overlap.
+
+    concat trusts the caller (as the reference trusts this promise); key
+    collisions surface at sinks via squash() multiplicity checks, so the
+    promise carries no runtime state here."""
 
 
 def promise_are_equal(*tables: Table) -> None:

@@ -187,22 +187,25 @@ def _stream_name(idx: int, source) -> str:
 
 
 def _compact_events(events: list) -> list:
-    """Net out insert/retract pairs, keeping the earliest time per survivor."""
+    """Net out insert/retract pairs per (key, row), keeping the earliest time
+    per survivor — the replayed multiset is exactly the original's net."""
+    from ..engine.types import _hashable_row
+
     acc: dict = {}
     order: list = []
     for t, key, row, diff in events:
-        entry = acc.get(key)
+        hk = (key, _hashable_row(row))
+        entry = acc.get(hk)
         if entry is None:
-            acc[key] = [t, row, diff]
-            order.append(key)
+            acc[hk] = [t, row, diff]
+            order.append(hk)
         else:
             entry[2] += diff
-            entry[1] = row if diff > 0 else entry[1]
     out = []
-    for key in order:
-        t, row, diff = acc[key]
+    for hk in order:
+        t, row, diff = acc[hk]
         if diff != 0:
-            out.append((t, key, row, diff))
+            out.append((t, hk[0], row, diff))
     return out
 
 

@@ -7,6 +7,17 @@ if "xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", "")
         os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
     ).strip()
 
+# jax may already be imported (a site hook can pre-import it with a TPU
+# platform captured from the pre-conftest environment); force CPU through
+# the live config so no test can block on device-claim I/O
+if "jax" in __import__("sys").modules:
+    try:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass
+
 import pytest
 
 
