@@ -101,22 +101,37 @@ def table_from_markdown(
 
     events = []
     auto_id = itertools.count()
+    parsed_rows = []
     for ln in rows_txt:
         parts = split(ln)
         # a trailing pipe leaves one extra empty cell
         if len(parts) > len(colnames) + 1 and parts[-1] == "":
             parts = parts[:-1]
-        if len(parts) == len(colnames) + 1:
-            if parts[-1] == "" and not has_id:
-                rid = None
-                parts = parts[:-1]
-            else:
-                rid = parts[0]
-                parts = parts[1:]
-        elif len(parts) == len(colnames):
-            rid = None
+        parsed_rows.append(parts)
+    # id-column detection is per TABLE and must be consistent: every row
+    # carries exactly one extra leading field (a single malformed row must
+    # raise, not silently flip the interpretation)
+    if not has_id and parsed_rows:
+        has_id = all(len(p) == len(colnames) + 1 for p in parsed_rows) and any(
+            p[-1] != "" for p in parsed_rows
+        )
+    for ln, parts in zip(rows_txt, parsed_rows):
+        if has_id:
+            if len(parts) != len(colnames) + 1:
+                raise ValueError(
+                    f"row {ln!r} has {len(parts)} fields, expected "
+                    f"{len(colnames) + 1} (id + columns)"
+                )
+            rid = parts[0]
+            parts = parts[1:]
         else:
-            raise ValueError(f"row {ln!r} has {len(parts)} fields, expected {len(colnames)}")
+            rid = None
+            if len(parts) == len(colnames) + 1 and parts[-1] == "":
+                parts = parts[:-1]
+            if len(parts) != len(colnames):
+                raise ValueError(
+                    f"row {ln!r} has {len(parts)} fields, expected {len(colnames)}"
+                )
         values = dict(zip(colnames, [_parse_scalar(p) for p in parts]))
         t = int(values.pop("__time__", 0))
         diff = int(values.pop("__diff__", 1))

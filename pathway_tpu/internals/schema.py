@@ -166,17 +166,31 @@ def schema_from_pandas(
     cols: dict[str, ColumnDefinition] = {}
     for col in df.columns:
         np_dt = df[col].dtype
-        if np.issubdtype(np_dt, np.integer):
+        try:
+            kind = np.dtype(np_dt).kind
+        except TypeError:
+            kind = getattr(np_dt, "kind", "O")  # pandas extension dtypes
+        if kind in "iu":
             d = dt.INT
-        elif np.issubdtype(np_dt, np.floating):
+        elif kind == "f":
             d = dt.FLOAT
-        elif np.issubdtype(np_dt, np.bool_):
+        elif kind == "b":
             d = dt.BOOL
-        elif np.issubdtype(np_dt, np.datetime64):
-            d = dt.DATE_TIME_NAIVE
+        elif kind == "M":
+            # tz-aware pandas datetimes are UTC-kind, naive otherwise
+            d = (
+                dt.DATE_TIME_UTC
+                if getattr(np_dt, "tz", None) is not None
+                else dt.DATE_TIME_NAIVE
+            )
         else:
             inferred = {dt.dtype_of_value(v) for v in df[col] if v is not None}
             d = dt.lub(*inferred) if inferred else dt.ANY
+        try:
+            if df[col].isna().any():
+                d = dt.optional(d)
+        except (TypeError, ValueError):
+            pass
         cols[str(col)] = ColumnDefinition(
             dtype=d, primary_key=bool(id_from and col in id_from)
         )

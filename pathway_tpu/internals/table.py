@@ -315,13 +315,33 @@ class Table:
     def restrict(self, other: "Table") -> "Table":
         return self.with_universe_of(other)
 
-    def having(self, *indexers: ColumnReference) -> "Table":
+    def having(self, *indexers) -> "Table":
+        """Keep rows whose indexer pointers resolve in their target table
+        (reference: Table.having).  Indexers are pointer expressions — e.g.
+        `target.pointer_from(self.key)` — whose target table is the
+        expression's owner; a plain column reference indexes into its own
+        table."""
         out = self
         for indexer in indexers:
-            target = indexer.table
-            marker = target.select(__pw_present=True)
-            looked = marker.ix(indexer, optional=True)
-            out = out.filter(looked.__pw_present.is_not_none())
+            expr = self._desugar(indexer)
+            if isinstance(expr, PointerExpression):
+                target = expr._table
+            elif isinstance(expr, ColumnReference):
+                if expr.table is not self:
+                    raise ValueError(
+                        "having() with a plain column reference requires a "
+                        "column of this table; use "
+                        "target.pointer_from(...) to name the target table"
+                    )
+                target = self
+            else:
+                raise ValueError(
+                    "having() indexers must be pointer_from(...) expressions "
+                    "or column references"
+                )
+            marker = target.select(_pw_present=True)
+            looked = marker.ix(expr, optional=True, context=self)
+            out = out.filter(looked["_pw_present"].is_not_none())
         return out
 
     # ------------------------------------------------------------------

@@ -16,19 +16,31 @@ _WORD = re.compile(r"\w+|[^\w\s]")
 
 
 class HashTokenizer:
-    def __init__(self, vocab_size: int = 32768, lowercase: bool = True):
+    def __init__(self, vocab_size: int = 32768, lowercase: bool = True,
+                 cache_size: int = 1 << 18):
         self.vocab_size = vocab_size
         self.lowercase = lowercase
+        # natural-language word frequency is zipfian: a bounded word->id
+        # cache removes nearly all stable-hash invocations on the hot path
+        self._cache: dict[str, int] = {}
+        self._cache_size = cache_size
 
     def tokenize(self, text: str) -> list[str]:
         if self.lowercase:
             text = text.lower()
         return _WORD.findall(text or "")
 
+    def _id(self, w: str) -> int:
+        tid = self._cache.get(w)
+        if tid is None:
+            # ids 0..3 reserved (pad/unk/cls/sep)
+            tid = 4 + (hash_values("#tok", w) % (self.vocab_size - 4))
+            if len(self._cache) < self._cache_size:
+                self._cache[w] = tid
+        return tid
+
     def encode(self, text: str) -> list[int]:
-        # ids 0..3 reserved (pad/unk/cls/sep)
-        return [4 + (hash_values("#tok", w) % (self.vocab_size - 4))
-                for w in self.tokenize(text)]
+        return [self._id(w) for w in self.tokenize(text)]
 
     def count_tokens(self, text: str) -> int:
         return len(self.tokenize(text))

@@ -1,0 +1,204 @@
+"""Expression namespace + misc stdlib coverage
+(reference model: python/pathway/tests/expressions/)."""
+
+import datetime
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.debug import table_from_markdown, table_from_pandas, table_to_pandas
+
+from .utils import run_and_squash
+
+
+def test_str_namespace():
+    t = table_from_markdown(
+        """
+        | s
+      1 | "Hello World"
+        """
+    )
+    out = t.select(
+        lower=t.s.str.lower(),
+        upper=t.s.str.upper(),
+        n=t.s.str.len(),
+        sw=t.s.str.startswith("Hello"),
+        rep=t.s.str.replace("World", "TPU"),
+        parts=t.s.str.split(" "),
+        rev=t.s.str.reversed(),
+    )
+    [(row)] = run_and_squash(out).values()
+    assert row == (
+        "hello world", "HELLO WORLD", 11, True, "Hello TPU",
+        ("Hello", "World"), "dlroW olleH",
+    )
+
+
+def test_str_parse():
+    t = table_from_markdown(
+        """
+        | s
+      1 | "42"
+      2 | "x"
+        """
+    )
+    out = t.select(v=t.s.str.parse_int(optional=True))
+    vals = sorted(run_and_squash(out).values(), key=repr)
+    assert vals == [(42,), (None,)]
+
+
+def test_dt_namespace():
+    import pandas as pd
+
+    df = pd.DataFrame({"ts": [pd.Timestamp("2024-03-05 10:30:45")]})
+    t = table_from_pandas(df)
+    out = t.select(
+        y=t.ts.dt.year(),
+        m=t.ts.dt.month(),
+        d=t.ts.dt.day(),
+        h=t.ts.dt.hour(),
+        fl=t.ts.dt.floor(datetime.timedelta(hours=1)),
+        s=t.ts.dt.strftime("%Y-%m-%d"),
+    )
+    [(y, m, d, h, fl, s)] = run_and_squash(out).values()
+    assert (y, m, d, h) == (2024, 3, 5, 10)
+    assert fl == datetime.datetime(2024, 3, 5, 10, 0, 0)
+    assert s == "2024-03-05"
+
+
+def test_duration_arithmetic():
+    import pandas as pd
+
+    df = pd.DataFrame(
+        {"a": [pd.Timestamp("2024-01-01 00:00:00")],
+         "b": [pd.Timestamp("2024-01-02 06:00:00")]}
+    )
+    t = table_from_pandas(df)
+    out = t.select(
+        delta_h=(t.b - t.a).dt.hours(),
+        shifted=t.a + datetime.timedelta(days=1),
+    )
+    [(dh, sh)] = run_and_squash(out).values()
+    assert dh == 30
+    assert sh == datetime.datetime(2024, 1, 2)
+
+
+def test_num_namespace():
+    t = table_from_markdown(
+        """
+        | x
+      1 | 2.0
+        """
+    )
+    out = t.select(
+        r=t.x.num.sqrt(),
+        f=(t.x * 3.7).num.floor(),
+        c=(t.x * 3.7).num.ceil(),
+    )
+    [(r, f, c)] = run_and_squash(out).values()
+    assert abs(r - 2 ** 0.5) < 1e-9
+    assert (f, c) == (7, 8)
+
+
+def test_json_expressions():
+    from pathway_tpu.internals.value import Json
+
+    import pandas as pd
+
+    df = pd.DataFrame({"j": [Json({"a": {"b": 5}, "arr": [1, 2, 3]})]})
+    t = table_from_pandas(df)
+    out = t.select(
+        b=t.j["a"]["b"].as_int(),
+        first=t.j["arr"][0].as_int(),
+        missing=t.j.get("nope", Json(0)).as_int(),
+    )
+    [(b, first, missing)] = run_and_squash(out).values()
+    assert (b, first, missing) == (5, 1, 0)
+
+
+def test_make_tuple_and_get():
+    t = table_from_markdown(
+        """
+        | a | b
+      1 | 1 | 2
+        """
+    )
+    out = t.select(tup=pw.make_tuple(t.a, t.b, t.a + t.b))
+    out2 = out.select(last=out.tup[2], second=out.tup.get(1), oob=out.tup.get(9, -1))
+    [(last, second, oob)] = run_and_squash(out2).values()
+    assert (last, second, oob) == (3, 2, -1)
+
+
+def test_pandas_roundtrip():
+    import pandas as pd
+
+    df = pd.DataFrame({"a": [3, 1, 2], "s": ["x", "y", "z"]})
+    t = table_from_pandas(df)
+    out_df = table_to_pandas(t.select(a2=t.a * 2, s=t.s), include_id=False)
+    assert sorted(out_df["a2"]) == [2, 4, 6]
+
+
+def test_having():
+    target = table_from_markdown(
+        """
+        k | v
+        1 | 100
+        """,
+        id_from=["k"],
+    )
+    src = table_from_markdown(
+        """
+        | ptr
+      5 | 1
+      6 | 2
+        """
+    )
+    kept = src.having(target.pointer_from(src.ptr))
+    state = run_and_squash(kept)
+    assert len(state) == 1
+    assert list(state.values()) == [(1,)]
+
+
+def test_interpolate():
+    t = table_from_markdown(
+        """
+        | ts | v
+      1 | 0  | 0.0
+      2 | 5  |
+      3 | 10 | 10.0
+        """
+    )
+    out = t.interpolate(t.ts, t.v)
+    state = run_and_squash(out)
+    by_ts = {r[0]: r[1] for r in state.values()}
+    assert by_ts[5] == 5.0
+
+
+def test_apply_with_type_and_declare():
+    t = table_from_markdown(
+        """
+        | a
+      1 | 2
+        """
+    )
+    e = pw.apply_with_type(lambda x: x + 0.5, float, t.a)
+    out = t.select(v=e)
+    assert out._dtypes["v"].name == "FLOAT"
+
+
+def test_concat_same_columns_different_order():
+    t1 = table_from_markdown(
+        """
+        | a | b
+      1 | 1 | x
+        """
+    )
+    t2 = table_from_markdown(
+        """
+        | b | a
+      5 | y | 2
+        """
+    )
+    out = t1.concat_reindex(t2)
+    vals = sorted(run_and_squash(out).values())
+    assert vals == [(1, "x"), (2, "y")]
