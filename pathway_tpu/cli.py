@@ -21,7 +21,13 @@ MAX_PROCESSES = 64
 
 
 def _spawn_once(program: list[str], threads: int, processes: int, first_port: int) -> int:
-    """Run the program as `processes` cooperating OS processes."""
+    """Run the program as `processes` cooperating OS processes.
+
+    A rescale exit code (10/12) from ANY worker terminates the others so the
+    supervisor can respawn the whole cluster at the new size.
+    """
+    import time
+
     env_base = dict(os.environ)
     env_base["PATHWAY_THREADS"] = str(threads)
     env_base["PATHWAY_PROCESSES"] = str(processes)
@@ -35,10 +41,23 @@ def _spawn_once(program: list[str], threads: int, processes: int, first_port: in
         env["PATHWAY_PROCESS_ID"] = str(pid)
         procs.append(subprocess.Popen(program, env=env))
     code = 0
-    for p in procs:
-        rc = p.wait()
-        if rc != 0:
-            code = rc
+    running = list(procs)
+    while running:
+        for p in list(running):
+            rc = p.poll()
+            if rc is None:
+                continue
+            running.remove(p)
+            if rc in (EXIT_CODE_DOWNSCALE, EXIT_CODE_UPSCALE):
+                # propagate the rescale to the whole cluster
+                for q in running:
+                    q.terminate()
+                for q in running:
+                    q.wait()
+                return rc
+            if rc != 0:
+                code = rc
+        time.sleep(0.1)
     return code
 
 

@@ -327,7 +327,16 @@ class GraphRunner:
         logical = sched.frontier + 2 if sched.frontier >= 0 else 0
         if logical % 2:
             logical += 1
+        import os as _os
+
+        tracker = None
+        if _os.environ.get("PATHWAY_ELASTIC") == "1":
+            from .telemetry import WorkloadTracker
+
+            tracker = WorkloadTracker()
+        rescale_code: int | None = None
         while live and len(finished) < len(live):
+            loop_t0 = _time.monotonic()
             got_any = False
             for op, source in live:
                 if op.id in finished:
@@ -344,6 +353,7 @@ class GraphRunner:
             has_completions = any(
                 getattr(op, "_completions", None) for op in sched.operators
             )
+            slept = 0.0
             if got_any or has_completions:
                 if not got_any:
                     # schedule an empty time so every operator's flush runs
@@ -353,15 +363,40 @@ class GraphRunner:
                 logical += 2
                 last_event = _time.monotonic()
             else:
-                _time.sleep(autocommit_ms / 1000.0)
+                slept = autocommit_ms / 1000.0
+                _time.sleep(slept)
             now = _time.monotonic()
+            if tracker is not None:
+                # busy fraction = non-sleep time / loop time (work in poll,
+                # scheduling, and async completion handling all count)
+                loop_el = max(now - loop_t0, 1e-9)
+                tracker.record(max(0.0, min(1.0, (loop_el - slept) / loop_el)))
+                code = tracker.recommendation()
+                if code is not None:
+                    from .telemetry import WorkloadTracker as _WT
+
+                    n_procs = int(_os.environ.get("PATHWAY_PROCESSES", "1"))
+                    if code == _WT.EXIT_CODE_DOWNSCALE and n_procs <= 1:
+                        pass  # already at minimum; keep running
+                    else:
+                        rescale_code = code
+                        break
             if timeout_s is not None and now - start > timeout_s:
                 break
             if idle_stop_s is not None and now - last_event > idle_stop_s:
                 break
+        # graceful drain even on rescale: flush buffered sink output first
         for op in self.lg.scheduler.topo_order():
             op.on_end()
         sched.run_until_idle()
+        if rescale_code is not None:
+            import sys as _sys
+
+            print(
+                f"[pathway-tpu] workload tracker requests rescale "
+                f"(exit {rescale_code})", file=_sys.stderr,
+            )
+            _sys.exit(rescale_code)
         return self.lg.captures
 
 

@@ -36,23 +36,50 @@ class MetricsServer:
             lines.append(f"pathway_operator_rows_total{{{labels},direction=\"out\"}} {op.rows_out}")
         return "\n".join(lines) + "\n"
 
+    def render_dashboard(self) -> str:
+        """Minimal live dashboard (reference: python/pathway/web_dashboard/)."""
+        rows = "".join(
+            f"<tr><td>{op.name}</td><td>{op.id}</td><td>{op.rows_in}</td>"
+            f"<td>{op.rows_out}</td></tr>"
+            for op in self.scheduler.operators
+        )
+        return (
+            "<html><head><title>pathway-tpu</title>"
+            '<meta http-equiv="refresh" content="2">'
+            "<style>body{font-family:monospace;background:#111;color:#ddd}"
+            "table{border-collapse:collapse}td,th{border:1px solid #444;"
+            "padding:4px 10px}</style></head><body>"
+            f"<h2>pathway-tpu &middot; frontier={self.scheduler.frontier} "
+            f"&middot; uptime={time.time() - self.started_at:.0f}s</h2>"
+            "<table><tr><th>operator</th><th>id</th><th>rows in</th>"
+            f"<th>rows out</th></tr>{rows}</table>"
+            '<p><a href="/metrics">/metrics</a></p></body></html>'
+        )
+
     def start(self) -> None:
         if self._server is not None:
             return
         render = self.render
+
+        render_html = self.render_dashboard
 
         class Handler(BaseHTTPRequestHandler):
             def log_message(self, *args):
                 pass
 
             def do_GET(self):
-                if self.path != "/metrics":
+                if self.path == "/metrics":
+                    body = render().encode()
+                    ctype = "text/plain; version=0.0.4"
+                elif self.path in ("/", "/dashboard"):
+                    body = render_html().encode()
+                    ctype = "text/html"
+                else:
                     self.send_response(404)
                     self.end_headers()
                     return
-                body = render().encode()
                 self.send_response(200)
-                self.send_header("Content-Type", "text/plain; version=0.0.4")
+                self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
@@ -94,6 +121,44 @@ class ProgressReporter:
 
     def stop(self) -> None:
         self._stop.set()
+
+
+class WorkloadTracker:
+    """Elastic-scaling signal (reference: src/engine/workload_tracker.rs:30 +
+    cli.py exit codes 10/12): tracks the busy fraction of the streaming loop
+    over a window and recommends down/up-scaling.
+
+    Enabled with PATHWAY_ELASTIC=1; the `pathway-tpu spawn` supervisor
+    restarts with 0.5x/2x processes on the corresponding exit codes.
+    """
+
+    # canonical protocol constants live in cli.py
+    from ..cli import EXIT_CODE_DOWNSCALE, EXIT_CODE_UPSCALE  # noqa: F401
+
+    def __init__(self, window_s: float = 30.0, low: float = 0.2, high: float = 0.9):
+        self.window_s = window_s
+        self.low = low
+        self.high = high
+        self.samples: list[tuple[float, float]] = []  # (ts, busy_fraction)
+        self.started = time.time()
+
+    def record(self, busy_fraction: float) -> None:
+        now = time.time()
+        self.samples.append((now, busy_fraction))
+        cutoff = now - self.window_s
+        while self.samples and self.samples[0][0] < cutoff:
+            self.samples.pop(0)
+
+    def recommendation(self) -> int | None:
+        """None, or an exit code requesting rescale."""
+        if time.time() - self.started < self.window_s or not self.samples:
+            return None
+        avg = sum(b for _t, b in self.samples) / len(self.samples)
+        if avg < self.low:
+            return self.EXIT_CODE_DOWNSCALE
+        if avg > self.high:
+            return self.EXIT_CODE_UPSCALE
+        return None
 
 
 class ErrorLog:
