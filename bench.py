@@ -99,16 +99,52 @@ def main() -> None:
     enc.embed_batch(docs[:batch])
     enc.embed_batch([docs[0]])
 
+    # ingest through the REAL pipeline: docs table -> batched on-device
+    # embedder UDF -> live KNN index (the DocumentStore path)
+    import pathway_tpu as pw
+    from pathway_tpu.debug import table_from_rows
+    from pathway_tpu.engine.runner import run_tables
+    from pathway_tpu.internals import parse_graph as pg
+    from pathway_tpu.stdlib.indexing import BruteForceKnnFactory
+    from pathway_tpu.xpacks.llm.embedders import BaseEmbedder
+
+    pg.G.clear()
+
+    class DocSchema(pw.Schema):
+        text: str
+
+    doc_table = table_from_rows(DocSchema, [(d,) for d in docs])
+
+    class _Emb(BaseEmbedder):
+        """The real embedder UDF wiring over the pre-warmed encoder."""
+
+        def _embed(self, text):
+            return enc.embed(text)
+
+        def _embed_many(self, texts):
+            return list(enc.embed_batch(texts))
+
+    embedded = doc_table.select(text=doc_table.text, vec=_Emb()(doc_table.text))
+    data_index = BruteForceKnnFactory(dimensions=enc.dimensions).build_index(
+        embedded.vec, embedded
+    )
+
+    class QSchema(pw.Schema):
+        qv: object
+
+    probe = table_from_rows(QSchema, [(enc.embed(docs[0]),)])
+    reply = data_index.query(probe.qv, number_of_matches=1)
+
     t0 = time.perf_counter()
-    key = 0
-    for i in range(0, n_docs, batch):
-        chunk = docs[i : i + batch]
-        vecs = enc.embed_batch(chunk)
-        for v in vecs:
-            index.add(key, v)
-            key += 1
+    caps = run_tables(reply, embedded)
     t1 = time.perf_counter()
+    assert len(caps[0].squash()) == 1
     docs_per_sec = n_docs / (t1 - t0)
+    # the serving-latency loop searches over the same embedded corpus
+    for key, row in caps[1].squash().items():
+        index.add(int(key), row[1])
+    assert index.n == n_docs
+    pg.G.clear()
 
     queries = make_corpus(n_queries, seed=123)
     lat = []

@@ -32,6 +32,7 @@ def _spawn_once(program: list[str], threads: int, processes: int, first_port: in
     env_base["PATHWAY_THREADS"] = str(threads)
     env_base["PATHWAY_PROCESSES"] = str(processes)
     env_base["PATHWAY_FIRST_PORT"] = str(first_port)
+    env_base["PATHWAY_SPAWNED"] = "1"  # rescale exits only fire under a supervisor
     if processes == 1:
         env_base["PATHWAY_PROCESS_ID"] = "0"
         return subprocess.call(program, env=env_base)

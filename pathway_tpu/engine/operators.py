@@ -71,6 +71,18 @@ class StatelessRowwise(Operator):
         self.n_in_cols = n_in_cols
         self._plan = ...  # compiled lazily; None = unsupported
         self._raw_exprs = raw_exprs
+        # device-UDF batching: columns whose top-level Apply carries batch_fn
+        self._batched: list[tuple[int, Any]] | None = None
+        if raw_exprs is not None:
+            from ..internals.expression import ApplyExpression
+
+            batched = [
+                (i, e) for i, e in enumerate(raw_exprs)
+                if isinstance(e, ApplyExpression)
+                and e._batch_fn is not None
+                and not e._kwargs  # batch_fn contract covers positional args only
+            ]
+            self._batched = batched or None
 
     def _get_plan(self):
         if self._plan is ...:
@@ -82,8 +94,58 @@ class StatelessRowwise(Operator):
                 self._plan = vectorize.compile_plan(self._raw_exprs, self.env.positions)
         return self._plan
 
+    def _process_batched_apply(self, updates, time) -> None:
+        """Evaluate batch_fn columns with ONE call per micro-batch (the
+        pad->jit->scatter device path); other columns row-evaluate."""
+        build = self.env.build
+        envs = [build(k, r) for k, r, _d in updates]
+        n = len(updates)
+        out_cols: dict[int, list] = {}
+        for i, e in self._batched:
+            arg_lists = []
+            ok_idx = []
+            results: list = [None] * n
+            for j, env in enumerate(envs):
+                vals = [a._eval(env) for a in e._args]
+                if any(isinstance(v, Error) for v in vals):
+                    results[j] = ERROR
+                elif e._propagate_none and any(v is None for v in vals):
+                    results[j] = None
+                else:
+                    arg_lists.append(vals[0] if len(vals) == 1 else tuple(vals))
+                    ok_idx.append(j)
+            if ok_idx:
+                try:
+                    batch_out = list(e._batch_fn(arg_lists))
+                    if len(batch_out) != len(ok_idx):
+                        raise ValueError(
+                            f"batch_fn returned {len(batch_out)} results for "
+                            f"{len(ok_idx)} inputs"
+                        )
+                except Exception:
+                    # per-row fallback: only genuinely-failing rows poison,
+                    # with error-log provenance (parity with the row path)
+                    batch_out = [e._eval(envs[j]) for j in ok_idx]
+                for j, v in zip(ok_idx, batch_out):
+                    results[j] = v
+            out_cols[i] = results
+        out: list[Update] = []
+        for j, (key, row, diff) in enumerate(updates):
+            vals = []
+            for i, f in enumerate(self.exprs):
+                if i in out_cols:
+                    vals.append(out_cols[i][j])
+                else:
+                    vals.append(f(envs[j]))
+            out.append((key, tuple(vals), diff))
+        self.emit(time, out)
+
     def process(self, port, updates, time):
         from .vectorize import VEC_THRESHOLD, try_columns
+
+        if self._batched is not None and len(updates) > 1:
+            self._process_batched_apply(updates, time)
+            return
 
         plan = self._get_plan() if len(updates) >= VEC_THRESHOLD else None
         if plan is not None:

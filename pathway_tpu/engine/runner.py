@@ -373,14 +373,19 @@ class GraphRunner:
                 tracker.record(max(0.0, min(1.0, (loop_el - slept) / loop_el)))
                 code = tracker.recommendation()
                 if code is not None:
+                    from ..cli import MAX_PROCESSES
                     from .telemetry import WorkloadTracker as _WT
 
                     n_procs = int(_os.environ.get("PATHWAY_PROCESSES", "1"))
-                    if code == _WT.EXIT_CODE_DOWNSCALE and n_procs <= 1:
-                        pass  # already at minimum; keep running
-                    else:
+                    supervised = _os.environ.get("PATHWAY_SPAWNED") == "1"
+                    at_min = code == _WT.EXIT_CODE_DOWNSCALE and n_procs <= 1
+                    at_max = (
+                        code == _WT.EXIT_CODE_UPSCALE and n_procs >= MAX_PROCESSES
+                    )
+                    if supervised and not at_min and not at_max:
                         rescale_code = code
                         break
+                    # standalone or at a bound: keep running
             if timeout_s is not None and now - start > timeout_s:
                 break
             if idle_stop_s is not None and now - last_event > idle_stop_s:

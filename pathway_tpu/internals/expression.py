@@ -459,6 +459,7 @@ class ApplyExpression(ColumnExpression):
         propagate_none: bool = False,
         deterministic: bool = True,
         max_batch_size: int | None = None,
+        batch_fn: Callable | None = None,
     ):
         self._fun = fun
         self._dtype = dt.wrap(return_type)
@@ -467,6 +468,9 @@ class ApplyExpression(ColumnExpression):
         self._propagate_none = propagate_none
         self._deterministic = deterministic
         self._max_batch_size = max_batch_size
+        # batch_fn([v0, v1, ...]) -> [r0, r1, ...]: one call per micro-batch
+        # (the device-UDF hook: pad -> jit forward -> scatter back)
+        self._batch_fn = batch_fn
 
     def _dependencies(self):
         for a in self._args:

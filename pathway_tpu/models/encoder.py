@@ -115,7 +115,9 @@ class JaxEncoder:
                  seq_buckets=(32, 128, 512), batch_buckets=(1, 8, 64, 256)):
         self.cfg = cfg or EncoderConfig()
         self.params = init_params(self.cfg, jax.random.PRNGKey(seed))
-        self.seq_buckets = [b for b in seq_buckets if b <= self.cfg.max_len]
+        self.seq_buckets = [b for b in seq_buckets if b <= self.cfg.max_len] or [
+            self.cfg.max_len
+        ]
         self.batch_buckets = list(batch_buckets)
         self._fwd = jax.jit(functools.partial(encode, cfg=self.cfg))
         from .tokenizer import HashTokenizer
@@ -135,6 +137,14 @@ class JaxEncoder:
     def embed_batch(self, texts: list[str]) -> np.ndarray:
         if not texts:
             return np.zeros((0, self.cfg.d_model), np.float32)
+        max_b = self.batch_buckets[-1]
+        if len(texts) > max_b:
+            # chunk oversized batches at the largest bucket
+            parts = [
+                self.embed_batch(texts[i : i + max_b])
+                for i in range(0, len(texts), max_b)
+            ]
+            return np.concatenate(parts, axis=0)
         toks = [self.tokenizer.encode(t)[: self.cfg.max_len] for t in texts]
         max_t = max(1, max(len(t) for t in toks))
         T = self._bucket(max_t, self.seq_buckets)

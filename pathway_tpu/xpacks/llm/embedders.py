@@ -33,7 +33,9 @@ class BaseEmbedder:
     def __call__(self, text, **kwargs):
         if isinstance(text, ColumnExpression):
             return ApplyExpression(
-                self._embed, dt.ANY_ARRAY, (text,), {}, propagate_none=True
+                self._embed, dt.ANY_ARRAY, (text,), {},
+                propagate_none=True,
+                batch_fn=self._embed_many,  # one device dispatch per micro-batch
             )
         return self._embed(text)
 
