@@ -191,6 +191,7 @@ Table.plot = utils.viz_plot
 Table.sort = temporal.sort
 
 from .internals import universes  # noqa: E402
+from .internals.interactive import LiveTable, enable_interactive_mode  # noqa: E402
 
 __version__ = "0.1.0"
 

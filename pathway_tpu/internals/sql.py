@@ -29,8 +29,11 @@ def sql(query: str, **tables: Table) -> Table:
     q = query.strip().rstrip(";")
     m = re.match(
         r"(?is)^select\s+(?P<cols>.*?)\s+from\s+(?P<table>\w+)"
+        r"(?P<joins>(?:\s+(?:inner\s+|left\s+|right\s+|outer\s+)?join\s+\w+\s+on\s+.*?(?=\s+(?:inner\s+|left\s+|right\s+|outer\s+)?join|\s+where|\s+group\s+by|\s+order\s+by|\s+limit|$))*)"
         r"(?:\s+where\s+(?P<where>.*?))?"
-        r"(?:\s+group\s+by\s+(?P<group>.*?))?$",
+        r"(?:\s+group\s+by\s+(?P<group>.*?))?"
+        r"(?:\s+order\s+by\s+(?P<order>.*?))?"
+        r"(?:\s+limit\s+(?P<limit>\d+))?$",
         q,
     )
     if not m:
@@ -39,10 +42,57 @@ def sql(query: str, **tables: Table) -> Table:
     if tname not in tables:
         raise ValueError(f"unknown table {tname!r} in SQL query")
     t = tables[tname]
+    joins_txt = m.group("joins") or ""
+    for jm in re.finditer(
+        r"(?is)(?:(?P<how>inner|left|right|outer)\s+)?join\s+(?P<jt>\w+)\s+on\s+"
+        r"(?P<on>.*?)(?=\s+(?:inner\s+|left\s+|right\s+|outer\s+)?join|\s*$)",
+        joins_txt,
+    ):
+        jt_name = jm.group("jt")
+        if jt_name not in tables:
+            raise ValueError(f"unknown table {jt_name!r} in SQL join")
+        right = tables[jt_name]
+        how = (jm.group("how") or "inner").lower()
+        on = jm.group("on").strip()
+        cm = re.match(r"(?s)^(\w+)\.(\w+)\s*=\s*(\w+)\.(\w+)$", on)
+        if not cm:
+            raise NotImplementedError(f"unsupported JOIN condition: {on!r}")
+        lt_n, lc, rt_n, rc = cm.groups()
+        sides = {lt_n, rt_n}
+        if jt_name not in sides:
+            raise ValueError(
+                f"JOIN condition {on!r} must reference the joined table "
+                f"{jt_name!r}"
+            )
+        other = (sides - {jt_name}).pop() if len(sides) == 2 else None
+        if other is not None and other not in tables:
+            raise ValueError(f"JOIN condition references unknown table {other!r}")
+        if len(sides) == 1:
+            raise ValueError(
+                f"JOIN condition {on!r} must reference two different tables"
+            )
+        if rt_n == jt_name:
+            lcol, rcol = lc, rc
+        else:
+            lcol, rcol = rc, lc
+        jr = t.join(right, t[lcol] == right[rcol], how=how)
+        # flatten the join into a plain table carrying both sides' columns
+        sel = {}
+        for n in t.column_names():
+            sel[n] = t[n]
+        for n in right.column_names():
+            if n not in sel:
+                sel[n] = right[n]
+        t = jr.select(**sel)
     if m.group("where"):
         t = t.filter(_parse_expr(m.group("where"), t))
     cols_txt = _split_commas(m.group("cols"))
     group_txt = m.group("group")
+    if m.group("order") or m.group("limit"):
+        raise NotImplementedError(
+            "ORDER BY / LIMIT: incremental tables are unordered; sort at the "
+            "sink (e.g. pandas) or use Table.sort for prev/next traversal"
+        )
     if group_txt:
         gb_cols = [c.strip() for c in group_txt.split(",")]
         out: dict[str, Any] = {}

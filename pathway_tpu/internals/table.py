@@ -565,8 +565,13 @@ class Table:
         return self
 
     def _materialize_capture(self):
-        """Attach a capture sink; returns the OpNode for the runner."""
-        return pg.new_output_node("capture", [self], colnames=list(self._colnames))
+        """Attach a capture sink; returns the OpNode for the runner.
+
+        Captures are NOT registered as pw.run() outputs — they belong to the
+        explicit run_tables() invocation that created them (otherwise every
+        debug/LiveTable access would leak a permanent sink into the global
+        graph)."""
+        return pg.new_node("capture", [self], colnames=list(self._colnames))
 
 
 class GroupedTable:

@@ -13,7 +13,7 @@ import sys
 import types
 from typing import Any
 
-from . import csv, fs, jsonlines, kafka, python
+from . import csv, fs, jsonlines, kafka, python, sqlite
 from ._subscribe import subscribe
 from ._synchronization import register_input_synchronization_group
 
@@ -52,7 +52,6 @@ gdrive = _make_stub("gdrive", "google-api-python-client")
 sharepoint = _make_stub("sharepoint", "Office365-REST client")
 postgres = _make_stub("postgres", "psycopg")
 mysql = _make_stub("mysql", "pymysql")
-sqlite = _make_stub("sqlite", "sqlite driver wiring")
 mongodb = _make_stub("mongodb", "pymongo")
 elasticsearch = _make_stub("elasticsearch", "elasticsearch client")
 deltalake = _make_stub("deltalake", "deltalake")
