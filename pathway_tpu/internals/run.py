@@ -2,7 +2,9 @@
 
 Reference: python/pathway/internals/run.py:13.  Batch graphs execute to
 completion; graphs with live sources run the streaming poll loop;
-PATHWAY_THREADS>1 routes batch graphs through the sharded data-plane.
+PATHWAY_THREADS>1 routes BOTH through the sharded data-plane
+(parallel/sharded.py), which mirrors the streaming loop's async ticks and
+elastic workload tracking.
 """
 
 from __future__ import annotations
@@ -39,7 +41,7 @@ def run(
     streaming = has_live_sources(sinks)
 
     # exactly one runner is built and instrumented
-    if not streaming and n_shards > 1:
+    if n_shards > 1:
         from ..parallel.sharded import ShardedGraphRunner
 
         runner: Any = ShardedGraphRunner(sinks, n_shards=n_shards)

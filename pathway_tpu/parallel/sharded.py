@@ -149,6 +149,7 @@ class ShardedGraphRunner:
             self.shard_graphs.append(lg)
         base = self.shard_graphs[0]
         self.lg = base  # persistence and telemetry attach to the base graph
+        self._last_t = -2  # highest processed logical time
         self.topo = base.scheduler.topo_order()
         # map operator-position -> node for routing (lower() builds ops in
         # the same order per shard)
@@ -190,54 +191,50 @@ class ShardedGraphRunner:
 
     def run_batch(self) -> dict[int, CapturedStream]:
         # collect events per time, partitioned into shards by input routing
-        by_time: dict[int, dict[int, dict[int, list[Update]]]] = defaultdict(
-            lambda: defaultdict(lambda: defaultdict(list))
-        )  # time -> op_pos -> shard -> updates
-        base = self.shard_graphs[0]
-        for idx, (op, source) in enumerate(base.input_ops):
-            pos = self.pos_of[op.id]
-            router = ShardRouter(_SHARD_BY_KEY, self.n)
-            for t, key, row, diff in source.static_events():
-                s = router.shard_of((key, row, diff))
-                by_time[t][pos][s].append((key, row, diff))
-
         pending: dict[int, dict[tuple[int, int], list[tuple[int, list[Update]]]]] = (
             defaultdict(lambda: defaultdict(list))
         )  # time -> (op_pos, shard) -> [(port, updates)]
-        for t, per_op in by_time.items():
-            for pos, per_shard in per_op.items():
-                for s, updates in per_shard.items():
-                    pending[t][(pos, s)].append((0, updates))
+        base = self.shard_graphs[0]
+        key_router = ShardRouter(_SHARD_BY_KEY, self.n)
+        for op, source in base.input_ops:
+            pos = self.pos_of[op.id]
+            for t, key, row, diff in source.static_events():
+                s = key_router.shard_of((key, row, diff))
+                pending[t][(pos, s)].append((0, [(key, row, diff)]))
+        self._drain(pending)
+        self._drain_on_end(pending)
+        return self.captures
 
-        times = sorted(pending.keys())
-        ti = 0
-        while ti < len(times):
-            t = times[ti]
-            self._run_time(t, pending, times)
-            ti += 1
-        # on_end pass: emissions (e.g. fully-async resolutions) are routed
-        # like any other batch, then unconsumed buckets drain (consumed
-        # buckets were popped by _run_time, so re-running a time only
-        # delivers the new batches)
-        end_t = (times[-1] + 2) if times else 0
+    # ------------------------------------------------------------------
+    # execution core: `pending` holds only OUTSTANDING times; _run_time
+    # removes a time's bucket after processing, so scans stay O(outstanding)
+    # and long streams neither leak memory nor slow down over time
+    # ------------------------------------------------------------------
+
+    def _drain(self, pending) -> None:
+        while True:
+            ready = [t for t, b in pending.items() if b]
+            if not ready:
+                for t in list(pending):
+                    pending.pop(t, None)
+                return
+            self._run_time(min(ready), pending)
+
+    def _drain_on_end(self, pending) -> None:
+        """Route interior on_end emissions like normal batches, then drain.
+
+        Shared by batch and streaming shutdown."""
+        end_t = self._last_t + 2
         for pos, _base_op in enumerate(self.topo):
             for s in range(self.n):
                 op = self.shard_graphs[s].scheduler.topo_order()[pos]
                 emitted: list = []
                 self._hook_emit(op, end_t, emitted)
                 op.on_end()
-                self._route_emissions(op, s, emitted, pending, times, 0)
-        while True:
-            leftover = sorted(t for t, b in list(pending.items()) if b)
-            if not leftover:
-                break
-            for t in leftover:
-                if t not in times:
-                    times.append(t)
-                self._run_time(t, pending, times)
-        return self.captures
+                self._route_emissions(op, s, emitted, pending)
+        self._drain(pending)
 
-    def _run_time(self, t, pending, times) -> None:
+    def _run_time(self, t, pending) -> None:
         bucket = pending.get(t, {})
         for pos, base_op in enumerate(self.topo):
             for s in range(self.n):
@@ -251,7 +248,10 @@ class ShardedGraphRunner:
                         op.rows_in += len(updates)
                         op.process(port, updates, t)
                 op.flush(t)
-                self._route_emissions(op, s, emitted, pending, times, t)
+                self._route_emissions(op, s, emitted, pending)
+        if not pending.get(t):
+            pending.pop(t, None)
+        self._last_t = max(self._last_t, t)
 
     def _hook_emit(self, op: Operator, t, sink_list):
         def emit(time, updates, _op=op, _sink=sink_list):
@@ -261,7 +261,7 @@ class ShardedGraphRunner:
 
         op.emit = emit  # type: ignore[method-assign]
 
-    def _route_emissions(self, op, shard, emitted, pending, times, cur_t):
+    def _route_emissions(self, op, shard, emitted, pending):
         node_id = None
         for nid, o in self.shard_graphs[shard].by_node.items():
             if o is op:
@@ -269,10 +269,7 @@ class ShardedGraphRunner:
                 break
         if node_id is None:
             return
-        node = self.nodes.get(node_id)
-        if node is None:
-            return
-        # find downstream consumers via the shard-0 graph topology
+        # route downstream via the shard-0 graph topology
         base_op = self.shard_graphs[0].by_node[node_id]
         for time, updates in emitted:
             for down, port in base_op.downstream:
@@ -283,14 +280,113 @@ class ShardedGraphRunner:
                     per_shard[router.shard_of(u)].append(u)
                 for s2, us in per_shard.items():
                     pending[time][(pos, s2)].append((port, us))
-                if time > cur_t and time not in pending:
-                    pass
-            if time > cur_t and time not in times:
-                import bisect
 
-                bisect.insort(times, time)
-            if not base_op.downstream and node.kind in ("capture",):
-                pass
+    def run_streaming(
+        self,
+        autocommit_ms: int = 50,
+        timeout_s: float | None = None,
+        idle_stop_s: float | None = None,
+    ) -> dict[int, CapturedStream]:
+        """Streaming loop over the sharded data-plane: poll sources, partition
+        each commit's events by key, process logical times across shards.
+
+        Mirrors GraphRunner.run_streaming: async-completion ticks and the
+        PATHWAY_ELASTIC workload tracker both apply here."""
+        import os as _os
+        import time as _time
+
+        base = self.shard_graphs[0]
+        pending: dict = defaultdict(lambda: defaultdict(list))
+        live = []
+        start = _time.monotonic()
+        key_router = ShardRouter(_SHARD_BY_KEY, self.n)
+        for op, source in base.input_ops:
+            pos = self.pos_of[op.id]
+            if source.is_live():
+                source.start()
+                live.append((pos, source))
+            else:
+                for t, key, row, diff in source.static_events():
+                    s = key_router.shard_of((key, row, diff))
+                    pending[t][(pos, s)].append((0, [(key, row, diff)]))
+        self._drain(pending)
+        logical = self._last_t + 2
+        logical -= logical % 2
+        last_event = _time.monotonic()
+        finished: set[int] = set()
+        tracker = None
+        if _os.environ.get("PATHWAY_ELASTIC") == "1":
+            from ..engine.telemetry import WorkloadTracker
+
+            tracker = WorkloadTracker()
+        rescale_code: int | None = None
+        all_ops = [
+            op for lg in self.shard_graphs for op in lg.scheduler.operators
+        ]
+        while live and len(finished) < len(live):
+            loop_t0 = _time.monotonic()
+            got_any = False
+            for pos, source in live:
+                if pos in finished:
+                    continue
+                events = source.poll()
+                if events is None:
+                    finished.add(pos)
+                    continue
+                if events:
+                    got_any = True
+                    per_shard: dict[int, list] = defaultdict(list)
+                    for _t, key, row, diff in events:
+                        per_shard[key_router.shard_of((key, row, diff))].append(
+                            (key, row, diff)
+                        )
+                    for s, us in per_shard.items():
+                        pending[logical][(pos, s)].append((0, us))
+            has_completions = any(
+                getattr(op, "_completions", None) for op in all_ops
+            )
+            slept = 0.0
+            if got_any or has_completions:
+                if not got_any:
+                    self._run_time(logical, pending)  # flush-only tick
+                self._drain(pending)
+                logical += 2
+                last_event = _time.monotonic()
+            else:
+                slept = autocommit_ms / 1000.0
+                _time.sleep(slept)
+            now = _time.monotonic()
+            if tracker is not None:
+                loop_el = max(now - loop_t0, 1e-9)
+                tracker.record(max(0.0, min(1.0, (loop_el - slept) / loop_el)))
+                code = tracker.recommendation()
+                if code is not None:
+                    from ..cli import MAX_PROCESSES
+                    from ..engine.telemetry import WorkloadTracker as _WT
+
+                    n_procs = int(_os.environ.get("PATHWAY_PROCESSES", "1"))
+                    supervised = _os.environ.get("PATHWAY_SPAWNED") == "1"
+                    at_min = code == _WT.EXIT_CODE_DOWNSCALE and n_procs <= 1
+                    at_max = (
+                        code == _WT.EXIT_CODE_UPSCALE and n_procs >= MAX_PROCESSES
+                    )
+                    if supervised and not at_min and not at_max:
+                        rescale_code = code
+                        break
+            if timeout_s is not None and now - start > timeout_s:
+                break
+            if idle_stop_s is not None and now - last_event > idle_stop_s:
+                break
+        self._drain_on_end(pending)
+        if rescale_code is not None:
+            import sys as _sys
+
+            print(
+                f"[pathway-tpu] workload tracker requests rescale "
+                f"(exit {rescale_code})", file=_sys.stderr,
+            )
+            _sys.exit(rescale_code)
+        return self.captures
 
 
 def run_tables_sharded(*tables, n_shards: int = 4) -> list[CapturedStream]:

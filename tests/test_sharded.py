@@ -64,6 +64,37 @@ def test_sharded_stream_with_retractions():
     _assert_same(out, n_shards=3)
 
 
+def test_sharded_streaming_via_threads(monkeypatch):
+    """PATHWAY_THREADS>1 + live sources run the sharded streaming loop."""
+    import time
+
+    from pathway_tpu.internals.config import pathway_config
+
+    monkeypatch.setattr(pathway_config, "threads", 3)
+
+    class S(pw.Schema):
+        word: str
+
+    class Subject(pw.io.python.ConnectorSubject):
+        def run(self):
+            for i in range(60):
+                self.next(word=f"w{i % 5}")
+                if i % 20 == 0:
+                    time.sleep(0.02)
+
+    t = pw.io.python.read(Subject(), schema=S)
+    counts = t.groupby(t.word).reduce(t.word, c=pw.reducers.count())
+    final = {}
+    pw.io.subscribe(
+        counts,
+        on_change=lambda key, row, time, is_addition: final.__setitem__(
+            row["word"], row["c"]
+        ) if is_addition else None,
+    )
+    pw.run(idle_stop_s=0.8, autocommit_duration_ms=20)
+    assert sum(final.values()) == 60 and len(final) == 5, final
+
+
 def test_sharded_chain():
     class S(pw.Schema):
         g: str
