@@ -98,7 +98,7 @@ class SessionWindow(Window):
 
 
 class IntervalsOverWindow(Window):
-    def __init__(self, at, lower_bound, upper_bound, is_outer=False):
+    def __init__(self, at, lower_bound, upper_bound, is_outer=True):
         self.at = at
         self.lower_bound = lower_bound
         self.upper_bound = upper_bound
@@ -119,7 +119,9 @@ def session(*, predicate=None, max_gap=None) -> SessionWindow:
     return SessionWindow(predicate, max_gap)
 
 
-def intervals_over(*, at, lower_bound, upper_bound, is_outer: bool = False) -> IntervalsOverWindow:
+def intervals_over(*, at, lower_bound, upper_bound, is_outer: bool = True) -> IntervalsOverWindow:
+    """Windows centered at `at` points (reference default: is_outer=True —
+    points with no rows still emit a window with empty aggregates)."""
     return IntervalsOverWindow(at, lower_bound, upper_bound, is_outer)
 
 
@@ -127,10 +129,14 @@ class WindowedTable:
     """Result of windowby(); reduce() mirrors GroupedTable with the special
     _pw_window / _pw_window_start / _pw_window_end / _pw_instance columns."""
 
-    def __init__(self, table: Table, base: Table, gb_cols: list[str]):
+    def __init__(self, table: Table, base: Table, gb_cols: list[str],
+                 outer_points: Table | None = None):
         self._source = table
         self._base = base
         self._gb_cols = gb_cols
+        # intervals_over(is_outer=True): one row per at-point whose window may
+        # be empty; empty windows emit reducer defaults
+        self._outer_points = outer_points
 
     def reduce(self, *args, **kwargs) -> Table:
         base = self._base
@@ -154,7 +160,58 @@ class WindowedTable:
         for n, e in kwargs.items():
             new_kwargs[n] = _map_reducer_args(remap_refs(e), remap_refs)
         grouped = base.groupby(*[base[c] for c in self._gb_cols])
-        return grouped.reduce(*new_args, **new_kwargs)
+        reduced = grouped.reduce(*new_args, **new_kwargs)
+        if self._outer_points is None:
+            return reduced
+        return self._add_empty_windows(reduced, new_args, new_kwargs)
+
+    def _add_empty_windows(self, reduced: Table, args, kwargs) -> Table:
+        """Union in rows for at-points whose window matched nothing,
+        carrying each reducer's empty-state default."""
+        from ...engine.reducers_impl import make_state
+        from ...internals.desugaring import walk
+        from ...internals.expression import ReducerExpression
+
+        from ...internals.desugaring import rewrite_nodes
+        from ...internals.expression import ConstExpression
+
+        pts = self._outer_points  # columns: _pw_instance/_pw_window/start/end
+        # key the points exactly like the groupby keys its groups
+        pts = pts.with_id(
+            pts.pointer_from(*[pts[c] for c in self._gb_cols])
+        )
+
+        def pad_expr(e):
+            """Evaluate the reduce expression over an empty group: each
+            reducer node becomes its empty-state default; grouping-column
+            refs resolve against the point table."""
+
+            def node_fn(node):
+                if isinstance(node, ReducerExpression):
+                    try:
+                        default = make_state(
+                            node._reducer, dict(node._kwargs)
+                        ).value()
+                    except Exception:
+                        default = None
+                    return ConstExpression(default)
+                if isinstance(node, ColumnReference):
+                    if node.name in pts._colnames:
+                        return pts[node.name]
+                    return ConstExpression(None)
+                return None
+
+            return rewrite_nodes(wrap(e), node_fn)
+
+        out_cols: dict[str, object] = {}
+        for a in args:
+            if isinstance(a, ColumnReference):
+                out_cols[a.name] = pad_expr(a)
+        for n, e in kwargs.items():
+            out_cols[n] = pad_expr(e)
+        pads = pts.select(**out_cols)
+        missing = pads.difference(reduced)
+        return reduced.concat(missing)
 
 
 def windowby(
@@ -278,10 +335,9 @@ def _session_windowby(table: Table, time_expr, window: SessionWindow, instance):
 def _intervals_over_windowby(table: Table, time_expr, window: IntervalsOverWindow, instance):
     """intervals_over: one window per row of `at`, containing source rows with
     t in [p+lower, p+upper]."""
-    if window.is_outer:
+    if window.is_outer and instance is not None:
         raise NotImplementedError(
-            "intervals_over(is_outer=True): empty-window emission is not "
-            "implemented yet; use is_outer=False"
+            "intervals_over(is_outer=True) with instance= is not supported"
         )
     at = window.at
     if not isinstance(at, Table):
@@ -314,4 +370,18 @@ def _intervals_over_windowby(table: Table, time_expr, window: IntervalsOverWindo
         _pw_window_start=inside._pw_pt + lower,
         _pw_window_end=inside._pw_pt + upper,
     ).without("_pw_t", "_pw_pt")
-    return WindowedTable(table, base, ["_pw_instance", "_pw_window", "_pw_window_start", "_pw_window_end"])
+    outer_points = None
+    if window.is_outer:
+        outer_points = pts.select(
+            _pw_instance=None,
+            _pw_window=ApplyExpression(
+                lambda p: (p + lower, p + upper), dt.ANY, (pts._pw_at,), {}
+            ),
+            _pw_window_start=pts._pw_at + lower,
+            _pw_window_end=pts._pw_at + upper,
+        )
+    return WindowedTable(
+        table, base,
+        ["_pw_instance", "_pw_window", "_pw_window_start", "_pw_window_end"],
+        outer_points=outer_points,
+    )

@@ -1,0 +1,85 @@
+"""End-to-end RAG soak: live document ingestion + REST serving + on-device
+embedder + persistence, all in one run (tier-4 style; reference model:
+integration_tests/rag_evals + webserver)."""
+
+import json
+import socket
+import threading
+import time
+import urllib.request
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.models.encoder import EncoderConfig
+from pathway_tpu.stdlib.indexing import BruteForceKnnFactory
+from pathway_tpu.xpacks.llm.document_store import DocumentStore
+from pathway_tpu.xpacks.llm.question_answering import BaseRAGQuestionAnswerer
+from pathway_tpu.xpacks.llm.servers import QARestServer
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_live_rag_serving(tmp_path):
+    # live document source: files appear over time
+    docs_dir = tmp_path / "docs"
+    docs_dir.mkdir()
+    (docs_dir / "a.txt").write_text("pathway is a stream processing framework")
+
+    docs = pw.io.fs.read(str(docs_dir), format="binary", mode="streaming",
+                         with_metadata=True)
+    from pathway_tpu.xpacks.llm.embedders import SentenceTransformerEmbedder
+
+    emb = SentenceTransformerEmbedder(
+        config=EncoderConfig(vocab_size=2048, d_model=48, n_layers=2,
+                             n_heads=4, d_ff=96, max_len=48)
+    )
+    store = DocumentStore(
+        docs,
+        retriever_factory=BruteForceKnnFactory(
+            dimensions=emb.get_embedding_dimension(), embedder=emb
+        ),
+    )
+    rag = BaseRAGQuestionAnswerer(
+        lambda msgs: "A[" + msgs[0]["content"][:20] + "]", store, search_topk=1
+    )
+    port = _free_port()
+    QARestServer("127.0.0.1", port, rag)
+
+    results = {}
+
+    def client():
+        def post(route, payload, timeout=15):
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}{route}",
+                json.dumps(payload).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            return json.loads(urllib.request.urlopen(req, timeout=timeout).read())
+
+        time.sleep(1.2)
+        results["first"] = post("/v1/retrieve", {"query": "stream framework", "k": 1})
+        # a new document arrives mid-run...
+        (docs_dir / "b.txt").write_text("the mxu is the tpu systolic matrix unit")
+        time.sleep(1.5)
+        # ...and becomes retrievable (live index maintenance)
+        results["second"] = post("/v1/retrieve", {"query": "mxu systolic", "k": 1})
+        results["answer"] = post("/v1/pw_ai_answer", {"prompt": "what is pathway"})
+        results["stats"] = post("/v1/statistics", {})
+
+    th = threading.Thread(target=client, daemon=True)
+    th.start()
+    pw.run(timeout_s=8.0, autocommit_duration_ms=50,
+           monitoring_level=pw.MonitoringLevel.NONE)
+    th.join(timeout=2)
+
+    assert results["first"][0]["text"].startswith("pathway is")
+    assert "mxu" in results["second"][0]["text"]
+    assert results["answer"].startswith("A[")
+    assert results["stats"]["chunk_count"] == 2

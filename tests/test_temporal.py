@@ -222,6 +222,33 @@ def test_diff():
     assert diffs == [(1, None), (2, 5), (3, 10)]
 
 
+def test_intervals_over_outer_emits_empty_windows():
+    t = table_from_markdown(
+        """
+        | t | v
+      1 | 1 | 1
+        """
+    )
+    probes = table_from_markdown(
+        """
+        | pt
+      7 | 2
+      8 | 10
+        """
+    )
+    out = t.windowby(
+        t.t,
+        window=pw.temporal.intervals_over(
+            at=probes.pt, lower_bound=-2, upper_bound=0, is_outer=True
+        ),
+    ).reduce(
+        end=pw.this._pw_window_end,
+        c=pw.reducers.count(),
+    )
+    state = sorted(run_and_squash(out).values())
+    assert state == [(2, 1), (10, 0)]
+
+
 def test_intervals_over():
     t = table_from_markdown(
         """
