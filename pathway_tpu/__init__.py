@@ -192,6 +192,42 @@ Table.sort = temporal.sort
 
 from .internals import universes  # noqa: E402
 from .internals.interactive import LiveTable, enable_interactive_mode  # noqa: E402
+from .internals.compat import (  # noqa: E402
+    BaseCustomAccumulator,
+    DateTimeNaive,
+    DateTimeUtc,
+    Duration,
+    GroupedJoinResult,
+    Joinable,
+    OuterJoinResult,
+    PersistenceMode,
+    PyObjectWrapper,
+    SchemaProperties,
+    TableLike,
+    TableSlice,
+    Type,
+    global_error_log,
+    groupby,
+    iterate_universe,
+    join,
+    join_inner,
+    join_left,
+    join_outer,
+    join_right,
+    local_error_log,
+    pandas_transformer,
+    schema_builder,
+    schema_from_csv,
+    table_transformer,
+    wrap_py_object,
+)
+from .internals import udfs as asynchronous  # noqa: E402  (reference alias)
+from .stdlib import graphs  # noqa: E402
+from .stdlib.temporal import _window as window  # noqa: E402
+from .stdlib import viz  # noqa: E402
+from .stdlib.temporal._asof_join import AsofJoinResult  # noqa: E402
+from .stdlib.temporal._interval_join import IntervalJoinResult  # noqa: E402
+from .stdlib.temporal._window_join import WindowJoinResult  # noqa: E402
 
 __version__ = "0.1.0"
 

@@ -112,7 +112,10 @@ def expand_args(table, *args) -> dict[str, ColumnExpression]:
                 if name not in arg._pw_exclusions:
                     out[name] = src[name]
         elif isinstance(arg, ColumnReference):
-            out[arg.name] = arg
+            out[getattr(arg, "_output_name", None) or arg.name] = arg
+        elif hasattr(arg, "_mapping"):  # TableSlice: keeps its renames
+            for name, ref in arg._mapping.items():
+                out[name] = ref
         elif hasattr(arg, "column_names") and hasattr(arg, "__getitem__"):
             for name in arg.column_names():
                 out[name] = arg[name]

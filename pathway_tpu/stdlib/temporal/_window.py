@@ -97,12 +97,16 @@ class SessionWindow(Window):
         self.max_gap = max_gap
 
 
+_OUTER_DEFAULT = object()
+
+
 class IntervalsOverWindow(Window):
-    def __init__(self, at, lower_bound, upper_bound, is_outer=True):
+    def __init__(self, at, lower_bound, upper_bound, is_outer=_OUTER_DEFAULT):
         self.at = at
         self.lower_bound = lower_bound
         self.upper_bound = upper_bound
-        self.is_outer = is_outer
+        self.is_outer_explicit = is_outer is not _OUTER_DEFAULT
+        self.is_outer = True if is_outer is _OUTER_DEFAULT else bool(is_outer)
 
 
 def tumbling(duration=None, origin=None, **kwargs) -> TumblingWindow:
@@ -119,7 +123,8 @@ def session(*, predicate=None, max_gap=None) -> SessionWindow:
     return SessionWindow(predicate, max_gap)
 
 
-def intervals_over(*, at, lower_bound, upper_bound, is_outer: bool = True) -> IntervalsOverWindow:
+def intervals_over(*, at, lower_bound, upper_bound,
+                   is_outer=_OUTER_DEFAULT) -> IntervalsOverWindow:
     """Windows centered at `at` points (reference default: is_outer=True —
     points with no rows still emit a window with empty aggregates)."""
     return IntervalsOverWindow(at, lower_bound, upper_bound, is_outer)
@@ -169,11 +174,8 @@ class WindowedTable:
         """Union in rows for at-points whose window matched nothing,
         carrying each reducer's empty-state default."""
         from ...engine.reducers_impl import make_state
-        from ...internals.desugaring import walk
-        from ...internals.expression import ReducerExpression
-
         from ...internals.desugaring import rewrite_nodes
-        from ...internals.expression import ConstExpression
+        from ...internals.expression import ConstExpression, ReducerExpression
 
         pts = self._outer_points  # columns: _pw_instance/_pw_window/start/end
         # key the points exactly like the groupby keys its groups
@@ -335,10 +337,13 @@ def _session_windowby(table: Table, time_expr, window: SessionWindow, instance):
 def _intervals_over_windowby(table: Table, time_expr, window: IntervalsOverWindow, instance):
     """intervals_over: one window per row of `at`, containing source rows with
     t in [p+lower, p+upper]."""
-    if window.is_outer and instance is not None:
-        raise NotImplementedError(
-            "intervals_over(is_outer=True) with instance= is not supported"
-        )
+    is_outer = window.is_outer
+    if is_outer and instance is not None:
+        if window.is_outer_explicit:
+            raise NotImplementedError(
+                "intervals_over(is_outer=True) with instance= is not supported"
+            )
+        is_outer = False  # defaulted: instance-windows stay inner
     at = window.at
     if not isinstance(at, Table):
         # column reference to the at-times
@@ -371,7 +376,7 @@ def _intervals_over_windowby(table: Table, time_expr, window: IntervalsOverWindo
         _pw_window_end=inside._pw_pt + upper,
     ).without("_pw_t", "_pw_pt")
     outer_points = None
-    if window.is_outer:
+    if is_outer:
         outer_points = pts.select(
             _pw_instance=None,
             _pw_window=ApplyExpression(

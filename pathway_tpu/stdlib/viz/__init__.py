@@ -1,0 +1,9 @@
+"""Visualization hooks (reference: stdlib/viz — Bokeh/Panel live plots).
+
+Console/pandas fallbacks; rich plotting plugs in via Table.plot.
+"""
+
+from ..utils import viz_plot as plot
+from ..utils import viz_show as show
+
+__all__ = ["show", "plot"]
