@@ -28,6 +28,13 @@ class EncoderConfig:
     d_ff: int = 1536
     max_len: int = 512
     dtype: Any = jnp.bfloat16
+    # "pre" (default, training-friendly) or "post" (BERT-family weight
+    # compatibility — see models/hf_import.py)
+    ln_placement: str = "pre"
+    # gelu (exact erf), gelu_tanh (approximation — the historical default
+    # for randomly-initialized encoders), relu
+    act: str = "gelu_tanh"
+    ln_eps: float = 1e-6
 
 
 def init_params(cfg: EncoderConfig, rng: jax.Array) -> dict:
@@ -70,32 +77,67 @@ def _layer_norm(x, scale, bias, eps=1e-6):
     return out.astype(x.dtype)
 
 
+def _proj(layer, x, w_name: str, b_name: str):
+    out = x @ layer[w_name].astype(x.dtype)
+    b = layer.get(b_name)
+    if b is not None:
+        out = out + b.astype(x.dtype)
+    return out
+
+
 def _attention(layer, x, mask, n_heads: int):
     B, T, D = x.shape
     H = n_heads
     hd = D // H
-    q = (x @ layer["wq"].astype(x.dtype)).reshape(B, T, H, hd)
-    k = (x @ layer["wk"].astype(x.dtype)).reshape(B, T, H, hd)
-    v = (x @ layer["wv"].astype(x.dtype)).reshape(B, T, H, hd)
+    q = _proj(layer, x, "wq", "bq").reshape(B, T, H, hd)
+    k = _proj(layer, x, "wk", "bk").reshape(B, T, H, hd)
+    v = _proj(layer, x, "wv", "bv").reshape(B, T, H, hd)
     scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(hd)
     scores = jnp.where(mask[:, None, None, :], scores, -1e9)
     probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(x.dtype)
     out = jnp.einsum("bhqk,bkhd->bqhd", probs, v).reshape(B, T, D)
-    return out @ layer["wo"].astype(x.dtype)
+    return _proj(layer, out, "wo", "bo")
+
+
+def encode_tokens(params: dict, cfg: EncoderConfig, token_ids: jax.Array,
+                  mask: jax.Array) -> jax.Array:
+    """(B, T) -> (B, T, d_model) contextual embeddings."""
+    x = params["embed"].astype(cfg.dtype)[token_ids]
+    T = token_ids.shape[1]
+    x = x + params["pos_embed"].astype(cfg.dtype)[:T][None, :, :]
+    eps = cfg.ln_eps
+    if cfg.ln_placement == "post" and "ln_e_scale" in params:
+        x = _layer_norm(x, params["ln_e_scale"], params["ln_e_bias"], eps)
+    def act(v):
+        if cfg.act == "gelu":
+            return jax.nn.gelu(v, approximate=False)
+        if cfg.act == "gelu_tanh":
+            return jax.nn.gelu(v, approximate=True)
+        return jax.nn.relu(v)
+
+    for layer in params["layers"]:
+        if cfg.ln_placement == "pre":
+            h = _layer_norm(x, layer["ln1_scale"], layer["ln1_bias"], eps)
+            x = x + _attention(layer, h, mask, cfg.n_heads)
+            h = _layer_norm(x, layer["ln2_scale"], layer["ln2_bias"], eps)
+            ff = act(_proj(layer, h, "w_up", "b_up"))
+            x = x + _proj(layer, ff, "w_down", "b_down")
+        else:  # post-LN (BERT family)
+            a = _attention(layer, x, mask, cfg.n_heads)
+            x = _layer_norm(x + a, layer["ln1_scale"], layer["ln1_bias"], eps)
+            ff = act(_proj(layer, x, "w_up", "b_up"))
+            x = _layer_norm(
+                x + _proj(layer, ff, "w_down", "b_down"),
+                layer["ln2_scale"], layer["ln2_bias"], eps,
+            )
+    if cfg.ln_placement == "pre":
+        x = _layer_norm(x, params["ln_f_scale"], params["ln_f_bias"], eps)
+    return x
 
 
 def encode(params: dict, cfg: EncoderConfig, token_ids: jax.Array, mask: jax.Array) -> jax.Array:
     """(B, T) int32 tokens + (B, T) bool mask -> (B, d_model) L2-normed f32."""
-    x = params["embed"].astype(cfg.dtype)[token_ids]
-    T = token_ids.shape[1]
-    x = x + params["pos_embed"].astype(cfg.dtype)[:T][None, :, :]
-    for layer in params["layers"]:
-        h = _layer_norm(x, layer["ln1_scale"], layer["ln1_bias"])
-        x = x + _attention(layer, h, mask, cfg.n_heads)
-        h = _layer_norm(x, layer["ln2_scale"], layer["ln2_bias"])
-        ff = jax.nn.gelu(h @ layer["w_up"].astype(x.dtype))
-        x = x + ff @ layer["w_down"].astype(x.dtype)
-    x = _layer_norm(x, params["ln_f_scale"], params["ln_f_bias"])
+    x = encode_tokens(params, cfg, token_ids, mask)
     # masked mean pooling + L2 norm (SentenceTransformer-style)
     m = mask[:, :, None].astype(jnp.float32)
     pooled = jnp.sum(x.astype(jnp.float32) * m, axis=1) / jnp.maximum(
@@ -112,17 +154,33 @@ class JaxEncoder:
     """
 
     def __init__(self, cfg: EncoderConfig | None = None, seed: int = 0,
-                 seq_buckets=(32, 128, 512), batch_buckets=(1, 8, 64, 256)):
+                 seq_buckets=(32, 128, 512), batch_buckets=(1, 8, 64, 256),
+                 params: dict | None = None, tokenizer=None):
         self.cfg = cfg or EncoderConfig()
-        self.params = init_params(self.cfg, jax.random.PRNGKey(seed))
+        self.params = (
+            params if params is not None
+            else init_params(self.cfg, jax.random.PRNGKey(seed))
+        )
         self.seq_buckets = [b for b in seq_buckets if b <= self.cfg.max_len] or [
             self.cfg.max_len
         ]
         self.batch_buckets = list(batch_buckets)
         self._fwd = jax.jit(functools.partial(encode, cfg=self.cfg))
-        from .tokenizer import HashTokenizer
+        if tokenizer is None:
+            from .tokenizer import HashTokenizer
 
-        self.tokenizer = HashTokenizer(self.cfg.vocab_size)
+            tokenizer = HashTokenizer(self.cfg.vocab_size)
+        self.tokenizer = tokenizer
+
+    @classmethod
+    def from_hf(cls, model_name_or_path: str, **kwargs) -> "JaxEncoder":
+        """Run a locally-available BERT-family model on the TPU path
+        (models/hf_import.py)."""
+        from .hf_import import load_hf_encoder
+
+        params, cfg, hf_tok = load_hf_encoder(model_name_or_path)
+        tok = _HFTokenizerAdapter(hf_tok) if hf_tok is not None else None
+        return cls(cfg, params=params, tokenizer=tok, **kwargs)
 
     def _bucket(self, n: int, buckets) -> int:
         for b in buckets:
@@ -164,3 +222,14 @@ class JaxEncoder:
 
     def __call__(self, text: str) -> np.ndarray:
         return self.embed(text)
+
+
+class _HFTokenizerAdapter:
+    def __init__(self, hf_tok):
+        self._tok = hf_tok
+
+    def encode(self, text: str) -> list[int]:
+        return self._tok.encode(text, add_special_tokens=True)
+
+    def count_tokens(self, text: str) -> int:
+        return len(self.encode(text))

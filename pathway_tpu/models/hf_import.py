@@ -1,0 +1,129 @@
+"""Import BERT-family HuggingFace weights into the JAX encoder.
+
+Lets real pretrained embedders (MiniLM / BERT / sentence-transformers
+encoders stored locally) run on the TPU compute path: the state dict maps
+onto EncoderConfig(ln_placement="post") parameters and `encode_tokens`
+reproduces the torch forward pass.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import numpy as np
+
+from .encoder import EncoderConfig
+
+
+_ACT_MAP = {
+    "gelu": "gelu",  # HF "gelu" is the exact erf form
+    "gelu_new": "gelu_tanh",
+    "gelu_pytorch_tanh": "gelu_tanh",
+    "gelu_fast": "gelu_tanh",
+    "relu": "relu",
+}
+
+
+def config_from_hf(hf_config) -> EncoderConfig:
+    import jax.numpy as jnp
+
+    act = getattr(hf_config, "hidden_act", "gelu")
+    if act not in _ACT_MAP:
+        raise ValueError(
+            f"unsupported hidden_act {act!r}; supported: {sorted(_ACT_MAP)}"
+        )
+    pos_type = getattr(hf_config, "position_embedding_type", "absolute")
+    if pos_type != "absolute":
+        raise ValueError(
+            f"unsupported position_embedding_type {pos_type!r}; only "
+            "'absolute' BERT-family models map onto this encoder"
+        )
+    return EncoderConfig(
+        vocab_size=hf_config.vocab_size,
+        d_model=hf_config.hidden_size,
+        n_layers=hf_config.num_hidden_layers,
+        n_heads=hf_config.num_attention_heads,
+        d_ff=hf_config.intermediate_size,
+        max_len=hf_config.max_position_embeddings,
+        dtype=jnp.float32,
+        ln_placement="post",
+        act=_ACT_MAP[act],
+        ln_eps=float(getattr(hf_config, "layer_norm_eps", 1e-12)),
+    )
+
+
+def params_from_bert_state_dict(state: dict[str, Any], cfg: EncoderConfig) -> dict:
+    """Map a (torch) BERT state dict onto the encoder's param pytree.
+
+    Accepts both `bert.encoder.layer...` and `encoder.layer...` prefixes.
+    Linear weights transpose (torch stores out x in)."""
+    import jax.numpy as jnp
+
+    def get(name: str) -> np.ndarray:
+        for prefix in ("", "bert."):
+            key = prefix + name
+            if key in state:
+                v = state[key]
+                return np.asarray(v.detach().cpu().numpy() if hasattr(v, "detach") else v)
+        raise KeyError(name)
+
+    def lin_w(name: str) -> np.ndarray:
+        return get(name).T  # torch Linear: (out, in) -> (in, out)
+
+    params: dict = {
+        "embed": jnp.asarray(get("embeddings.word_embeddings.weight")),
+        "pos_embed": jnp.asarray(get("embeddings.position_embeddings.weight")),
+        "ln_e_scale": jnp.asarray(get("embeddings.LayerNorm.weight")),
+        "ln_e_bias": jnp.asarray(get("embeddings.LayerNorm.bias")),
+        # post-LN models have no final LN; keep identity for API shape
+        "ln_f_scale": jnp.ones((cfg.d_model,), jnp.float32),
+        "ln_f_bias": jnp.zeros((cfg.d_model,), jnp.float32),
+        "layers": [],
+    }
+    # token_type embeddings fold into the embedding table when all inputs are
+    # segment 0 (the embedding lookup adds them per token)
+    try:
+        tt = get("embeddings.token_type_embeddings.weight")
+        params["embed"] = params["embed"] + jnp.asarray(tt[0])[None, :]
+    except KeyError:
+        pass
+    for i in range(cfg.n_layers):
+        p = f"encoder.layer.{i}."
+        layer = {
+            "wq": jnp.asarray(lin_w(p + "attention.self.query.weight")),
+            "bq": jnp.asarray(get(p + "attention.self.query.bias")),
+            "wk": jnp.asarray(lin_w(p + "attention.self.key.weight")),
+            "bk": jnp.asarray(get(p + "attention.self.key.bias")),
+            "wv": jnp.asarray(lin_w(p + "attention.self.value.weight")),
+            "bv": jnp.asarray(get(p + "attention.self.value.bias")),
+            "wo": jnp.asarray(lin_w(p + "attention.output.dense.weight")),
+            "bo": jnp.asarray(get(p + "attention.output.dense.bias")),
+            "w_up": jnp.asarray(lin_w(p + "intermediate.dense.weight")),
+            "b_up": jnp.asarray(get(p + "intermediate.dense.bias")),
+            "w_down": jnp.asarray(lin_w(p + "output.dense.weight")),
+            "b_down": jnp.asarray(get(p + "output.dense.bias")),
+            "ln1_scale": jnp.asarray(get(p + "attention.output.LayerNorm.weight")),
+            "ln1_bias": jnp.asarray(get(p + "attention.output.LayerNorm.bias")),
+            "ln2_scale": jnp.asarray(get(p + "output.LayerNorm.weight")),
+            "ln2_bias": jnp.asarray(get(p + "output.LayerNorm.bias")),
+        }
+        params["layers"].append(layer)
+    return params
+
+
+def load_hf_encoder(model_name_or_path: str):
+    """Load a local BERT-family model into (params, cfg, hf_tokenizer).
+
+    No network access: the model must be importable locally (a saved
+    directory, or a randomly-initialized config for testing)."""
+    from transformers import AutoConfig, AutoModel, AutoTokenizer
+
+    hf_cfg = AutoConfig.from_pretrained(model_name_or_path)
+    model = AutoModel.from_pretrained(model_name_or_path)
+    cfg = config_from_hf(hf_cfg)
+    params = params_from_bert_state_dict(model.state_dict(), cfg)
+    try:
+        tok = AutoTokenizer.from_pretrained(model_name_or_path)
+    except Exception:
+        tok = None
+    return params, cfg, tok

@@ -52,8 +52,16 @@ class SentenceTransformerEmbedder(BaseEmbedder):
                  cache_strategy: CacheStrategy | None = None):
         from ...models.encoder import EncoderConfig, JaxEncoder
 
+        import os
+
         self.model_name = model or "pathway-tpu-minilm"
-        self._enc = JaxEncoder(config or EncoderConfig(), seed=seed)
+        if model is not None and config is None and os.path.exists(model):
+            # a local checkpoint path = BERT-family HF weights on the TPU
+            # path (models/hf_import.py); label-style names keep the
+            # self-contained hash-tokenizer encoder (no network, no torch)
+            self._enc = JaxEncoder.from_hf(model)
+        else:
+            self._enc = JaxEncoder(config or EncoderConfig(), seed=seed)
         if cache_strategy is not None:
             self._embed = with_cache_strategy(  # type: ignore[method-assign]
                 self._embed_uncached, cache_strategy, f"emb:{self.model_name}"
