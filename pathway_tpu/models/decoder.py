@@ -28,6 +28,8 @@ class DecoderConfig:
     d_ff: int = 2048
     max_len: int = 1024
     dtype: Any = jnp.bfloat16
+    ln_eps: float = 1e-6
+    act: str = "gelu_tanh"  # gelu (exact erf) | gelu_tanh | relu
 
     def as_encoder_cfg(self) -> EncoderConfig:
         return EncoderConfig(
@@ -42,32 +44,48 @@ def init_decoder_params(cfg: DecoderConfig, rng: jax.Array) -> dict:
 
 
 def _causal_attention(layer, x, n_heads: int):
+    from .encoder import _proj
+
     B, T, D = x.shape
     H = n_heads
     hd = D // H
-    q = (x @ layer["wq"].astype(x.dtype)).reshape(B, T, H, hd)
-    k = (x @ layer["wk"].astype(x.dtype)).reshape(B, T, H, hd)
-    v = (x @ layer["wv"].astype(x.dtype)).reshape(B, T, H, hd)
+    q = _proj(layer, x, "wq", "bq").reshape(B, T, H, hd)
+    k = _proj(layer, x, "wk", "bk").reshape(B, T, H, hd)
+    v = _proj(layer, x, "wv", "bv").reshape(B, T, H, hd)
     scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(hd)
     causal = jnp.tril(jnp.ones((T, T), bool))
     scores = jnp.where(causal[None, None, :, :], scores, -1e9)
     probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(x.dtype)
     out = jnp.einsum("bhqk,bkhd->bqhd", probs, v).reshape(B, T, D)
-    return out @ layer["wo"].astype(x.dtype)
+    return _proj(layer, out, "wo", "bo")
 
 
 def forward_logits(params: dict, cfg: DecoderConfig, token_ids: jax.Array) -> jax.Array:
-    """(B, T) -> (B, T, V) logits (tied embedding head)."""
+    """(B, T) -> (B, T, V) logits (tied embedding head).
+
+    Pre-LN residual blocks — structurally GPT-2's forward, so GPT-2-family
+    weights map directly (models/hf_import.py)."""
+    from .encoder import _proj
+
     x = params["embed"].astype(cfg.dtype)[token_ids]
     T = token_ids.shape[1]
     x = x + params["pos_embed"].astype(cfg.dtype)[:T][None, :, :]
+    eps = cfg.ln_eps
+
+    def act(v):
+        if cfg.act == "gelu":
+            return jax.nn.gelu(v, approximate=False)
+        if cfg.act == "gelu_tanh":
+            return jax.nn.gelu(v, approximate=True)
+        return jax.nn.relu(v)
+
     for layer in params["layers"]:
-        h = _layer_norm(x, layer["ln1_scale"], layer["ln1_bias"])
+        h = _layer_norm(x, layer["ln1_scale"], layer["ln1_bias"], eps)
         x = x + _causal_attention(layer, h, cfg.n_heads)
-        h = _layer_norm(x, layer["ln2_scale"], layer["ln2_bias"])
-        ff = jax.nn.gelu(h @ layer["w_up"].astype(x.dtype))
-        x = x + ff @ layer["w_down"].astype(x.dtype)
-    x = _layer_norm(x, params["ln_f_scale"], params["ln_f_bias"])
+        h = _layer_norm(x, layer["ln2_scale"], layer["ln2_bias"], eps)
+        ff = act(_proj(layer, h, "w_up", "b_up"))
+        x = x + _proj(layer, ff, "w_down", "b_down")
+    x = _layer_norm(x, params["ln_f_scale"], params["ln_f_bias"], eps)
     return (x @ params["embed"].astype(x.dtype).T).astype(jnp.float32)
 
 
@@ -112,12 +130,18 @@ class JaxDecoderLM:
     """
 
     def __init__(self, cfg: DecoderConfig | None = None, seed: int = 0,
-                 seq_buckets=(64, 256, 1024)):
+                 seq_buckets=(64, 256, 1024), params: dict | None = None,
+                 tokenizer=None):
         self.cfg = cfg or DecoderConfig()
-        self.params = init_decoder_params(self.cfg, jax.random.PRNGKey(seed))
-        from .tokenizer import HashTokenizer
+        self.params = (
+            params if params is not None
+            else init_decoder_params(self.cfg, jax.random.PRNGKey(seed))
+        )
+        if tokenizer is None:
+            from .tokenizer import HashTokenizer
 
-        self.tokenizer = HashTokenizer(self.cfg.vocab_size)
+            tokenizer = HashTokenizer(self.cfg.vocab_size)
+        self.tokenizer = tokenizer
         self.seq_buckets = [b for b in seq_buckets if b <= self.cfg.max_len] or [
             self.cfg.max_len
         ]
@@ -127,6 +151,19 @@ class JaxDecoderLM:
             return jnp.argmax(logits[0, pos])
 
         self._next_token = jax.jit(next_token)
+
+    @classmethod
+    def from_hf(cls, model_name_or_path: str, **kwargs) -> "JaxDecoderLM":
+        """Run a locally-available GPT-2-family model on the TPU path."""
+        from .hf_import import load_hf_decoder
+
+        params, cfg, hf_tok = load_hf_decoder(model_name_or_path)
+        tok = None
+        if hf_tok is not None:
+            from .encoder import _HFTokenizerAdapter
+
+            tok = _HFTokenizerAdapter(hf_tok)
+        return cls(cfg, params=params, tokenizer=tok, **kwargs)
 
     def _bucket(self, n: int) -> int:
         for b in self.seq_buckets:
@@ -152,4 +189,6 @@ class JaxDecoderLM:
             else:
                 buf[0, :-1] = buf[0, 1:]
                 buf[0, -1] = nxt
+        if hasattr(self.tokenizer, "decode"):
+            return self.tokenizer.decode(out)
         return " ".join(f"<{t}>" for t in out)

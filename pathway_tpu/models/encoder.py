@@ -231,5 +231,8 @@ class _HFTokenizerAdapter:
     def encode(self, text: str) -> list[int]:
         return self._tok.encode(text, add_special_tokens=True)
 
+    def decode(self, ids: list[int]) -> str:
+        return self._tok.decode(ids, skip_special_tokens=True)
+
     def count_tokens(self, text: str) -> int:
         return len(self.encode(text))

@@ -27,6 +27,12 @@ _ACT_MAP = {
 def config_from_hf(hf_config) -> EncoderConfig:
     import jax.numpy as jnp
 
+    if getattr(hf_config, "model_type", None) != "bert":
+        raise ValueError(
+            f"expected a BERT-family config, got model_type="
+            f"{getattr(hf_config, 'model_type', None)!r} (GPT-2-family models "
+            "load via JaxDecoderLM.from_hf)"
+        )
     act = getattr(hf_config, "hidden_act", "gelu")
     if act not in _ACT_MAP:
         raise ValueError(
@@ -111,6 +117,98 @@ def params_from_bert_state_dict(state: dict[str, Any], cfg: EncoderConfig) -> di
     return params
 
 
+def config_from_gpt2(hf_config):
+    """GPT-2-family config -> DecoderConfig (pre-LN, tanh gelu, tied head)."""
+    import jax.numpy as jnp
+
+    from .decoder import DecoderConfig
+
+    if getattr(hf_config, "model_type", None) != "gpt2":
+        raise ValueError(
+            f"expected a GPT-2-family config, got model_type="
+            f"{getattr(hf_config, 'model_type', None)!r} (BERT-family models "
+            "load via JaxEncoder.from_hf)"
+        )
+    act = getattr(hf_config, "activation_function", "gelu_new")
+    if act not in _ACT_MAP:
+        raise ValueError(f"unsupported activation_function {act!r}")
+    return DecoderConfig(
+        vocab_size=hf_config.vocab_size,
+        d_model=hf_config.n_embd,
+        n_layers=hf_config.n_layer,
+        n_heads=hf_config.n_head,
+        d_ff=getattr(hf_config, "n_inner", None) or 4 * hf_config.n_embd,
+        max_len=hf_config.n_positions,
+        dtype=jnp.float32,
+        ln_eps=float(getattr(hf_config, "layer_norm_epsilon", 1e-5)),
+        act=_ACT_MAP[act],
+    )
+
+
+def params_from_gpt2_state_dict(state: dict[str, Any], cfg) -> dict:
+    """Map a (torch) GPT-2 state dict onto the decoder's param pytree.
+
+    GPT-2 uses Conv1D (weights already (in, out)) and a fused qkv
+    projection; the lm head is tied to wte (as is our logits head)."""
+    import jax.numpy as jnp
+
+    def get(name: str) -> np.ndarray:
+        for prefix in ("", "transformer."):
+            key = prefix + name
+            if key in state:
+                v = state[key]
+                return np.asarray(v.detach().cpu().numpy() if hasattr(v, "detach") else v)
+        raise KeyError(name)
+
+    D = cfg.d_model
+    params: dict = {
+        "embed": jnp.asarray(get("wte.weight")),
+        "pos_embed": jnp.asarray(get("wpe.weight")),
+        "ln_f_scale": jnp.asarray(get("ln_f.weight")),
+        "ln_f_bias": jnp.asarray(get("ln_f.bias")),
+        "layers": [],
+    }
+    for i in range(cfg.n_layers):
+        p = f"h.{i}."
+        c_attn_w = get(p + "attn.c_attn.weight")  # (D, 3D)
+        c_attn_b = get(p + "attn.c_attn.bias")  # (3D,)
+        layer = {
+            "wq": jnp.asarray(c_attn_w[:, :D]),
+            "bq": jnp.asarray(c_attn_b[:D]),
+            "wk": jnp.asarray(c_attn_w[:, D : 2 * D]),
+            "bk": jnp.asarray(c_attn_b[D : 2 * D]),
+            "wv": jnp.asarray(c_attn_w[:, 2 * D :]),
+            "bv": jnp.asarray(c_attn_b[2 * D :]),
+            "wo": jnp.asarray(get(p + "attn.c_proj.weight")),
+            "bo": jnp.asarray(get(p + "attn.c_proj.bias")),
+            "w_up": jnp.asarray(get(p + "mlp.c_fc.weight")),
+            "b_up": jnp.asarray(get(p + "mlp.c_fc.bias")),
+            "w_down": jnp.asarray(get(p + "mlp.c_proj.weight")),
+            "b_down": jnp.asarray(get(p + "mlp.c_proj.bias")),
+            "ln1_scale": jnp.asarray(get(p + "ln_1.weight")),
+            "ln1_bias": jnp.asarray(get(p + "ln_1.bias")),
+            "ln2_scale": jnp.asarray(get(p + "ln_2.weight")),
+            "ln2_bias": jnp.asarray(get(p + "ln_2.bias")),
+        }
+        params["layers"].append(layer)
+    return params
+
+
+def load_hf_decoder(model_name_or_path: str):
+    """Load a local GPT-2-family model into (params, cfg, hf_tokenizer)."""
+    from transformers import AutoConfig, AutoModel, AutoTokenizer
+
+    hf_cfg = AutoConfig.from_pretrained(model_name_or_path)
+    cfg = config_from_gpt2(hf_cfg)  # validates BEFORE the heavy model load
+    model = AutoModel.from_pretrained(model_name_or_path)
+    params = params_from_gpt2_state_dict(model.state_dict(), cfg)
+    try:
+        tok = AutoTokenizer.from_pretrained(model_name_or_path)
+    except Exception:
+        tok = None
+    return params, cfg, tok
+
+
 def load_hf_encoder(model_name_or_path: str):
     """Load a local BERT-family model into (params, cfg, hf_tokenizer).
 
@@ -119,8 +217,8 @@ def load_hf_encoder(model_name_or_path: str):
     from transformers import AutoConfig, AutoModel, AutoTokenizer
 
     hf_cfg = AutoConfig.from_pretrained(model_name_or_path)
+    cfg = config_from_hf(hf_cfg)  # validates BEFORE the heavy model load
     model = AutoModel.from_pretrained(model_name_or_path)
-    cfg = config_from_hf(hf_cfg)
     params = params_from_bert_state_dict(model.state_dict(), cfg)
     try:
         tok = AutoTokenizer.from_pretrained(model_name_or_path)

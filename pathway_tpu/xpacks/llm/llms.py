@@ -49,10 +49,16 @@ class JaxChat(BaseChat):
 
     def __init__(self, config=None, *, seed: int = 0, max_new_tokens: int = 64,
                  params=None, model: str | None = None, **kwargs):
+        import os
+
         from ...models.decoder import DecoderConfig, JaxDecoderLM
 
         self.model_name = model or "pathway-tpu-decoder"
-        self._lm = JaxDecoderLM(config or DecoderConfig(), seed=seed)
+        if model is not None and config is None and os.path.exists(model):
+            # a local checkpoint path = GPT-2-family HF weights on the TPU path
+            self._lm = JaxDecoderLM.from_hf(model)
+        else:
+            self._lm = JaxDecoderLM(config or DecoderConfig(), seed=seed)
         if params is not None:
             self._lm.params = params
         self.max_new_tokens = max_new_tokens

@@ -48,6 +48,44 @@ def test_bert_forward_parity():
     assert diff.max() < 2e-4, diff.max()
 
 
+def test_gpt2_logits_parity():
+    import jax.numpy as jnp
+    from transformers import GPT2Config, GPT2LMHeadModel
+
+    from pathway_tpu.models.decoder import forward_logits
+    from pathway_tpu.models.hf_import import (
+        config_from_gpt2,
+        params_from_gpt2_state_dict,
+    )
+
+    torch.manual_seed(0)
+    hf = GPT2Config(vocab_size=150, n_embd=32, n_layer=2, n_head=4, n_positions=24)
+    model = GPT2LMHeadModel(hf).eval()
+    cfg = config_from_gpt2(hf)
+    params = params_from_gpt2_state_dict(model.transformer.state_dict(), cfg)
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, 150, (2, 10))
+    with torch.no_grad():
+        ref = model(input_ids=torch.tensor(ids)).logits.numpy()
+    ours = np.asarray(forward_logits(params, cfg, jnp.asarray(ids, jnp.int32)))
+    assert np.abs(ours - ref).max() < 5e-4
+    assert (ours[:, -1].argmax(-1) == ref[:, -1].argmax(-1)).all()
+
+
+def test_gpt2_generate_from_saved(tmp_path):
+    from transformers import GPT2Config, GPT2LMHeadModel
+
+    torch.manual_seed(0)
+    hf = GPT2Config(vocab_size=150, n_embd=32, n_layer=2, n_head=4, n_positions=64)
+    GPT2LMHeadModel(hf).transformer.save_pretrained(str(tmp_path / "tinygpt"))
+
+    from pathway_tpu.models.decoder import JaxDecoderLM
+
+    lm = JaxDecoderLM.from_hf(str(tmp_path / "tinygpt"))
+    out = lm.generate("hello", max_new_tokens=3)
+    assert isinstance(out, str) and out
+
+
 def test_hf_encoder_end_to_end(tmp_path):
     """Save a random tiny BERT locally, load via JaxEncoder.from_hf, embed."""
     hf_cfg, model = _tiny_bert()
