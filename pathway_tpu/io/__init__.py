@@ -85,7 +85,7 @@ sys.modules["pathway_tpu.io.null"] = null
 
 from . import http  # noqa: E402  (needs subscribe defined)
 
-CsvParserSettings = dict
+from .csv import CsvParserSettings  # noqa: E402
 OnChangeCallback = Any
 OnFinishCallback = Any
 

@@ -3,6 +3,7 @@
 from __future__ import annotations
 
 import csv as _csv
+import dataclasses
 
 from ..internals.schema import SchemaMetaclass
 from ..internals.table import Table
@@ -16,9 +17,45 @@ from ._utils import (
 )
 
 
-def _parse_csv_file(path: str) -> list[dict]:
-    with open(path, newline="", encoding="utf-8") as f:
-        return list(_csv.DictReader(f))
+@dataclasses.dataclass
+class CsvParserSettings:
+    """Reference: pw.io.CsvParserSettings (dsv format options)."""
+
+    delimiter: str = ","
+    quote: str = '"'
+    escape: str | None = None
+    enable_double_quote_escapes: bool = True
+    enable_quoting: bool = True
+    comment_character: str | None = None
+
+
+def _make_parse(csv_settings):
+    if isinstance(csv_settings, dict):
+        csv_settings = CsvParserSettings(**csv_settings)
+    opts: dict = {}
+    comment = None
+    if csv_settings is not None:
+        opts["delimiter"] = csv_settings.delimiter
+        if csv_settings.enable_quoting:
+            opts["quotechar"] = csv_settings.quote
+            opts["doublequote"] = csv_settings.enable_double_quote_escapes
+        else:
+            opts["quoting"] = _csv.QUOTE_NONE
+        if csv_settings.escape:
+            opts["escapechar"] = csv_settings.escape
+        comment = csv_settings.comment_character
+
+    def parse(path: str) -> list[dict]:
+        with open(path, newline="", encoding="utf-8") as f:
+            if comment:
+                # first-byte comment rule (matches the reference's csv
+                # semantics); note: unsupported inside quoted multi-line
+                # fields, as in the reference's line-oriented reader
+                lines = (ln for ln in f if not ln.startswith(comment))
+                return list(_csv.DictReader(lines, **opts))
+            return list(_csv.DictReader(f, **opts))
+
+    return parse
 
 
 def read(
@@ -26,11 +63,12 @@ def read(
     *,
     schema: SchemaMetaclass,
     mode: str = "streaming",
-    csv_settings=None,
+    csv_settings: CsvParserSettings | dict | None = None,
     autocommit_duration_ms: int = 1500,
     with_metadata: bool = False,
     **kwargs,
 ) -> Table:
+    parse = _make_parse(csv_settings)
     if mode in ("static", "batch"):
         import glob
         import os
@@ -43,9 +81,9 @@ def read(
             files = sorted(glob.glob(path)) or [path]
         events = []
         for f in sorted(files):
-            events.extend(events_from_dicts(_parse_csv_file(f), schema, seed=f))
+            events.extend(events_from_dicts(parse(f), schema, seed=f))
         return make_input_table(schema, StaticDataSource(events), name="csv")
-    source = FilePollingSource(path, _parse_csv_file, schema)
+    source = FilePollingSource(path, parse, schema)
     return make_input_table(schema, source, name="csv")
 
 
