@@ -552,6 +552,37 @@ class Table:
     # ------------------------------------------------------------------
     # misc parity helpers
     # ------------------------------------------------------------------
+    def await_futures(self) -> "Table":
+        """Keep only rows whose fully-async values have resolved (reference:
+        Table.await_futures filters exactly Pending): Pending placeholders
+        drop; the resolved revision re-inserts the row.  Error values pass
+        through untouched (remove_errors-style handling stays separate).
+        Future dtypes unwrap."""
+        from .expression import ConvertExpression
+        from .value import Pending
+
+        future_cols = [
+            n for n, d in self._dtypes.items() if isinstance(d, dt.Future)
+        ]
+        if not future_cols:
+            return self
+
+        def resolved(v) -> bool:
+            # ConvertExpression applies without the Error short-circuit, so
+            # Error-valued rows are kept (they are resolved, just poisoned)
+            return not isinstance(v, Pending)
+
+        pred = None
+        for n in future_cols:
+            check = ConvertExpression(resolved, self[n], dtype=dt.BOOL)
+            pred = check if pred is None else pred & check
+        out = self.filter(pred)
+        out._dtypes = {
+            n: (d.wrapped if isinstance(d, dt.Future) else d)
+            for n, d in out._dtypes.items()
+        }
+        return out
+
     def promise_universes_are_equal(self, other: "Table") -> "Table":
         promise_universes_equal(self, other)
         return self

@@ -1,0 +1,29 @@
+"""Table.await_futures (reference parity for fully-async columns)."""
+
+import asyncio
+
+import pathway_tpu as pw
+from pathway_tpu.debug import table_from_markdown
+from pathway_tpu.engine.runner import run_tables
+
+
+def test_await_futures_filters_pending():
+    @pw.udf(executor=pw.udfs.fully_async_executor())
+    async def up(s: str) -> str:
+        await asyncio.sleep(0.02)
+        return s.upper()
+
+    t = table_from_markdown(
+        """
+        | s
+      1 | ab
+      2 | cd
+        """
+    )
+    out = t.select(u=up(t.s)).await_futures()
+    [cap] = run_tables(out)
+    assert not any(
+        repr(r[0]) == "Pending" for _k, r, _t, _d in cap.as_list()
+    )
+    assert sorted(r[0] for r in cap.squash().values()) == ["AB", "CD"]
+    assert out._dtypes["u"].name == "STR"
