@@ -552,6 +552,54 @@ class Table:
     # ------------------------------------------------------------------
     # misc parity helpers
     # ------------------------------------------------------------------
+    @staticmethod
+    def from_columns(*args, **kwargs) -> "Table":
+        """Build a table from same-universe column references (reference:
+        Table.from_columns, internals/table.py:272)."""
+        refs = list(args) + list(kwargs.values())
+        if not refs:
+            raise ValueError("from_columns needs at least one column")
+        for r in refs:
+            if not isinstance(r, ColumnReference):
+                raise ValueError(
+                    f"from_columns takes column references, got {r!r}"
+                )
+        names = [getattr(a, "_output_name", None) or a.name for a in args]
+        names += list(kwargs.keys())
+        if len(set(names)) != len(names):
+            raise ValueError(
+                f"from_columns column names must be pairwise distinct: {names}"
+            )
+        # select() applies the standard expansion (honoring slice renames)
+        return refs[0].table.select(*args, **kwargs)
+
+    @staticmethod
+    def empty(**kwargs) -> "Table":
+        """An empty table with the given column types (reference:
+        Table.empty, internals/table.py:362)."""
+        from .datasource import StaticDataSource
+
+        node = pg.new_node("input", [], source=StaticDataSource([]))
+        dtypes = {n: dt.wrap(t) for n, t in kwargs.items()}
+        return Table(node, list(kwargs.keys()), dtypes, Universe(), name="empty")
+
+    def remove_errors(self) -> "Table":
+        """Drop rows containing Error values (reference: Table.remove_errors,
+        internals/table.py:2753)."""
+        from .expression import ConvertExpression
+        from .value import Error as _Error
+
+        def clean(v) -> bool:
+            return not isinstance(v, _Error)
+
+        pred = None
+        for n in self._colnames:
+            check = ConvertExpression(clean, self[n], dtype=dt.BOOL)
+            pred = check if pred is None else pred & check
+        if pred is None:
+            return self
+        return self.filter(pred)
+
     def await_futures(self) -> "Table":
         """Keep only rows whose fully-async values have resolved (reference:
         Table.await_futures filters exactly Pending): Pending placeholders
