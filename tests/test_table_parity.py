@@ -1,0 +1,68 @@
+"""Reference-parity Table helpers: empty / from_columns / remove_errors /
+slice (reference model: tests/test_common.py)."""
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.debug import table_from_markdown
+from pathway_tpu.internals.value import Error
+
+from .utils import run_and_squash
+
+
+def test_table_empty():
+    e = pw.Table.empty(x=int, y=str)
+    assert e.column_names() == ["x", "y"]
+    assert run_and_squash(e) == {}
+
+
+def test_empty_in_left_join():
+    e = pw.Table.empty(k=str, y=int)
+    left = table_from_markdown("k | x\na | 1", id_from=["k"])
+    j = left.join_left(e, left.k == e.k).select(x=pw.left.x, y=pw.right.y)
+    assert list(run_and_squash(j).values()) == [(1, None)]
+
+
+def test_from_columns():
+    t = table_from_markdown("a | b\n1 | 2")
+    t2 = pw.Table.from_columns(t.a, renamed=t.b)
+    assert t2.column_names() == ["a", "renamed"]
+    assert list(run_and_squash(t2).values()) == [(1, 2)]
+
+
+def test_from_columns_validation():
+    t = table_from_markdown("a | b\n1 | 2")
+    with pytest.raises(ValueError):
+        pw.Table.from_columns(t.a, a=t.b)  # duplicate name
+    with pytest.raises(ValueError):
+        pw.Table.from_columns(t.a, t.b + 1)  # not a reference
+
+
+def test_remove_errors():
+    t = table_from_markdown(
+        """
+        | a | b
+      1 | 1 | 1
+      2 | 2 | 0
+        """
+    )
+    out = t.select(a=t.a, d=t.a // t.b).remove_errors()
+    assert list(run_and_squash(out).values()) == [(1, 1)]
+
+
+def test_fill_error_then_no_errors():
+    t = table_from_markdown(
+        """
+        | a | b
+      1 | 2 | 0
+        """
+    )
+    out = t.select(d=pw.fill_error(t.a // t.b, -1)).remove_errors()
+    assert list(run_and_squash(out).values()) == [(-1,)]
+
+
+def test_slice_select():
+    t = table_from_markdown("a | b | c\n1 | 2 | 3")
+    out = t.select(*t.slice.without("c").with_suffix("_v"))
+    assert out.column_names() == ["a_v", "b_v"]
+    assert list(run_and_squash(out).values()) == [(1, 2)]
