@@ -87,12 +87,9 @@ class SumState(ReducerState):
         v = args[0]
         if v is None:
             return
-        if isinstance(self.total, int) and isinstance(v, float):
-            self.total = float(self.total)
-        if isinstance(v, np.ndarray):
-            self.total = self.total + v * diff if not isinstance(self.total, int) else v * diff
-        else:
-            self.total += v * diff
+        # works for scalar and ndarray alike; a prior scalar total broadcasts
+        # into the array accumulation instead of being discarded
+        self.total = self.total + v * diff
         self.count += diff
 
     def bulk_add(self, total_diff: int, weighted_sum) -> None:

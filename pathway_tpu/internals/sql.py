@@ -8,6 +8,7 @@ raises with a clear message.
 
 from __future__ import annotations
 
+import ast
 import re
 from typing import Any
 
@@ -141,6 +142,194 @@ def _parse_output(col: str, t: Table):
     return name, e
 
 
+def _quote_split(txt: str) -> tuple[str, list[str]]:
+    """Pull single-quoted SQL string literals out into placeholders so the
+    keyword/operator rewrites never touch text inside quotes ('a=b AND c'
+    stays intact).  '' inside a literal is the SQL escape for one quote."""
+    out: list[str] = []
+    lits: list[str] = []
+    i, n = 0, len(txt)
+    while i < n:
+        ch = txt[i]
+        if ch == "'":
+            j = i + 1
+            buf: list[str] = []
+            while j < n:
+                if txt[j] == "'":
+                    if j + 1 < n and txt[j + 1] == "'":
+                        buf.append("'")
+                        j += 2
+                        continue
+                    break
+                buf.append(txt[j])
+                j += 1
+            else:
+                raise NotImplementedError(f"unterminated string literal in {txt!r}")
+            out.append(f" __litstr_{len(lits)}__ ")
+            lits.append("".join(buf))
+            i = j + 1
+        else:
+            out.append(ch)
+            i += 1
+    return "".join(out), lits
+
+
+_ALLOWED_BINOPS = {
+    "Add": lambda a, b: a + b,
+    "Sub": lambda a, b: a - b,
+    "Mult": lambda a, b: a * b,
+    "Div": lambda a, b: a / b,
+    "Mod": lambda a, b: a % b,
+    "BitAnd": lambda a, b: a & b,
+    "BitOr": lambda a, b: a | b,
+    "FloorDiv": lambda a, b: a // b,
+}
+_ALLOWED_CMPOPS = {
+    "Eq": lambda a, b: a == b,
+    "NotEq": lambda a, b: a != b,
+    "Lt": lambda a, b: a < b,
+    "LtE": lambda a, b: a <= b,
+    "Gt": lambda a, b: a > b,
+    "GtE": lambda a, b: a >= b,
+}
+
+
+def _eval_ast(node, names: dict, lits: list[str]):
+    """Whitelist AST interpreter — no eval(): only names, constants,
+    arithmetic/comparison/bitwise operators.  Attribute access, subscripts,
+    calls, comprehensions etc. are rejected, so no dunder-chain escapes."""
+    if isinstance(node, ast.Expression):
+        return _eval_ast(node.body, names, lits)
+    if isinstance(node, ast.Constant):
+        if isinstance(node.value, (int, float, bool, str)) or node.value is None:
+            return node.value
+        raise NotImplementedError(f"unsupported literal {node.value!r}")
+    if isinstance(node, ast.Name):
+        m = re.match(r"^__litstr_(\d+)__$", node.id)
+        if m:
+            return lits[int(m.group(1))]
+        low = node.id.lower()
+        if low == "null" or low == "none":
+            return None
+        if low == "true":
+            return True
+        if low == "false":
+            return False
+        if node.id in names:
+            return names[node.id]
+        raise NotImplementedError(f"unknown column {node.id!r}")
+    if isinstance(node, ast.BinOp):
+        opname = type(node.op).__name__
+        if opname not in _ALLOWED_BINOPS:
+            raise NotImplementedError(f"unsupported operator {opname}")
+        return _ALLOWED_BINOPS[opname](
+            _eval_ast(node.left, names, lits), _eval_ast(node.right, names, lits)
+        )
+    if isinstance(node, ast.UnaryOp):
+        opname = type(node.op).__name__
+        v = _eval_ast(node.operand, names, lits)
+        if opname == "USub":
+            return -v
+        if opname in ("Invert", "Not"):
+            return (not v) if isinstance(v, bool) else ~v
+        raise NotImplementedError(f"unsupported unary operator {opname}")
+    if isinstance(node, ast.Compare):
+        if len(node.ops) != 1:
+            raise NotImplementedError("chained comparisons unsupported in SQL")
+        opname = type(node.ops[0]).__name__
+        if opname not in _ALLOWED_CMPOPS:
+            raise NotImplementedError(f"unsupported comparison {opname}")
+        return _ALLOWED_CMPOPS[opname](
+            _eval_ast(node.left, names, lits),
+            _eval_ast(node.comparators[0], names, lits),
+        )
+    raise NotImplementedError(f"unsupported SQL syntax node {type(node).__name__}")
+
+
+def _split_keyword(s: str, kw: str) -> list[str]:
+    """Split on a boolean keyword at paren depth 0 (quotes already extracted
+    into placeholders by _quote_split)."""
+    matches = [(m.start(), m.end()) for m in re.finditer(rf"(?i)\b{kw}\b", s)]
+    if not matches:
+        return [s]
+    parts: list[str] = []
+    depth = 0
+    last = 0
+    mi = 0
+    for idx, ch in enumerate(s):
+        if ch == "(":
+            depth += 1
+        elif ch == ")":
+            depth -= 1
+        while mi < len(matches) and matches[mi][0] == idx:
+            if depth == 0:
+                parts.append(s[last:idx])
+                last = matches[mi][1]
+            mi += 1
+    parts.append(s[last:])
+    return parts
+
+
+def _strip_outer_parens(s: str) -> str | None:
+    """'(…)' → '…' when the parens wrap the whole expression, else None."""
+    if not (s.startswith("(") and s.endswith(")")):
+        return None
+    depth = 0
+    for i, ch in enumerate(s):
+        if ch == "(":
+            depth += 1
+        elif ch == ")":
+            depth -= 1
+            if depth == 0 and i != len(s) - 1:
+                return None
+    return s[1:-1]
+
+
+def _parse_bool(s: str, names: dict, lits: list[str]):
+    """SQL boolean grammar: OR < AND < NOT < comparison — each comparison
+    clause is evaluated as its own atom, so Python's `&`-binds-tighter-than-
+    `==` precedence never mangles `a = 1 AND b = 2`."""
+    ors = _split_keyword(s, "OR")
+    if len(ors) > 1:
+        res = _parse_bool(ors[0], names, lits)
+        for p in ors[1:]:
+            res = res | _parse_bool(p, names, lits)
+        return res
+    ands = _split_keyword(s, "AND")
+    if len(ands) > 1:
+        res = _parse_bool(ands[0], names, lits)
+        for p in ands[1:]:
+            res = res & _parse_bool(p, names, lits)
+        return res
+    s2 = s.strip()
+    m = re.match(r"(?is)^NOT\b(.*)$", s2)
+    if m:
+        v = _parse_bool(m.group(1), names, lits)
+        # a constant-folded predicate is a plain bool: ~False would be -1
+        return (not v) if isinstance(v, bool) else ~v
+    inner = _strip_outer_parens(s2)
+    if inner is not None:
+        return _parse_bool(inner, names, lits)
+    return _parse_atom(s2, names, lits)
+
+
+def _parse_atom(s: str, names: dict, lits: list[str]):
+    py = re.sub(r"(?<![<>!=])=(?!=)", "==", s)
+    py = re.sub(r"(?i)\s+IS\s+NOT\s+", " != ", py)
+    py = re.sub(r"(?i)\s+IS\s+", " == ", py)
+    py = re.sub(r"<>", "!=", py)
+    try:
+        tree = ast.parse(py, mode="eval")
+    except SyntaxError as exc:
+        raise NotImplementedError(f"unsupported SQL expression: {s!r} ({exc})")
+    try:
+        return _eval_ast(tree, names, lits)
+    except NotImplementedError:
+        raise
+    except Exception as exc:
+        raise NotImplementedError(f"unsupported SQL expression: {s!r} ({exc})")
+
+
 def _parse_expr(txt: str, t: Table) -> Any:
     txt = txt.strip()
     magg = re.match(r"(?is)^(count|sum|avg|min|max)\s*\((.*)\)$", txt)
@@ -150,13 +339,6 @@ def _parse_expr(txt: str, t: Table) -> Any:
         if inner == "*":
             return reducers.count()
         return fn(_parse_expr(inner, t))
-    # binary comparisons / arithmetic via safe eval over column names
     names = {n: t[n] for n in t.column_names()}
-    py = re.sub(r"(?<![<>!=])=(?!=)", "==", txt)
-    py = re.sub(r"(?i)\bAND\b", "&", py)
-    py = re.sub(r"(?i)\bOR\b", "|", py)
-    py = re.sub(r"(?i)\bNOT\b", "~", py)
-    try:
-        return eval(py, {"__builtins__": {}}, names)  # noqa: S307 - controlled env
-    except Exception as exc:
-        raise NotImplementedError(f"unsupported SQL expression: {txt!r} ({exc})")
+    protected, lits = _quote_split(txt)
+    return _parse_bool(protected, names, lits)

@@ -74,10 +74,21 @@ def events_from_dicts(
     events = []
     if pk:
         # primary-key keys must match pointer_from()-derived keys, so they
-        # always use the canonical ref_scalar hash
+        # hash the *coerced* typed values (the reference keys off
+        # parse_with_type output, src/connectors/data_format/dsv.rs) — raw
+        # connector strings would type-tag differently from int/float pks.
+        # Unparseable pk values fall back to the raw value so distinct bad
+        # rows never collapse onto the shared ERROR sentinel's key.
+        from ..internals.value import ERROR
+
+        pk_idx = [colnames.index(c) for c in pk]
         for d in dicts:
             row = tuple(coerce_value(d.get(c), dtypes[c]) for c in colnames)
-            events.append((time, ref_scalar(*[d.get(c) for c in pk]), row, 1))
+            kvals = [
+                row[i] if row[i] is not ERROR else d.get(colnames[i])
+                for i in pk_idx
+            ]
+            events.append((time, ref_scalar(*kvals), row, 1))
         return events
     # auto keys are content+position based and never recomputed elsewhere —
     # batched through the native hashing tier when available
@@ -129,6 +140,7 @@ class FilePollingSource(DataSource):
         self.poll_interval_s = poll_interval_s
         self._seen: dict[str, float] = {}
         self._progress: dict[str, int] = {}  # file -> rows already emitted
+        self._fails: dict[str, tuple[float, int]] = {}  # file -> (mtime, count)
         self._last_poll = 0.0
 
     def is_live(self) -> bool:
@@ -163,11 +175,27 @@ class FilePollingSource(DataSource):
                 continue
             if self._seen.get(f) == mtime:
                 continue
-            self._seen[f] = mtime
             try:
                 dicts = self.parse_file(f)
             except Exception:
+                # mid-write or unreadable: retry on later polls rather than
+                # silently skipping the file's rows — but a file that keeps
+                # failing at the same mtime is never-parseable, not mid-write:
+                # warn once and mark it seen so we stop burning CPU on it
+                fm, fc = self._fails.get(f, (mtime, 0))
+                fc = fc + 1 if fm == mtime else 1
+                self._fails[f] = (mtime, fc)
+                if fc >= 5:
+                    import logging
+
+                    logging.getLogger(__name__).warning(
+                        "giving up parsing %s after %d failures at the same "
+                        "mtime; skipping until the file changes", f, fc,
+                    )
+                    self._seen[f] = mtime
                 continue
+            self._fails.pop(f, None)
+            self._seen[f] = mtime
             start = self._progress.get(f, 0)
             if len(dicts) <= start:
                 continue

@@ -141,6 +141,13 @@ class Config:
         self.persistence_mode = persistence_mode
 
 
+# Journal format history: v1 (round 1) keyed primary-key rows off raw
+# uncoerced connector values; v2 keys off coerced typed values.  Replaying a
+# journal written under a different keying would silently duplicate rows, so
+# a mismatched journal is discarded (clean re-ingest) with a warning.
+_JOURNAL_FORMAT_VERSION = 2
+
+
 def attach_persistence(runner, config: Config) -> None:
     """Wire input journaling + replay into a GraphRunner.
 
@@ -151,6 +158,35 @@ def attach_persistence(runner, config: Config) -> None:
     if backend is None:
         return
     lg = runner.lg
+    streams = [
+        _stream_name(idx, source) for idx, (_op, source) in enumerate(lg.input_ops)
+    ]
+    ver_b = backend.get_metadata("journal_format")
+    if ver_b is not None:
+        ver = int(ver_b)
+    elif any(backend.read_all(s) for s in streams):
+        # journals exist but carry no version stamp: written by round-1 code
+        # (which predates the metadata key) — that is format v1
+        ver = 1
+    else:
+        ver = _JOURNAL_FORMAT_VERSION
+    if ver != _JOURNAL_FORMAT_VERSION:
+        if not hasattr(backend, "replace_all"):
+            raise RuntimeError(
+                f"persistence journal format v{ver} is incompatible with "
+                f"current v{_JOURNAL_FORMAT_VERSION} and this backend cannot "
+                "discard streams; clear the persistence storage manually"
+            )
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "persistence journal format v%s != current v%s: discarding "
+            "journal and re-ingesting from sources",
+            ver, _JOURNAL_FORMAT_VERSION,
+        )
+        for s in streams:
+            backend.replace_all(s, [])
+    backend.put_metadata("journal_format", str(_JOURNAL_FORMAT_VERSION).encode())
     for idx, (op, source) in enumerate(lg.input_ops):
         stream = _stream_name(idx, source)
         # replay journal through a wrapper source; each journal record is
@@ -227,10 +263,21 @@ def _wrap_source_with_persistence(source, backend: Backend, stream: str,
             if live:
                 backend.append(stream, pickle.dumps((live, None)))
             return live
-        # resumed run over a static source that may have grown: journal wins
-        # for journaled keys, genuinely-new rows are appended + journaled
-        seen_keys = {e[1] for e in replayed}
-        fresh = [e for e in live if e[1] not in seen_keys]
+        # resumed run over a static source that may have grown: per key, the
+        # journal already covers the first count_j(k) live events (static
+        # sources replay their event log in a stable order), so only events
+        # beyond that prefix are fresh.  This re-ingests a legitimately
+        # re-added key after an add+retract pair (live count 3 > journaled 2)
+        # without re-journaling net-zero pairs on every resume.
+        from collections import Counter
+
+        jcount = Counter(e[1] for e in replayed)
+        seen_now: Counter = Counter()
+        fresh = []
+        for e in live:
+            seen_now[e[1]] += 1
+            if seen_now[e[1]] > jcount.get(e[1], 0):
+                fresh.append(e)
         if fresh:
             backend.append(stream, pickle.dumps((fresh, None)))
         return replayed + fresh

@@ -1,0 +1,337 @@
+"""Regression tests for the round-1 advisor findings (ADVICE.md)."""
+
+import numpy as np
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.debug import table_from_markdown
+
+from .utils import run_and_squash
+
+
+def test_sql_rejects_dunder_escape():
+    t = table_from_markdown(
+        """
+        | a
+      1 | 1
+        """
+    )
+    # (1).__class__ chains must be rejected, not evaluated
+    with pytest.raises(NotImplementedError):
+        pw.sql("SELECT a FROM tab WHERE a > (1).__class__", tab=t)
+    with pytest.raises(NotImplementedError):
+        pw.sql("SELECT a FROM tab WHERE a > ().__class__.__bases__[0]", tab=t)
+
+
+def test_sql_rejects_calls_and_subscripts():
+    t = table_from_markdown(
+        """
+        | a
+      1 | 1
+        """
+    )
+    with pytest.raises(NotImplementedError):
+        pw.sql("SELECT a FROM tab WHERE a > len('x')", tab=t)
+    with pytest.raises(NotImplementedError):
+        pw.sql("SELECT a FROM tab WHERE a[0] = 1", tab=t)
+
+
+def test_sql_quoted_literal_with_keywords():
+    t = table_from_markdown(
+        """
+        | s     | v
+      1 | a=b   | 1
+      2 | c     | 2
+        """
+    )
+    # '=' , 'AND' inside quoted literals must not be rewritten
+    out = pw.sql("SELECT v FROM tab WHERE s = 'a=b'", tab=t)
+    rows = run_and_squash(out)
+    assert list(rows.values()) == [(1,)]
+
+
+def test_sql_quoted_literal_with_and_or():
+    t = table_from_markdown(
+        """
+        | s        | v
+      1 | x and y  | 5
+      2 | z        | 6
+        """
+    )
+    out = pw.sql("SELECT v FROM tab WHERE s = 'x and y'", tab=t)
+    rows = run_and_squash(out)
+    assert list(rows.values()) == [(5,)]
+
+
+def test_sql_escaped_quote_literal():
+    t = table_from_markdown(
+        """
+        | s    | v
+      1 | it_s | 1
+        """
+    )
+    # '' is the SQL escape for a single quote inside a literal; just check
+    # the parse doesn't blow up and comparison semantics hold
+    out = pw.sql("SELECT v FROM tab WHERE s != 'it''s'", tab=t)
+    rows = run_and_squash(out)
+    assert list(rows.values()) == [(1,)]
+
+
+def test_primary_key_coercion_matches_pointer_from(tmp_path):
+    """CSV connectors deliver strings; int primary keys must hash the coerced
+    int so they match pointer_from()-derived pointers (ADVICE high)."""
+    import pathway_tpu.io as io
+
+    p = tmp_path / "data.csv"
+    p.write_text("id,v\n1,a\n2,b\n")
+
+    class S(pw.Schema):
+        id: int = pw.column_definition(primary_key=True)
+        v: str
+
+    t = io.csv.read(str(p), schema=S, mode="static")
+    rows = run_and_squash(t)
+    keys = set(rows.keys())
+    from pathway_tpu.internals.value import ref_scalar
+
+    assert keys == {ref_scalar(1), ref_scalar(2)}
+
+
+def test_sum_mixed_int_then_ndarray():
+    """A scalar total must be promoted, not discarded, when an ndarray value
+    arrives (ADVICE low, reducers_impl.SumState)."""
+    from pathway_tpu.engine.reducers_impl import SumState
+
+    s = SumState()
+    s._update((2,), 1, 0, None)
+    s._update((np.array([1.0, 2.0]),), 1, 0, None)
+    v = s._value()
+    assert isinstance(v, np.ndarray)
+    np.testing.assert_allclose(v, np.array([3.0, 4.0]))
+
+
+def test_file_source_retries_unparseable_file(tmp_path):
+    """A file that fails to parse must be retried on the next poll, not
+    marked seen (ADVICE medium, FilePollingSource)."""
+    from pathway_tpu.io._utils import FilePollingSource
+
+    class S(pw.Schema):
+        a: int
+
+    f = tmp_path / "x.txt"
+    f.write_text("bad")
+    calls = {"n": 0}
+
+    def parse(path):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise ValueError("mid-write")
+        return [{"a": 7}]
+
+    src = FilePollingSource(str(tmp_path / "*.txt"), parse, S, poll_interval_s=0.0)
+    assert src.poll() == []  # first parse raises -> nothing, not marked seen
+    events = src.poll()  # same mtime, retried
+    assert len(events) == 1 and events[0][2] == (7,)
+
+
+def test_persistence_readd_after_retraction(tmp_path):
+    """A key whose journaled diffs net to zero must be re-ingested when it
+    reappears in the live source (ADVICE low, persistence resume)."""
+    import pickle
+
+    from pathway_tpu.persistence import Backend, _wrap_source_with_persistence
+
+    backend = Backend.filesystem(str(tmp_path))
+
+    class FakeSource:
+        def __init__(self, events):
+            self._events = events
+
+        def is_live(self):
+            return False
+
+        def static_events(self):
+            return list(self._events)
+
+        def poll(self):
+            return None
+
+    # journal: key 1 added then retracted (nets to zero); the source's event
+    # log then GREW with a re-add of key 1 plus a new key 2
+    replayed = [(0, 1, ("a",), 1), (2, 1, ("a",), -1)]
+    live = [
+        (0, 1, ("a",), 1),
+        (2, 1, ("a",), -1),
+        (4, 1, ("a",), 1),
+        (4, 2, ("b",), 1),
+    ]
+    src = FakeSource(live)
+    _wrap_source_with_persistence(src, backend, "s", replayed, None)
+    events = src.static_events()
+    # key 1's live re-add must appear (net journal count is 0), key 2 is new
+    net = {}
+    for _t, k, _r, d in events:
+        net[k] = net.get(k, 0) + d
+    assert net.get(1, 0) == 1
+    assert net.get(2, 0) == 1
+
+
+def test_sql_compound_where_and_or():
+    t = table_from_markdown(
+        """
+        | a | b
+      1 | 1 | 2
+      2 | 1 | 3
+      3 | 2 | 2
+        """
+    )
+    out = pw.sql("SELECT a, b FROM tab WHERE a = 1 AND b = 2", tab=t)
+    assert list(run_and_squash(out).values()) == [(1, 2)]
+    out = pw.sql("SELECT a, b FROM tab WHERE a = 2 OR b = 3", tab=t)
+    assert sorted(run_and_squash(out).values()) == [(1, 3), (2, 2)]
+    out = pw.sql(
+        "SELECT a, b FROM tab WHERE (a = 1 AND b = 2) OR (a = 2 AND b = 2)",
+        tab=t,
+    )
+    assert sorted(run_and_squash(out).values()) == [(1, 2), (2, 2)]
+    out = pw.sql("SELECT a, b FROM tab WHERE NOT a = 1", tab=t)
+    assert list(run_and_squash(out).values()) == [(2, 2)]
+
+
+def test_pk_unparseable_values_stay_distinct(tmp_path):
+    import pathway_tpu.io as io
+
+    p = tmp_path / "data.csv"
+    p.write_text("id,v\nabc,a\nxyz,b\n")
+
+    class S(pw.Schema):
+        id: int = pw.column_definition(primary_key=True)
+        v: str
+
+    t = io.csv.read(str(p), schema=S, mode="static")
+    rows = run_and_squash(t)
+    assert len(rows) == 2  # bad pk values must not collide on ERROR's key
+
+
+def test_sql_not_constant_predicate():
+    t = table_from_markdown(
+        """
+        | a
+      1 | 1
+      2 | 2
+        """
+    )
+    out = pw.sql("SELECT a FROM tab WHERE NOT 1 = 2", tab=t)
+    assert len(run_and_squash(out)) == 2  # ~False must not become -1/falsy
+
+
+def test_persistence_no_rejournal_of_net_zero(tmp_path):
+    """Net-zero add/retract pairs must not be re-journaled on each resume."""
+    from pathway_tpu.persistence import Backend, _wrap_source_with_persistence
+
+    class FakeSource:
+        def __init__(self, events):
+            self._events = events
+
+        def is_live(self):
+            return False
+
+        def static_events(self):
+            return list(self._events)
+
+        def poll(self):
+            return None
+
+    live = [(0, 1, ("a",), 1), (2, 1, ("a",), -1), (0, 2, ("b",), 1)]
+    backend = Backend.mock()
+    # run 1: everything journaled
+    src = FakeSource(live)
+    _wrap_source_with_persistence(src, backend, "s", [], None)
+    src.static_events()
+    n1 = len(backend.streams.get("s", []))
+    # run 2 (resume over identical source): nothing fresh
+    import pickle
+
+    replayed = []
+    for rec in backend.read_all("s"):
+        evs, _off = pickle.loads(rec)
+        replayed.extend(evs)
+    src2 = FakeSource(live)
+    _wrap_source_with_persistence(src2, backend, "s", replayed, None)
+    events = src2.static_events()
+    assert len(backend.streams.get("s", [])) == n1  # journal did not grow
+    net = {}
+    for _t, k, _r, d in events:
+        net[k] = net.get(k, 0) + d
+    assert net.get(1, 0) == 0 and net.get(2, 0) == 1
+
+
+def test_journal_version_mismatch_discards(tmp_path):
+    from pathway_tpu.persistence import (
+        Backend, Config, attach_persistence, _stream_name,
+    )
+    import pickle
+
+    class FakeSource:
+        path = "x"
+
+        def is_live(self):
+            return False
+
+        def static_events(self):
+            return [(0, 5, ("z",), 1)]
+
+        def poll(self):
+            return None
+
+    class FakeRunner:
+        class lg:
+            pass
+
+    backend = Backend.mock()
+    src = FakeSource()
+    stream = _stream_name(0, src)
+    backend.append(stream, pickle.dumps(([(0, 9, ("old",), 1)], None)))
+    backend.put_metadata("journal_format", b"1")
+    r = FakeRunner()
+    r.lg = type("LG", (), {"input_ops": [(None, src)]})()
+    attach_persistence(r, Config(backend))
+    events = src.static_events()
+    keys = {e[1] for e in events}
+    assert 9 not in keys  # stale v1 journal discarded
+    assert 5 in keys
+    assert backend.get_metadata("journal_format") == b"2"
+
+
+def test_unversioned_journal_treated_as_v1():
+    """Round-1 journals carry no version stamp; they must be discarded, not
+    replayed under v2 keying."""
+    import pickle
+
+    from pathway_tpu.persistence import (
+        Backend, Config, attach_persistence, _stream_name,
+    )
+
+    class FakeSource:
+        path = "x"
+
+        def is_live(self):
+            return False
+
+        def static_events(self):
+            return [(0, 5, ("z",), 1)]
+
+        def poll(self):
+            return None
+
+    backend = Backend.mock()
+    src = FakeSource()
+    stream = _stream_name(0, src)
+    backend.append(stream, pickle.dumps(([(0, 9, ("old",), 1)], None)))
+    # no journal_format metadata: round-1 layout
+    r = type("R", (), {})()
+    r.lg = type("LG", (), {"input_ops": [(None, src)]})()
+    attach_persistence(r, Config(backend))
+    keys = {e[1] for e in src.static_events()}
+    assert 9 not in keys and 5 in keys
+    assert backend.get_metadata("journal_format") == b"2"
