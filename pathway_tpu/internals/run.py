@@ -38,21 +38,28 @@ def run(
     from .config import pathway_config
 
     n_shards = max(1, pathway_config.threads)
+    n_procs = max(1, pathway_config.processes)
     streaming = has_live_sources(sinks)
 
     # exactly one runner is built and instrumented
-    if n_shards > 1:
-        from ..parallel.sharded import ShardedGraphRunner
+    if n_shards > 1 or n_procs > 1:
+        from ..parallel.cluster import ClusterRunner
 
-        runner: Any = ShardedGraphRunner(sinks, n_shards=n_shards)
+        runner: Any = ClusterRunner(
+            sinks,
+            n_local_shards=n_shards,
+            pid=pathway_config.process_id,
+            nprocs=n_procs,
+            first_port=pathway_config.first_port,
+        )
         if terminate_on_error:
             from ..engine import operators as _o
 
-            for lg in runner.shard_graphs:
+            for lg in runner.graphs.values():
                 for op in lg.scheduler.operators:
                     if isinstance(op, _o.OutputOperator):
                         op.terminate_on_error = True
-        scheduler = runner.lg.scheduler  # shard-0 replicas carry the counters
+        scheduler = runner.lg.scheduler  # first-owned-shard replicas carry counters
     else:
         runner = GraphRunner(sinks, terminate_on_error=terminate_on_error)
         scheduler = runner.lg.scheduler

@@ -154,13 +154,35 @@ class FilePollingSource(DataSource):
         self._progress = dict(offsets)
         self._seen = {}
 
+    # -- cluster partitioning ---------------------------------------------
+    def set_partition(self, pid: int, nprocs: int) -> None:
+        """Worker sharding of the scan: each process reads a stable subset of
+        files (reference: per-worker source sharding,
+        src/connectors/data_storage/sharding.rs + scanner/filesystem.rs).
+        Keys are content-derived, so ownership of a row is independent of
+        which process parsed it — the cluster exchange re-routes rows to
+        their key's shard."""
+        self._partition = (pid, nprocs)
+
+    _partition: tuple[int, int] | None = None
+
     def _files(self) -> list[str]:
         if os.path.isdir(self.path):
             out = []
             for root, _dirs, files in os.walk(self.path):
                 out.extend(os.path.join(root, f) for f in files)
-            return sorted(out)
-        return sorted(glob.glob(self.path))
+            out = sorted(out)
+        else:
+            out = sorted(glob.glob(self.path))
+        if self._partition is not None:
+            import zlib
+
+            pid, n = self._partition
+            out = [
+                f for f in out
+                if zlib.crc32(os.path.basename(f).encode()) % n == pid
+            ]
+        return out
 
     def poll(self):
         now = time.monotonic()

@@ -1,0 +1,553 @@
+"""Multi-worker execution of the sharded data plane.
+
+This is the TPU-native re-design of the reference's worker cluster
+(/root/reference/src/engine/dataflow/config.rs:109-185 + vendored
+timely-dataflow): `workers = threads x processes` shards, collections
+partitioned by key, records exchanged at re-key boundaries, progress agreed
+through a deterministic per-time protocol instead of timely's asynchronous
+frontier gossip.
+
+One `ClusterRunner` per OS process owns `threads` contiguous shards and
+walks (time, topo-position, shard) in the same deterministic order on every
+process.  Exchange edges (groupby/join re-key, centralized ops) are "wait
+positions": before processing one, a process sends a mark ("I finished every
+earlier position at this time; my data for you is on the wire") and waits
+for all peers' marks — per-connection FIFO turns the mark into a data
+barrier.  After each logical time an eot exchange closes the cross-time
+race, and the coordinator (process 0) agrees the next time via an
+allreduce-min over pending times.  Output/capture operators are centralized
+on shard 0 (process 0), so sink effects happen exactly once.
+
+With n_processes == 1 there is no fabric and the same walk degrades to the
+sequential sharded execution (bit-identical to round 1's ShardedGraphRunner,
+minus its per-visit emit rebinding and O(n_ops) emission scans).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time as _time
+from collections import defaultdict
+from typing import Any, Callable
+
+from ..engine import runner as runner_mod
+from ..engine.graph import Operator
+from ..engine.types import CapturedStream, Update
+from ..internals import parse_graph as pg
+from .sharded import ShardRouter, edge_router, _CENTRAL, _SHARD_BY_KEY
+from .comm import Fabric
+
+# node kinds whose output keys equal their input keys, so key-routed
+# downstream edges never move rows between shards
+_KEY_PRESERVING = {
+    "rowwise", "filter", "update_rows", "update_cells", "concat",
+    "difference", "intersect",
+}
+
+
+class ClusterRunner:
+    def __init__(
+        self,
+        sinks: list[pg.OpNode],
+        n_local_shards: int = 1,
+        pid: int = 0,
+        nprocs: int = 1,
+        first_port: int = 10000,
+    ):
+        self.pid = pid
+        self.nprocs = nprocs
+        self.threads = n_local_shards
+        self.n_total = n_local_shards * nprocs
+        self.owned = list(
+            range(pid * n_local_shards, (pid + 1) * n_local_shards)
+        )
+        self.sinks = sinks
+        # one lowered graph per owned shard (same deterministic lowering on
+        # every process, so topo positions and operator ids line up)
+        self.graphs = {s: runner_mod.lower(sinks) for s in self.owned}
+        self.lg = self.graphs[self.owned[0]]
+        base = self.lg
+        self.topo: dict[int, list[Operator]] = {
+            s: g.scheduler.topo_order() for s, g in self.graphs.items()
+        }
+        self.n_pos = len(self.topo[self.owned[0]])
+        base_topo = self.topo[self.owned[0]]
+        pos_of_opid = {op.id: i for i, op in enumerate(base_topo)}
+        # node per position (base graph)
+        opid_to_nid = {op.id: nid for nid, op in base.by_node.items()}
+        self.nodes: dict[int, pg.OpNode] = {}
+        all_nodes = _collect_nodes(sinks)
+        for pos, op in enumerate(base_topo):
+            nid = opid_to_nid.get(op.id)
+            if nid is not None and nid in all_nodes:
+                self.nodes[pos] = all_nodes[nid]
+        # routers per (downstream pos, port)
+        self.routers: dict[tuple[int, int], ShardRouter] = {}
+        for pos, node in self.nodes.items():
+            for port in range(max(1, len(node.input_tables))):
+                self.routers[(pos, port)] = edge_router(node, port, self.n_total)
+        # per-shard edge lists: op.id -> [(down_pos, port)]
+        self.edges: dict[int, dict[int, list[tuple[int, int]]]] = {}
+        for s, topo in self.topo.items():
+            pos_of = {op.id: i for i, op in enumerate(topo)}
+            emap: dict[int, list[tuple[int, int]]] = {}
+            for op in topo:
+                emap[op.id] = [
+                    (pos_of[down.id], port) for down, port in op.downstream
+                ]
+            self.edges[s] = emap
+        # positions of input operators (base graph)
+        self.input_pos: dict[int, int] = {}  # pos -> index into input_ops
+        base_inputs = {op.id: i for i, (op, _src) in enumerate(base.input_ops)}
+        for pos, op in enumerate(base_topo):
+            if op.id in base_inputs:
+                self.input_pos[pos] = base_inputs[op.id]
+        self.wait_positions = self._compute_wait_positions()
+        # execution state
+        # pending[time][(pos, shard)] = [(producer, seq, port, updates)]
+        self.pending: dict[int, dict[tuple[int, int], list]] = defaultdict(
+            lambda: defaultdict(list)
+        )
+        self._seq = 0
+        self.frontier = -2
+        self.cur_t: int | None = None
+        # times that must run even with no data (flush-only ticks so async
+        # completions and temporal-behavior flushes fire)
+        self._force_times: set[int] = set()
+        # symmetric barrier id allocator: every process consumes ids in the
+        # same order, and ids never collide with real logical times (>= -2)
+        self._barrier_n = 0
+        self.captures: dict[int, CapturedStream] = dict(base.captures)
+        self.fabric: Fabric | None = None
+        if nprocs > 1:
+            self.fabric = Fabric(pid, nprocs, first_port)
+        # redirect each shard scheduler's route() into the cluster router —
+        # bound once here, never per visit
+        for s in self.owned:
+            self.graphs[s].scheduler.route = self._make_route(s)  # type: ignore[method-assign]
+        self.input_router = ShardRouter(_SHARD_BY_KEY, self.n_total)
+
+    # -- topology analysis -------------------------------------------------
+    def _compute_wait_positions(self) -> set[int]:
+        """Positions that can receive batches from another process: any
+        input edge whose router is not provably shard-local.  A key-routed
+        edge is local iff its producer's output is key-partitioned (keys
+        unchanged since the key-partitioned input)."""
+        keypart: dict[int, bool] = {}  # node id -> bool
+        wait: set[int] = set()
+        for pos in range(self.n_pos):
+            node = self.nodes.get(pos)
+            if node is None:
+                continue
+            if node.kind == "input":
+                keypart[node.id] = True
+                # partitioned live sources route their reads across processes
+                wait.add(pos)
+                continue
+            ups = [t._node for t in node.input_tables]
+            if node.kind in _KEY_PRESERVING and ups:
+                keypart[node.id] = all(keypart.get(u.id, False) for u in ups)
+            else:
+                keypart[node.id] = False
+            for port, up in enumerate(ups):
+                router = self.routers.get((pos, port))
+                if router is None:
+                    wait.add(pos)
+                elif router.kind == _SHARD_BY_KEY and keypart.get(up.id, False):
+                    continue  # provably local
+                else:
+                    wait.add(pos)
+        return wait
+
+    def owner_of(self, shard: int) -> int:
+        return shard // self.threads
+
+    def owns_event(self, event) -> bool:
+        """Ownership filter for replicated injection (static reads, journal
+        replay): (time, key, row, diff) belongs to this process iff the input
+        key router lands it on an owned shard."""
+        shard = self.input_router.shard_of((event[1], event[2], event[3]))
+        return self.owner_of(shard) == self.pid
+
+    # -- routing -----------------------------------------------------------
+    def _make_route(self, shard: int) -> Callable:
+        edges = self.edges[shard]
+        routers = self.routers
+
+        def route(source: Operator, time: int, updates: list[Update]) -> None:
+            if self.cur_t is not None and time < self.cur_t:
+                raise RuntimeError(
+                    f"operator {source.name} emitted at past time "
+                    f"{time} < {self.cur_t}"
+                )
+            for down_pos, port in edges[source.id]:
+                router = routers.get((down_pos, port))
+                if router is None or router.kind == _CENTRAL:
+                    self._deliver(time, down_pos, port, 0, updates)
+                    continue
+                per_shard: dict[int, list[Update]] = defaultdict(list)
+                for u in updates:
+                    per_shard[router.shard_of(u)].append(u)
+                for s2, us in per_shard.items():
+                    self._deliver(time, down_pos, port, s2, us)
+
+        return route
+
+    def _deliver(self, time: int, pos: int, port: int, shard: int,
+                 updates: list[Update]) -> None:
+        owner = self.owner_of(shard)
+        self._seq += 1
+        if owner == self.pid:
+            self.pending[time][(pos, shard)].append(
+                (self.pid, self._seq, port, updates)
+            )
+        else:
+            assert self.fabric is not None
+            self.fabric.send_data(owner, time, pos, port, shard, self._seq, updates)
+
+    def _inject(self, input_idx: int, events: list, exclusive: bool,
+                time_override: int | None = None) -> None:
+        """Feed source events.  Replicated sources (every process read the
+        whole thing, e.g. static files) keep only owned shards.  Exclusive
+        sources (one reader per event: partitioned scans, or live sources
+        pinned to one process) route their slice, shipping non-owned rows to
+        their owners over the fabric."""
+        pos = next(p for p, i in self.input_pos.items() if i == input_idx)
+        per: dict[tuple[int, int], list[Update]] = defaultdict(list)
+        for t, key, row, diff in events:
+            if time_override is not None:
+                t = time_override
+            shard = self.input_router.shard_of((key, row, diff))
+            owner = self.owner_of(shard)
+            if owner != self.pid and not exclusive:
+                continue
+            per[(t, shard)].append((key, row, diff))
+        for (t, shard), ups in per.items():
+            owner = self.owner_of(shard)
+            self._seq += 1
+            if owner == self.pid:
+                self.pending[t][(pos, shard)].append(
+                    (self.pid, self._seq, 0, ups)
+                )
+            else:
+                assert self.fabric is not None
+                self.fabric.send_data(owner, t, pos, 0, shard, self._seq, ups)
+
+    # -- per-time execution ------------------------------------------------
+    def _run_time(self, t: int) -> None:
+        self.cur_t = t
+        bucket = self.pending[t]
+        for pos in range(self.n_pos):
+            if self.fabric is not None and pos in self.wait_positions:
+                self.fabric.send_mark(t, pos)
+                self.fabric.wait_marks(t, pos)
+                for producer, seq, port, shard, updates in self.fabric.take_data(t, pos):
+                    bucket[(pos, shard)].append((producer, seq, port, updates))
+            for s in self.owned:
+                batches = bucket.pop((pos, s), None)
+                op = self.topo[s][pos]
+                if batches:
+                    batches.sort(key=lambda b: (b[0], b[1]))
+                    for _pr, _seq, port, updates in batches:
+                        op.rows_in += len(updates)
+                        op.process(port, updates, t)
+                op.flush(t)
+        if not self.pending.get(t):
+            self.pending.pop(t, None)
+        self._force_times.discard(t)
+        self.frontier = max(self.frontier, t)
+        self.cur_t = None
+        if self.fabric is not None:
+            self.fabric.send_eot(t)
+            self.fabric.wait_eot(t)
+
+    def _local_min_pending(self) -> int | None:
+        times = [t for t, b in self.pending.items() if b]
+        times.extend(self._force_times)
+        if self.fabric is not None:
+            times.extend(self.fabric.pending_times())
+        return min(times) if times else None
+
+    # -- control plane -----------------------------------------------------
+    def _agree_min(self, local: int | None) -> int | None:
+        if self.fabric is None:
+            return local
+        if self.pid == 0:
+            mins = [local]
+            for _ in range(self.nprocs - 1):
+                tag, _pid, m = self.fabric.recv_ctl()
+                assert tag == "min", tag
+                mins.append(m)
+            vals = [m for m in mins if m is not None]
+            agreed = min(vals) if vals else None
+            self.fabric.broadcast_ctl(("adv", agreed))
+            return agreed
+        else:
+            self.fabric.send_ctl(0, ("min", self.pid, local))
+            tag, agreed = self.fabric.recv_ctl()
+            assert tag == "adv", tag
+            return agreed
+
+    def _gather(self, payload: tuple) -> list | None:
+        """Workers send payload to pid0; pid0 returns the list (incl. own)."""
+        if self.fabric is None:
+            return [payload]
+        if self.pid == 0:
+            out = [payload]
+            for _ in range(self.nprocs - 1):
+                tag, p = self.fabric.recv_ctl()
+                assert tag == "rep", tag
+                out.append(p)
+            return out
+        self.fabric.send_ctl(0, ("rep", payload))
+        return None
+
+    def _broadcast(self, payload) -> Any:
+        if self.fabric is None:
+            return payload
+        if self.pid == 0:
+            self.fabric.broadcast_ctl(("cmd", payload))
+            return payload
+        tag, p = self.fabric.recv_ctl()
+        assert tag == "cmd", tag
+        return p
+
+    # -- drains ------------------------------------------------------------
+    def _agreed_drain(self) -> None:
+        """Process every globally-pending logical time in ascending order."""
+        while True:
+            m = self._agree_min(self._local_min_pending())
+            if m is None:
+                return
+            self._run_time(m)
+
+    def _input_barrier(self) -> None:
+        """Rendezvous ensuring injected/on_end emissions shipped to peers
+        have arrived before the next agreed drain decides there is no work.
+        Barrier ids live below every real logical time, and every process
+        allocates them in the same order."""
+        if self.fabric is None:
+            return
+        self._barrier_n += 1
+        bid = -10 - self._barrier_n
+        self.fabric.send_eot(bid)
+        self.fabric.wait_eot(bid)
+
+    def _end_phase(self) -> None:
+        """Graceful shutdown mirroring Scheduler.finish: interior operators'
+        on_end position by position (each followed by a full agreed drain so
+        downstream sees upstream final batches before its own on_end), then
+        sinks last."""
+        sink_positions: list[int] = []
+        for pos in range(self.n_pos):
+            base_op = self.topo[self.owned[0]][pos]
+            if not base_op.downstream:
+                sink_positions.append(pos)
+                continue
+            self.cur_t = None
+            for s in self.owned:
+                op = self.topo[s][pos]
+                # interior on_end emissions route normally (often at the end
+                # time; temporal buffers may flush at their own earlier times)
+                op.on_end()
+            self._input_barrier()
+            self._agreed_drain()
+        for pos in sink_positions:
+            for s in self.owned:
+                self.topo[s][pos].on_end()
+        self._input_barrier()
+        self._agreed_drain()
+
+    # -- sources -----------------------------------------------------------
+    def _prepare_sources(self):
+        """Partition live sources across processes where supported; pin
+        non-partitionable live sources to process 0 (reference: non-sharded
+        readers run on one worker, src/connectors/data_storage/sharding.rs).
+        Every live source has exactly one reader per event, so its events
+        are always injected exclusively (shipped to their owners)."""
+        static_srcs: list[tuple[int, Any]] = []
+        live_srcs: list[tuple[int, Any]] = []
+        for idx, (_op, source) in enumerate(self.lg.input_ops):
+            if source.is_live():
+                partitioned = False
+                if self.nprocs > 1 and hasattr(source, "set_partition"):
+                    source.set_partition(self.pid, self.nprocs)
+                    partitioned = True
+                if partitioned or self.pid == 0 or self.nprocs == 1:
+                    live_srcs.append((idx, source))
+            else:
+                static_srcs.append((idx, source))
+        return static_srcs, live_srcs
+
+    # -- public entry points ----------------------------------------------
+    def run_batch(self) -> dict[int, CapturedStream]:
+        static_srcs, live_srcs = self._prepare_sources()
+        for idx, source in static_srcs:
+            self._inject(idx, source.static_events(), exclusive=False)
+        self._input_barrier()
+        self._agreed_drain()
+        self._end_phase()
+        if self.fabric is not None:
+            self.fabric.shutdown_barrier()
+            self.fabric.close()
+        return self.captures
+
+    def run_streaming(
+        self,
+        autocommit_ms: int = 50,
+        timeout_s: float | None = None,
+        idle_stop_s: float | None = None,
+    ) -> dict[int, CapturedStream]:
+        static_srcs, live_srcs = self._prepare_sources()
+        for idx, source in static_srcs:
+            self._inject(idx, source.static_events(), exclusive=False)
+        for _idx, source in live_srcs:
+            source.start()
+        self._input_barrier()
+        self._agreed_drain()
+        start = _time.monotonic()
+        last_event = _time.monotonic()
+        finished: set[int] = set()
+        rescale_code: int | None = None
+        tracker = None
+        if os.environ.get("PATHWAY_ELASTIC") == "1" and self.pid == 0:
+            from ..engine.telemetry import WorkloadTracker
+
+            tracker = WorkloadTracker()
+        logical = self.frontier + 2
+        logical += logical % 2
+        # total live sources across the cluster (for the finish decision)
+        n_live_total = self._sum_across(len(live_srcs))
+        prev_active = True
+        while True:
+            loop_t0 = _time.monotonic()
+            # coordinator decides the tick; everyone else follows
+            if self.pid == 0:
+                slept = 0.0
+                if not prev_active:
+                    slept = autocommit_ms / 1000.0
+                    _time.sleep(slept)
+                now = _time.monotonic()
+                cmd: tuple
+                if timeout_s is not None and now - start > timeout_s:
+                    cmd = ("finish",)
+                elif idle_stop_s is not None and now - last_event > idle_stop_s:
+                    cmd = ("finish",)
+                elif rescale_code is not None:
+                    cmd = ("rescale", rescale_code)
+                else:
+                    cmd = ("tick", logical)
+                cmd = self._broadcast(cmd)
+            else:
+                slept = 0.0
+                cmd = self._broadcast(None)
+            if cmd[0] == "finish":
+                break
+            if cmd[0] == "rescale":
+                rescale_code = cmd[1]
+                break
+            t = cmd[1]
+            got_any = False
+            for idx, source in live_srcs:
+                if idx in finished:
+                    continue
+                events = source.poll()
+                if events is None:
+                    finished.add(idx)
+                    continue
+                if events:
+                    got_any = True
+                    self._inject(idx, events, exclusive=True, time_override=t)
+            self._input_barrier()
+            has_completions = any(
+                getattr(op, "_completions", None)
+                for s in self.owned
+                for op in self.topo[s]
+            )
+            if got_any or has_completions:
+                # force the tick time so every operator's flush runs even if
+                # all this tick's rows were shipped to peers
+                self._force_times.add(t)
+            # every process drains unconditionally: the agreement protocol
+            # itself discovers whether any peer has work at any time
+            self._agreed_drain()
+            # gather round state
+            reports = self._gather(
+                (len(finished), got_any, has_completions, self.frontier)
+            )
+            if self.pid == 0:
+                assert reports is not None
+                n_finished = sum(r[0] for r in reports)
+                any_events = any(r[1] for r in reports)
+                any_comps = any(r[2] for r in reports)
+                global_frontier = max(r[3] for r in reports)
+                prev_active = any_events or any_comps
+                if any_events:
+                    last_event = _time.monotonic()
+                logical = max(logical + 2, global_frontier + 2)
+                logical += logical % 2
+                if n_live_total and n_finished >= n_live_total and not any_comps:
+                    # all sources done everywhere: one more loop to broadcast
+                    timeout_s = -1.0  # force finish next round
+                if tracker is not None:
+                    now2 = _time.monotonic()
+                    loop_el = max(now2 - loop_t0, 1e-9)
+                    tracker.record(
+                        max(0.0, min(1.0, (loop_el - slept) / loop_el))
+                    )
+                    code = tracker.recommendation()
+                    if code is not None:
+                        from ..cli import MAX_PROCESSES
+                        from ..engine.telemetry import WorkloadTracker as _WT
+
+                        supervised = os.environ.get("PATHWAY_SPAWNED") == "1"
+                        at_min = (
+                            code == _WT.EXIT_CODE_DOWNSCALE and self.nprocs <= 1
+                        )
+                        at_max = (
+                            code == _WT.EXIT_CODE_UPSCALE
+                            and self.nprocs >= MAX_PROCESSES
+                        )
+                        if supervised and not at_min and not at_max:
+                            rescale_code = code
+        self._end_phase()
+        if self.fabric is not None:
+            self.fabric.shutdown_barrier()
+            self.fabric.close()
+        if rescale_code is not None:
+            print(
+                f"[pathway-tpu] workload tracker requests rescale "
+                f"(exit {rescale_code})", file=sys.stderr,
+            )
+            sys.exit(rescale_code)
+        return self.captures
+
+    def _sum_across(self, local: int) -> int:
+        reports = self._gather((local,))
+        if self.pid == 0:
+            assert reports is not None
+            total = sum(r[0] for r in reports)
+            return int(self._broadcast(("sum", total))[1])
+        return int(self._broadcast(None)[1])
+
+
+def _collect_nodes(sinks: list[pg.OpNode]) -> dict[int, pg.OpNode]:
+    seen: dict[int, pg.OpNode] = {}
+    stack = list(sinks)
+    while stack:
+        node = stack.pop()
+        if node.id in seen:
+            continue
+        seen[node.id] = node
+        stack.extend(t._node for t in node.input_tables)
+    return seen
+
+
+def run_tables_sharded(*tables, n_shards: int = 4) -> list[CapturedStream]:
+    """Single-process sharded execution (test harness parity with
+    run_tables; reference tests run suites under PATHWAY_THREADS>1)."""
+    sinks = [t._materialize_capture() for t in tables]
+    runner = ClusterRunner(sinks, n_local_shards=n_shards)
+    caps = runner.run_batch()
+    return [caps[s.id] for s in sinks]

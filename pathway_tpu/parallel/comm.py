@@ -1,0 +1,301 @@
+"""Inter-process exchange fabric for the multi-process worker cluster.
+
+TPU-first re-design of timely-dataflow's communication layer
+(/root/reference/external/timely-dataflow/communication/): the reference
+forms a localhost/remote TCP mesh between worker processes and moves typed
+serialized channels plus progress gossip over it.  Here the fabric carries
+three message families over one full TCP mesh:
+
+  - data(time, pos, port, shard, seq, updates) — update batches crossing a
+    process boundary at an exchange edge (the reference's exchange channels)
+  - mark(time, pos) — "this process finished every topo position < pos at
+    `time` and all its data for them is on the wire" (per-connection FIFO
+    makes the mark a barrier: receiving it guarantees the data arrived) —
+    the deterministic replacement for timely's frontier gossip
+  - eot(time) — "all sends stamped during `time`, including to later logical
+    times, are on the wire" (closes the cross-time race before the
+    coordinator advances the global frontier)
+  - ctl(payload) — worker->coordinator reports and coordinator broadcasts
+    (advance/tick/endphase/rescale), the jax.distributed-style host control
+    plane promised in SURVEY.md §2c
+
+Addresses: process i listens on first_port + i on localhost (multi-host
+would swap the address table, as the reference's PATHWAY_ADDRESSES does).
+Connection protocol: i dials every j < i; accepts from every j > i.
+"""
+
+from __future__ import annotations
+
+import pickle
+import queue
+import socket
+import struct
+import threading
+import time as _time
+from collections import defaultdict
+from typing import Any
+
+_LEN = struct.Struct("<I")
+
+
+class FabricError(RuntimeError):
+    pass
+
+
+class Fabric:
+    def __init__(self, pid: int, nprocs: int, first_port: int,
+                 host: str = "127.0.0.1", connect_timeout_s: float = 30.0):
+        self.pid = pid
+        self.n = nprocs
+        self.peers = [p for p in range(nprocs) if p != pid]
+        self._socks: dict[int, socket.socket] = {}
+        self._send_locks: dict[int, threading.Lock] = {}
+        self._cond = threading.Condition()
+        # data[(time, pos)] -> list[(producer_pid, seq, port, shard, updates)]
+        self._data: dict[tuple[int, int], list] = defaultdict(list)
+        # marks[peer][time] -> highest pos marked
+        self._marks: dict[int, dict[int, int]] = defaultdict(dict)
+        self._eot: set[tuple[int, int]] = set()  # (peer, time)
+        self._done_peers: set[int] = set()  # peers past their shutdown barrier
+        self._ctl: "queue.Queue[Any]" = queue.Queue()
+        self._dead: str | None = None
+        self._closed = False
+        self._connect(host, first_port, connect_timeout_s)
+        self._threads = []
+        for peer, sock in self._socks.items():
+            th = threading.Thread(
+                target=self._recv_loop, args=(peer, sock),
+                daemon=True, name=f"pw-fabric-{peer}",
+            )
+            th.start()
+            self._threads.append(th)
+
+    # -- mesh formation ----------------------------------------------------
+    def _connect(self, host: str, first_port: int, timeout_s: float) -> None:
+        listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        deadline = _time.monotonic() + timeout_s
+        while True:
+            try:
+                listener.bind((host, first_port + self.pid))
+                break
+            except OSError:
+                if _time.monotonic() > deadline:
+                    raise FabricError(
+                        f"cannot bind fabric port {first_port + self.pid}"
+                    )
+                _time.sleep(0.2)
+        listener.listen(self.n)
+        accept_from = [p for p in self.peers if p > self.pid]
+        dial_to = [p for p in self.peers if p < self.pid]
+        accepted: dict[int, socket.socket] = {}
+
+        def do_accept():
+            for _ in accept_from:
+                conn, _addr = listener.accept()
+                hello = b""
+                while len(hello) < 4:
+                    chunk = conn.recv(4 - len(hello))
+                    if not chunk:
+                        raise FabricError("peer hung up during handshake")
+                    hello += chunk
+                peer = int.from_bytes(hello, "little")
+                conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                accepted[peer] = conn
+
+        acceptor = None
+        if accept_from:
+            listener.settimeout(timeout_s)
+            acceptor = threading.Thread(target=do_accept, daemon=True)
+            acceptor.start()
+        for peer in dial_to:
+            sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            while True:
+                try:
+                    sock.connect((host, first_port + peer))
+                    break
+                except OSError:
+                    if _time.monotonic() > deadline:
+                        raise FabricError(f"cannot reach peer {peer}")
+                    _time.sleep(0.1)
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            sock.sendall(self.pid.to_bytes(4, "little"))
+            self._socks[peer] = sock
+        if acceptor is not None:
+            acceptor.join(timeout_s)
+            if len(accepted) != len(accept_from):
+                raise FabricError(
+                    f"pid {self.pid}: only {len(accepted)}/{len(accept_from)} "
+                    "peers connected"
+                )
+        self._socks.update(accepted)
+        listener.close()
+        self._send_locks = {p: threading.Lock() for p in self._socks}
+
+    # -- send --------------------------------------------------------------
+    def _send(self, peer: int, msg: tuple) -> None:
+        blob = pickle.dumps(msg, protocol=pickle.HIGHEST_PROTOCOL)
+        with self._send_locks[peer]:
+            try:
+                self._socks[peer].sendall(_LEN.pack(len(blob)) + blob)
+            except OSError as exc:
+                raise FabricError(f"peer {peer} unreachable: {exc}")
+
+    def send_data(self, peer: int, time: int, pos: int, port: int, shard: int,
+                  seq: int, updates: list) -> None:
+        self._send(peer, ("d", time, pos, port, shard, self.pid, seq, updates))
+
+    def send_mark(self, time: int, pos: int) -> None:
+        for peer in self.peers:
+            self._send(peer, ("m", time, pos))
+
+    def send_eot(self, time: int) -> None:
+        for peer in self.peers:
+            self._send(peer, ("e", time))
+
+    def send_ctl(self, peer: int, payload: Any) -> None:
+        self._send(peer, ("c", payload))
+
+    def broadcast_ctl(self, payload: Any) -> None:
+        for peer in self.peers:
+            self._send(peer, ("c", payload))
+
+    # -- receive -----------------------------------------------------------
+    def _recv_loop(self, peer: int, sock: socket.socket) -> None:
+        buf = b""
+
+        def read_exact(n: int) -> bytes | None:
+            nonlocal buf
+            while len(buf) < n:
+                try:
+                    chunk = sock.recv(1 << 16)
+                except OSError:
+                    return None
+                if not chunk:
+                    return None
+                buf += chunk
+            out, buf = buf[:n], buf[n:]
+            return out
+
+        while True:
+            header = read_exact(_LEN.size)
+            if header is None:
+                break
+            blob = read_exact(_LEN.unpack(header)[0])
+            if blob is None:
+                break
+            msg = pickle.loads(blob)
+            kind = msg[0]
+            if kind == "d":
+                _, t, pos, port, shard, producer, seq, updates = msg
+                with self._cond:
+                    self._data[(t, pos)].append(
+                        (producer, seq, port, shard, updates)
+                    )
+                    self._cond.notify_all()
+            elif kind == "m":
+                _, t, pos = msg
+                with self._cond:
+                    cur = self._marks[peer].get(t, -1)
+                    if pos > cur:
+                        self._marks[peer][t] = pos
+                    self._cond.notify_all()
+            elif kind == "e":
+                with self._cond:
+                    self._eot.add((peer, msg[1]))
+                    if msg[1] == self._SHUTDOWN_T:
+                        # peer has no protocol traffic left; its eventual
+                        # disconnect is a normal exit, not a failure
+                        self._done_peers.add(peer)
+                    self._cond.notify_all()
+            elif kind == "c":
+                self._ctl.put(msg[1])
+        with self._cond:
+            if not self._closed and peer not in self._done_peers:
+                self._dead = f"peer {peer} disconnected"
+                self._ctl.put(("__peer_lost__", peer))
+            self._cond.notify_all()
+
+    def _check(self) -> None:
+        if self._dead is not None:
+            raise FabricError(self._dead)
+
+    # -- barriers ----------------------------------------------------------
+    def wait_marks(self, time: int, pos: int, timeout_s: float = 120.0) -> None:
+        """Block until every peer marked (time, >= pos)."""
+        deadline = _time.monotonic() + timeout_s
+        with self._cond:
+            while True:
+                # success test before the death check: a peer that already
+                # delivered its mark may legitimately be gone by now
+                if all(self._marks[p].get(time, -1) >= pos for p in self.peers):
+                    return
+                self._check()
+                if not self._cond.wait(timeout=min(1.0, deadline - _time.monotonic())):
+                    if _time.monotonic() > deadline:
+                        raise FabricError(
+                            f"pid {self.pid}: mark barrier timeout at "
+                            f"(t={time}, pos={pos})"
+                        )
+
+    def wait_eot(self, time: int, timeout_s: float = 120.0) -> None:
+        deadline = _time.monotonic() + timeout_s
+        with self._cond:
+            while True:
+                if all((p, time) in self._eot for p in self.peers):
+                    # drop barrier bookkeeping for this time
+                    for p in self.peers:
+                        self._eot.discard((p, time))
+                        self._marks[p].pop(time, None)
+                    return
+                self._check()
+                if not self._cond.wait(timeout=min(1.0, deadline - _time.monotonic())):
+                    if _time.monotonic() > deadline:
+                        raise FabricError(
+                            f"pid {self.pid}: eot barrier timeout at t={time}"
+                        )
+
+    def pending_times(self) -> set[int]:
+        """Times with stashed remote data not yet taken."""
+        with self._cond:
+            return {t for (t, _pos) in self._data}
+
+    def take_data(self, time: int, pos: int) -> list:
+        """Remote batches for (time, pos), deterministically ordered."""
+        with self._cond:
+            batches = self._data.pop((time, pos), [])
+        batches.sort(key=lambda b: (b[0], b[1]))  # (producer, seq)
+        return batches
+
+    def recv_ctl(self, timeout_s: float = 120.0) -> Any:
+        try:
+            msg = self._ctl.get(timeout=timeout_s)
+        except queue.Empty:
+            raise FabricError(f"pid {self.pid}: ctl recv timeout")
+        if isinstance(msg, tuple) and msg and msg[0] == "__peer_lost__":
+            if self._closed:
+                raise FabricError("fabric closed")
+            raise FabricError(f"peer {msg[1]} disconnected")
+        return msg
+
+    _SHUTDOWN_T = -(1 << 62)
+
+    def shutdown_barrier(self, timeout_s: float = 120.0) -> None:
+        """Rendezvous before teardown: once every peer reaches this point no
+        protocol message is outstanding, so the subsequent socket closes
+        cannot be mistaken for failures."""
+        self.send_eot(self._SHUTDOWN_T)
+        self.wait_eot(self._SHUTDOWN_T, timeout_s=timeout_s)
+        self._closed = True
+
+    def close(self) -> None:
+        self._closed = True
+        for sock in self._socks.values():
+            try:
+                sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                sock.close()
+            except OSError:
+                pass

@@ -23,13 +23,10 @@ key exchange over the interconnect.
 
 from __future__ import annotations
 
-from collections import defaultdict
-from typing import Any, Callable
+from typing import Callable
 
-from ..engine import operators as ops
 from ..engine import runner as runner_mod
-from ..engine.graph import Operator, Scheduler
-from ..engine.types import CapturedStream, Update
+from ..engine.types import Update
 from ..internals import parse_graph as pg
 from ..internals.value import ref_scalar
 
@@ -126,271 +123,3 @@ def edge_router(down_node: pg.OpNode, port: int, n: int) -> ShardRouter:
     if kind in ("capture", "subscribe", "output", "raw_output"):
         return ShardRouter(_CENTRAL, n)
     return ShardRouter(_CENTRAL, n)
-
-
-class ShardedGraphRunner:
-    """Runs the lowered graph over n shards with exchange routing.
-
-    Deterministic schedule: for each logical time, walk operators in topo
-    order; for each operator, process all shards' pending batches, routing
-    emissions through the edge routers.
-    """
-
-    def __init__(self, sinks: list[pg.OpNode], n_shards: int = 2):
-        self.n = n_shards
-        self.node_by_op: dict[int, pg.OpNode] = {}
-        self.replicas: dict[int, list[Operator]] = {}
-        self.captures: dict[int, CapturedStream] = {}
-        self.input_ops: list[tuple[list[Operator], Any]] = []
-        # build one LoweredGraph per shard from the same parse graph
-        self.shard_graphs = []
-        for s in range(n_shards):
-            lg = runner_mod.lower(sinks)
-            self.shard_graphs.append(lg)
-        base = self.shard_graphs[0]
-        self.lg = base  # persistence and telemetry attach to the base graph
-        self._last_t = -2  # highest processed logical time
-        self.topo = base.scheduler.topo_order()
-        # map operator-position -> node for routing (lower() builds ops in
-        # the same order per shard)
-        for lg in self.shard_graphs[1:]:
-            assert len(lg.scheduler.topo_order()) == len(self.topo)
-        # node lookup: by_node maps node.id -> op; invert for shard 0
-        self.node_of_op0: dict[int, pg.OpNode] = {}
-        node_by_opid = {}
-        for nid, op in base.by_node.items():
-            node_by_opid[op.id] = nid
-        self.nodes = {nid: self._find_node(sinks, nid) for nid in base.by_node}
-        # per (downstream op pos, port) routers
-        self.routers: dict[tuple[int, int], ShardRouter] = {}
-        self.pos_of = {op.id: i for i, op in enumerate(self.topo)}
-        for nid, op in base.by_node.items():
-            node = self.nodes[nid]
-            if node is None:
-                continue
-            pos = self.pos_of[op.id]
-            for port in range(max(1, len(node.input_tables))):
-                self.routers[(pos, port)] = edge_router(node, port, n_shards)
-        # captures merge across shards: use shard-0 capture + feed others in
-        for nid, cap in base.captures.items():
-            self.captures[nid] = cap
-
-    @staticmethod
-    def _find_node(sinks, nid):
-        seen = set()
-        stack = list(sinks)
-        while stack:
-            node = stack.pop()
-            if node.id in seen:
-                continue
-            seen.add(node.id)
-            if node.id == nid:
-                return node
-            stack.extend(t._node for t in node.input_tables)
-        return None
-
-    def run_batch(self) -> dict[int, CapturedStream]:
-        # collect events per time, partitioned into shards by input routing
-        pending: dict[int, dict[tuple[int, int], list[tuple[int, list[Update]]]]] = (
-            defaultdict(lambda: defaultdict(list))
-        )  # time -> (op_pos, shard) -> [(port, updates)]
-        base = self.shard_graphs[0]
-        key_router = ShardRouter(_SHARD_BY_KEY, self.n)
-        for op, source in base.input_ops:
-            pos = self.pos_of[op.id]
-            for t, key, row, diff in source.static_events():
-                s = key_router.shard_of((key, row, diff))
-                pending[t][(pos, s)].append((0, [(key, row, diff)]))
-        self._drain(pending)
-        self._drain_on_end(pending)
-        return self.captures
-
-    # ------------------------------------------------------------------
-    # execution core: `pending` holds only OUTSTANDING times; _run_time
-    # removes a time's bucket after processing, so scans stay O(outstanding)
-    # and long streams neither leak memory nor slow down over time
-    # ------------------------------------------------------------------
-
-    def _drain(self, pending) -> None:
-        while True:
-            ready = [t for t, b in pending.items() if b]
-            if not ready:
-                for t in list(pending):
-                    pending.pop(t, None)
-                return
-            self._run_time(min(ready), pending)
-
-    def _drain_on_end(self, pending) -> None:
-        """Route interior on_end emissions like normal batches, then drain.
-
-        Shared by batch and streaming shutdown."""
-        end_t = self._last_t + 2
-        for pos, _base_op in enumerate(self.topo):
-            for s in range(self.n):
-                op = self.shard_graphs[s].scheduler.topo_order()[pos]
-                emitted: list = []
-                self._hook_emit(op, end_t, emitted)
-                op.on_end()
-                self._route_emissions(op, s, emitted, pending)
-        self._drain(pending)
-
-    def _run_time(self, t, pending) -> None:
-        bucket = pending.get(t, {})
-        for pos, base_op in enumerate(self.topo):
-            for s in range(self.n):
-                shard_sched = self.shard_graphs[s].scheduler
-                op = shard_sched.topo_order()[pos]
-                batches = bucket.pop((pos, s), None)
-                emitted: list[tuple[int, list[Update]]] = []
-                self._hook_emit(op, t, emitted)
-                if batches:
-                    for port, updates in batches:
-                        op.rows_in += len(updates)
-                        op.process(port, updates, t)
-                op.flush(t)
-                self._route_emissions(op, s, emitted, pending)
-        if not pending.get(t):
-            pending.pop(t, None)
-        self._last_t = max(self._last_t, t)
-
-    def _hook_emit(self, op: Operator, t, sink_list):
-        def emit(time, updates, _op=op, _sink=sink_list):
-            if updates:
-                _op.rows_out += len(updates)
-                _sink.append((time, updates))
-
-        op.emit = emit  # type: ignore[method-assign]
-
-    def _route_emissions(self, op, shard, emitted, pending):
-        node_id = None
-        for nid, o in self.shard_graphs[shard].by_node.items():
-            if o is op:
-                node_id = nid
-                break
-        if node_id is None:
-            return
-        # route downstream via the shard-0 graph topology
-        base_op = self.shard_graphs[0].by_node[node_id]
-        for time, updates in emitted:
-            for down, port in base_op.downstream:
-                pos = self.pos_of[down.id]
-                router = self.routers.get((pos, port), ShardRouter(_CENTRAL, self.n))
-                per_shard: dict[int, list[Update]] = defaultdict(list)
-                for u in updates:
-                    per_shard[router.shard_of(u)].append(u)
-                for s2, us in per_shard.items():
-                    pending[time][(pos, s2)].append((port, us))
-
-    def run_streaming(
-        self,
-        autocommit_ms: int = 50,
-        timeout_s: float | None = None,
-        idle_stop_s: float | None = None,
-    ) -> dict[int, CapturedStream]:
-        """Streaming loop over the sharded data-plane: poll sources, partition
-        each commit's events by key, process logical times across shards.
-
-        Mirrors GraphRunner.run_streaming: async-completion ticks and the
-        PATHWAY_ELASTIC workload tracker both apply here."""
-        import os as _os
-        import time as _time
-
-        base = self.shard_graphs[0]
-        pending: dict = defaultdict(lambda: defaultdict(list))
-        live = []
-        start = _time.monotonic()
-        key_router = ShardRouter(_SHARD_BY_KEY, self.n)
-        for op, source in base.input_ops:
-            pos = self.pos_of[op.id]
-            if source.is_live():
-                source.start()
-                live.append((pos, source))
-            else:
-                for t, key, row, diff in source.static_events():
-                    s = key_router.shard_of((key, row, diff))
-                    pending[t][(pos, s)].append((0, [(key, row, diff)]))
-        self._drain(pending)
-        logical = self._last_t + 2
-        logical -= logical % 2
-        last_event = _time.monotonic()
-        finished: set[int] = set()
-        tracker = None
-        if _os.environ.get("PATHWAY_ELASTIC") == "1":
-            from ..engine.telemetry import WorkloadTracker
-
-            tracker = WorkloadTracker()
-        rescale_code: int | None = None
-        all_ops = [
-            op for lg in self.shard_graphs for op in lg.scheduler.operators
-        ]
-        while live and len(finished) < len(live):
-            loop_t0 = _time.monotonic()
-            got_any = False
-            for pos, source in live:
-                if pos in finished:
-                    continue
-                events = source.poll()
-                if events is None:
-                    finished.add(pos)
-                    continue
-                if events:
-                    got_any = True
-                    per_shard: dict[int, list] = defaultdict(list)
-                    for _t, key, row, diff in events:
-                        per_shard[key_router.shard_of((key, row, diff))].append(
-                            (key, row, diff)
-                        )
-                    for s, us in per_shard.items():
-                        pending[logical][(pos, s)].append((0, us))
-            has_completions = any(
-                getattr(op, "_completions", None) for op in all_ops
-            )
-            slept = 0.0
-            if got_any or has_completions:
-                if not got_any:
-                    self._run_time(logical, pending)  # flush-only tick
-                self._drain(pending)
-                logical += 2
-                last_event = _time.monotonic()
-            else:
-                slept = autocommit_ms / 1000.0
-                _time.sleep(slept)
-            now = _time.monotonic()
-            if tracker is not None:
-                loop_el = max(now - loop_t0, 1e-9)
-                tracker.record(max(0.0, min(1.0, (loop_el - slept) / loop_el)))
-                code = tracker.recommendation()
-                if code is not None:
-                    from ..cli import MAX_PROCESSES
-                    from ..engine.telemetry import WorkloadTracker as _WT
-
-                    n_procs = int(_os.environ.get("PATHWAY_PROCESSES", "1"))
-                    supervised = _os.environ.get("PATHWAY_SPAWNED") == "1"
-                    at_min = code == _WT.EXIT_CODE_DOWNSCALE and n_procs <= 1
-                    at_max = (
-                        code == _WT.EXIT_CODE_UPSCALE and n_procs >= MAX_PROCESSES
-                    )
-                    if supervised and not at_min and not at_max:
-                        rescale_code = code
-                        break
-            if timeout_s is not None and now - start > timeout_s:
-                break
-            if idle_stop_s is not None and now - last_event > idle_stop_s:
-                break
-        self._drain_on_end(pending)
-        if rescale_code is not None:
-            import sys as _sys
-
-            print(
-                f"[pathway-tpu] workload tracker requests rescale "
-                f"(exit {rescale_code})", file=_sys.stderr,
-            )
-            _sys.exit(rescale_code)
-        return self.captures
-
-
-def run_tables_sharded(*tables, n_shards: int = 4) -> list[CapturedStream]:
-    sinks = [t._materialize_capture() for t in tables]
-    runner = ShardedGraphRunner(sinks, n_shards=n_shards)
-    caps = runner.run_batch()
-    return [caps[s.id] for s in sinks]

@@ -48,6 +48,10 @@ class Backend:
     def get_metadata(self, key: str) -> bytes | None:
         raise NotImplementedError
 
+    def list_streams(self, prefix: str) -> list[str]:
+        """Stream names starting with prefix (cluster union replay)."""
+        return []
+
 
 class FilesystemBackend(Backend):
     def __init__(self, path: str):
@@ -90,6 +94,14 @@ class FilesystemBackend(Backend):
                 out.append(rec)
         return out
 
+    def list_streams(self, prefix: str) -> list[str]:
+        safe = prefix.replace("/", "_")
+        out = []
+        for fn in os.listdir(self.path):
+            if fn.endswith(".journal") and fn[:-8].startswith(safe):
+                out.append(fn[:-8])
+        return sorted(out)
+
     def put_metadata(self, key: str, value: bytes) -> None:
         with open(os.path.join(self.path, f"{key}.meta"), "wb") as f:
             f.write(value)
@@ -115,6 +127,9 @@ class MockBackend(Backend):
 
     def replace_all(self, stream, records):
         self.streams[stream] = list(records)
+
+    def list_streams(self, prefix):
+        return sorted(s for s in self.streams if s.startswith(prefix))
 
     def put_metadata(self, key, value):
         self.meta[key] = value
@@ -158,9 +173,10 @@ def attach_persistence(runner, config: Config) -> None:
     if backend is None:
         return
     lg = runner.lg
-    streams = [
-        _stream_name(idx, source) for idx, (_op, source) in enumerate(lg.input_ops)
-    ]
+    streams: list[str] = []
+    for idx, (_op, source) in enumerate(lg.input_ops):
+        base = _stream_name(idx, source)
+        streams.extend(sorted(set(backend.list_streams(base)) | {base}))
     ver_b = backend.get_metadata("journal_format")
     if ver_b is not None:
         ver = int(ver_b)
@@ -187,28 +203,59 @@ def attach_persistence(runner, config: Config) -> None:
         for s in streams:
             backend.replace_all(s, [])
     backend.put_metadata("journal_format", str(_JOURNAL_FORMAT_VERSION).encode())
+    # cluster awareness: each worker process journals ONLY the events it owns
+    # into its own per-process stream; replay is the UNION of all processes'
+    # streams, re-filtered by the CURRENT ownership map — this survives
+    # elastic rescaling, where the shard->process assignment changes
+    # (reference: per-worker input snapshots redistributed by the metadata
+    # tracker, src/persistence/tracker.rs:51-275)
+    nprocs = getattr(runner, "nprocs", 1)
+    pid = getattr(runner, "pid", 0)
+    owns_event = getattr(runner, "owns_event", None)
     for idx, (op, source) in enumerate(lg.input_ops):
-        stream = _stream_name(idx, source)
+        base_stream = _stream_name(idx, source)
+        write_stream = (
+            f"{base_stream}__p{pid}" if nprocs > 1 else base_stream
+        )
         # replay journal through a wrapper source; each journal record is
         # (events, offsets_after) so journal+offsets commit atomically
-        journaled = backend.read_all(stream)
+        read_streams = [base_stream]
+        if hasattr(backend, "list_streams"):
+            read_streams = sorted(
+                set(backend.list_streams(base_stream)) | {base_stream}
+            )
         replayed: list = []
-        last_offsets = None
-        for rec in journaled:
-            events, offsets = pickle.loads(rec)
-            replayed.extend(events)
-            if offsets is not None:
-                last_offsets = offsets
+        last_offsets: dict | None = None
+        n_records = 0
+        for rs in read_streams:
+            for rec in backend.read_all(rs):
+                n_records += 1
+                events, offsets = pickle.loads(rec)
+                replayed.extend(events)
+                if offsets is not None:
+                    if last_offsets is None:
+                        last_offsets = dict(offsets)
+                    else:
+                        for k, v in offsets.items():
+                            cur = last_offsets.get(k)
+                            last_offsets[k] = v if cur is None else max(cur, v)
+        replayed.sort(key=lambda e: e[0])  # merge streams by logical time
         # journal compaction (reference: operator_snapshot.rs background
         # merging): squash the replay into one consolidated record so the
-        # journal doesn't grow with history
-        if len(journaled) > 8 and hasattr(backend, "replace_all"):
+        # journal doesn't grow with history.  Single-process only: cluster
+        # startup reads the same streams concurrently, so rewriting them
+        # here would race with peers' reads.
+        if nprocs <= 1 and n_records > 8 and hasattr(backend, "replace_all"):
             compacted = _compact_events(replayed)
             backend.replace_all(
-                stream, [pickle.dumps((compacted, last_offsets))]
+                base_stream, [pickle.dumps((compacted, last_offsets))]
             )
             replayed = compacted
-        _wrap_source_with_persistence(source, backend, stream, replayed, last_offsets)
+        _wrap_source_with_persistence(
+            source, backend, write_stream, replayed, last_offsets,
+            owns_event=owns_event if nprocs > 1 else None,
+            is_replay_injector=(pid == 0 or nprocs <= 1),
+        )
 
 
 def _stream_name(idx: int, source) -> str:
@@ -246,7 +293,14 @@ def _compact_events(events: list) -> list:
 
 
 def _wrap_source_with_persistence(source, backend: Backend, stream: str,
-                                  replayed: list, last_offsets) -> None:
+                                  replayed: list, last_offsets,
+                                  owns_event=None,
+                                  is_replay_injector: bool = True) -> None:
+    """`owns_event` (cluster mode) filters what THIS process journals, so the
+    union of all processes' streams is exactly one copy of the input.
+    `is_replay_injector` gates live-source replay to a single process —
+    live events are injected exclusively (shipped to owners), so exactly one
+    process may replay them."""
     orig_static = source.static_events
     orig_poll = source.poll
 
@@ -257,11 +311,17 @@ def _wrap_source_with_persistence(source, backend: Backend, stream: str,
     if last_offsets is not None and hasattr(source, "seek"):
         source.seek(last_offsets)
 
+    def _journal(events, offsets=None):
+        if owns_event is not None:
+            events = [e for e in events if owns_event(e)]
+        if events or offsets is not None:
+            backend.append(stream, pickle.dumps((events, offsets)))
+
     def static_events():
         live = orig_static()
         if not replayed:
             if live:
-                backend.append(stream, pickle.dumps((live, None)))
+                _journal(live)
             return live
         # resumed run over a static source that may have grown: per key, the
         # journal already covers the first count_j(k) live events (static
@@ -279,19 +339,23 @@ def _wrap_source_with_persistence(source, backend: Backend, stream: str,
             if seen_now[e[1]] > jcount.get(e[1], 0):
                 fresh.append(e)
         if fresh:
-            backend.append(stream, pickle.dumps((fresh, None)))
+            _journal(fresh)
         return replayed + fresh
 
     def journaling_poll():
         events = orig_poll()
         if events:
             offsets = source.get_offsets() if hasattr(source, "get_offsets") else None
+            # the exclusive reader journals everything it read (no ownership
+            # filter: no other process sees these events)
             backend.append(stream, pickle.dumps((events, offsets)))
         return events
 
     source.static_events = static_events
     if source.is_live():
-        pending = [list(replayed)] if replayed else []
+        pending = (
+            [list(replayed)] if replayed and is_replay_injector else []
+        )
 
         def poll_with_replay():
             if pending:

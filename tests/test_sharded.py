@@ -4,7 +4,7 @@
 import pathway_tpu as pw
 from pathway_tpu.debug import table_from_markdown, table_from_rows
 from pathway_tpu.engine.runner import run_tables
-from pathway_tpu.parallel.sharded import run_tables_sharded
+from pathway_tpu.parallel.cluster import run_tables_sharded
 
 
 def _assert_same(table, n_shards=4):
