@@ -6,6 +6,13 @@ backend (TPU when available): tokenize -> on-device transformer embed
 (bucketed bf16 batches) -> live KNN index add; then embed+search queries
 one-at-a-time to measure serving latency.
 
+`vs_baseline` is MEASURED, not asserted: the same corpus is pushed through a
+faithful CPU re-creation of the reference's embed+index path — a
+MiniLM-architecture torch encoder (the reference's SentenceTransformer
+stack, python/pathway/xpacks/llm/embedders.py) plus an ndarray brute-force
+top-k (src/external_integration/brute_force_knn_integration.rs:22-60) — and
+the ratio of indexing throughputs is reported.
+
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...extras}.
 """
 
@@ -20,29 +27,51 @@ import sys
 import time
 
 
-def _ensure_healthy_backend() -> None:
-    """The axon TPU tunnel can wedge (PJRT claim never granted); probe it in
-    a subprocess and fall back to CPU rather than hanging the bench."""
-    if os.environ.get("PW_BENCH_BACKEND_CHECKED"):
-        return
+def _probe_backend(timeout_s: int = 120) -> bool:
     try:
         probe = subprocess.run(
-            [sys.executable, "-c", "import jax; print(jax.devices()[0].platform)"],
-            capture_output=True, timeout=90,
+            [
+                sys.executable, "-c",
+                "import jax, jax.numpy as jnp;"
+                "x = jnp.ones((256, 256), jnp.bfloat16);"
+                "(x @ x).block_until_ready();"
+                "print(jax.devices()[0].platform)",
+            ],
+            capture_output=True, timeout=timeout_s,
         )
-        ok = probe.returncode == 0
+        return probe.returncode == 0
     except subprocess.TimeoutExpired:
-        ok = False
-    if not ok:
-        env = dict(os.environ)
-        env["JAX_PLATFORMS"] = "cpu"
-        env["PYTHONPATH"] = os.pathsep.join(
-            p for p in env.get("PYTHONPATH", "").split(os.pathsep)
-            if "axon" not in p
+        return False
+
+
+def _ensure_healthy_backend() -> None:
+    """The axon TPU tunnel can wedge (PJRT claim never granted); probe it in
+    a subprocess with retries + backoff, and only then fall back to CPU."""
+    if os.environ.get("PW_BENCH_BACKEND_CHECKED"):
+        return
+    attempts = int(os.environ.get("PW_BENCH_PROBE_ATTEMPTS", "3"))
+    for attempt in range(attempts):
+        if _probe_backend():
+            os.environ["PW_BENCH_BACKEND_CHECKED"] = "1"
+            return
+        wait = 5 * (attempt + 1)
+        print(
+            f"[bench] backend probe attempt {attempt + 1}/{attempts} failed; "
+            f"retrying in {wait}s", file=sys.stderr,
         )
-        env["PW_BENCH_BACKEND_CHECKED"] = "1"
-        os.execve(sys.executable, [sys.executable, os.path.abspath(__file__)], env)
-    os.environ["PW_BENCH_BACKEND_CHECKED"] = "1"
+        time.sleep(wait)
+    print(
+        "[bench] JAX backend unreachable after retries; falling back to CPU "
+        "(numbers below are NOT TPU numbers)", file=sys.stderr,
+    )
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = os.pathsep.join(
+        p for p in env.get("PYTHONPATH", "").split(os.pathsep)
+        if "axon" not in p
+    )
+    env["PW_BENCH_BACKEND_CHECKED"] = "1"
+    os.execve(sys.executable, [sys.executable, os.path.abspath(__file__)], env)
 
 
 def make_corpus(n_docs: int, words_per_doc: int = 48, seed: int = 0) -> list[str]:
@@ -76,6 +105,202 @@ def bench_wordcount(n_rows: int = 200_000, n_words: int = 5_000) -> float:
     assert len(cap.squash()) == n_words
     pg.G.clear()
     return n_rows / el
+
+
+def bench_data_plane(n_rows: int = 1_000_000) -> dict:
+    """1e6-row select+filter+groupby through the columnar engine vs the
+    forced row-interpreter path (VERDICT r1 item 3's gate: >=10x)."""
+    import pathway_tpu as pw
+    from pathway_tpu.debug import table_from_rows
+    from pathway_tpu.engine import vectorize
+    from pathway_tpu.engine.runner import run_tables
+    from pathway_tpu.internals import parse_graph as pg
+
+    rng = random.Random(0)
+
+    class S(pw.Schema):
+        g: str
+        a: int
+        b: float
+
+    rows = [
+        (f"g{rng.randrange(100)}", rng.randrange(1000), rng.random())
+        for _ in range(n_rows)
+    ]
+
+    def pipeline():
+        pg.G.clear()
+        t = table_from_rows(S, rows)
+        t2 = t.select(g=t.g, x=t.a * 2 + 1, y=t.b * 0.5)
+        t3 = t2.filter(t2.x > 400)
+        return t3.groupby(t3.g).reduce(
+            t3.g, s=pw.reducers.sum(t3.x), mn=pw.reducers.min(t3.y),
+            c=pw.reducers.count(),
+        )
+
+    t0 = time.perf_counter()
+    [cap] = run_tables(pipeline())
+    el_vec = time.perf_counter() - t0
+    res_vec = cap.squash()
+
+    import pathway_tpu.engine.runner as rmod
+
+    orig_plan = vectorize.compile_plan
+    orig_spec = rmod._groupby_simple_spec
+    vectorize.compile_plan = lambda *a, **k: None
+    rmod._groupby_simple_spec = lambda *a, **k: None
+    try:
+        t0 = time.perf_counter()
+        [cap] = run_tables(pipeline())
+        el_row = time.perf_counter() - t0
+        assert cap.squash() == res_vec
+    finally:
+        vectorize.compile_plan = orig_plan
+        rmod._groupby_simple_spec = orig_spec
+        pg.G.clear()
+    return {
+        "rows_per_sec": round(n_rows / el_vec),
+        "rowpath_rows_per_sec": round(n_rows / el_row),
+        "speedup_vs_row_path": round(el_row / el_vec, 1),
+    }
+
+
+def bench_reference_baseline(docs: list[str], queries: list[str], k: int,
+                             tokenizer) -> dict:
+    """Faithful CPU re-creation of the reference's serving path, measured on
+    this host: MiniLM-architecture torch encoder (384d / 6 layers — the
+    all-MiniLM-L6-v2 shape the reference's SentenceTransformer wrapper uses)
+    with identical tokenization, then numpy brute-force cosine top-k.
+    Weights are randomly initialized (zero-egress environment), which does
+    not change the computational cost being measured."""
+    import numpy as np
+    import torch
+    from transformers import BertConfig, BertModel
+
+    torch.set_num_threads(os.cpu_count() or 1)
+    cfg = BertConfig(
+        vocab_size=32768, hidden_size=384, num_hidden_layers=6,
+        num_attention_heads=6, intermediate_size=1536,
+        max_position_embeddings=512,
+    )
+    model = BertModel(cfg).eval()
+
+    def embed(texts: list[str], batch: int = 128) -> np.ndarray:
+        outs = []
+        with torch.no_grad():
+            for i in range(0, len(texts), batch):
+                chunk = texts[i : i + batch]
+                toks = [tokenizer.encode(t)[:128] for t in chunk]
+                T = max(len(t) for t in toks)
+                ids = torch.zeros((len(chunk), T), dtype=torch.long)
+                mask = torch.zeros((len(chunk), T), dtype=torch.long)
+                for j, t in enumerate(toks):
+                    ids[j, : len(t)] = torch.tensor(t)
+                    mask[j, : len(t)] = 1
+                h = model(input_ids=ids, attention_mask=mask).last_hidden_state
+                m = mask[:, :, None].float()
+                pooled = (h * m).sum(1) / m.sum(1).clamp(min=1.0)
+                pooled = torch.nn.functional.normalize(pooled, dim=-1)
+                outs.append(pooled.numpy())
+        return np.concatenate(outs, axis=0)
+
+    # warmup (parity with the TPU path's compile warmup)
+    embed(docs[:8])
+    t0 = time.perf_counter()
+    mat = embed(docs)
+    el = time.perf_counter() - t0
+    docs_per_sec = len(docs) / el
+
+    lat = []
+    for q in queries:
+        tq = time.perf_counter()
+        v = embed([q])[0]
+        scores = mat @ v
+        top = np.argpartition(-scores, min(k, len(scores) - 1))[:k]
+        top[np.argsort(-scores[top])]
+        lat.append((time.perf_counter() - tq) * 1000)
+    return {
+        "docs_per_sec": docs_per_sec,
+        "p50_ms": statistics.median(lat),
+    }
+
+
+def bench_parallel_wordcount(tmp: str, n_procs: int) -> float:
+    """Cluster wordcount over partitioned files via the real CLI supervisor;
+    returns elapsed seconds."""
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    app = os.path.join(tmp, "app.py")
+    out = os.path.join(tmp, f"out{n_procs}.jsonl")
+    with open(app, "w") as f:
+        f.write(
+            f"""
+import pathway_tpu as pw
+
+t = pw.io.plaintext.read({tmp!r} + "/data/*.txt", mode="streaming")
+counts = t.groupby(t.data).reduce(word=t.data, count=pw.reducers.count())
+pw.io.jsonlines.write(counts, {out!r})
+pw.run(idle_stop_s=1.0)
+"""
+        )
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = os.path.dirname(os.path.abspath(__file__))
+    t0 = time.perf_counter()
+    res = subprocess.run(
+        [
+            sys.executable, "-m", "pathway_tpu", "spawn",
+            "--processes", str(n_procs), "--first-port", str(port),
+            "--", sys.executable, app,
+        ],
+        env=env, capture_output=True, timeout=600,
+    )
+    el = time.perf_counter() - t0
+    assert res.returncode == 0, res.stderr.decode()[-2000:]
+    return el
+
+
+def bench_parallel(n_rows_per_file: int = 25_000, n_files: int = 4) -> dict:
+    """Measured multi-process scaling of the engine data plane.  On a
+    single-core host this honestly reports <= 1x (processes time-slice one
+    core and pay exchange overhead); on a multi-core host the same code
+    shows the partitioning speedup."""
+    import tempfile
+
+    cores = os.cpu_count() or 1
+    with tempfile.TemporaryDirectory() as tmp:
+        data = os.path.join(tmp, "data")
+        os.makedirs(data)
+        rng = random.Random(3)
+        for f in range(n_files):
+            with open(os.path.join(data, f"part{f}.txt"), "w") as fh:
+                for _ in range(n_rows_per_file):
+                    fh.write(f"w{rng.randrange(2000)}\n")
+        t1 = bench_parallel_wordcount(tmp, 1)
+        tn_procs = min(4, max(2, cores))
+        tn = bench_parallel_wordcount(tmp, tn_procs)
+    return {
+        "host_cpus": cores,
+        "procs": tn_procs,
+        "elapsed_1proc_s": round(t1, 2),
+        f"elapsed_{tn_procs}proc_s": round(tn, 2),
+        "parallel_speedup": round(t1 / tn, 2),
+    }
+
+
+def _encoder_flops_per_batch(cfg, B: int, T: int) -> float:
+    """Dense matmul + attention FLOPs for one forward pass."""
+    per_token_matmul = 2 * (4 * cfg.d_model * cfg.d_model + 2 * cfg.d_model * cfg.d_ff)
+    attn_per_token = 4 * T * cfg.d_model  # scores + weighted sum, 2 matmuls
+    return B * T * cfg.n_layers * (per_token_matmul + attn_per_token)
+
+
+# bf16 peak FLOPs/s per chip by TPU generation (public spec sheets)
+_TPU_PEAK = {"v5e": 197e12, "v5p": 459e12, "v4": 275e12, "v6e": 918e12}
 
 
 def main() -> None:
@@ -156,7 +381,30 @@ def main() -> None:
     p50 = statistics.median(lat)
     p95 = sorted(lat)[int(0.95 * len(lat)) - 1]
 
+    # device-only embed throughput + MFU (the MXU-bound inner loop,
+    # separated from the pipeline overhead measured above)
+    t2 = time.perf_counter()
+    n_embed_batches = 8
+    for _ in range(n_embed_batches):
+        enc.embed_batch(docs[:batch])
+    t3 = time.perf_counter()
+    flops = _encoder_flops_per_batch(enc.cfg, batch, 64) * n_embed_batches
+    achieved = flops / (t3 - t2)
+    gen = os.environ.get("PALLAS_AXON_TPU_GEN", "")
+    peak = _TPU_PEAK.get(gen) if backend == "tpu" else None
+    mfu = round(achieved / peak, 4) if peak else None
+
     wordcount_rps = bench_wordcount()
+
+    # measured reference baseline on the same corpus (CPU, torch MiniLM arch)
+    n_base = 1024
+    base = bench_reference_baseline(
+        docs[:n_base], queries[:16], k, enc.tokenizer
+    )
+    vs_baseline = round(docs_per_sec / base["docs_per_sec"], 2)
+
+    parallel = bench_parallel()
+    data_plane = bench_data_plane()
 
     print(
         json.dumps(
@@ -164,10 +412,16 @@ def main() -> None:
                 "metric": "rag_index_throughput",
                 "value": round(docs_per_sec, 1),
                 "unit": "docs/sec",
-                "vs_baseline": 1.0,
+                "vs_baseline": vs_baseline,
+                "baseline_docs_per_sec": round(base["docs_per_sec"], 1),
+                "baseline_query_p50_ms": round(base["p50_ms"], 2),
                 "query_p50_ms": round(p50, 2),
                 "query_p95_ms": round(p95, 2),
                 "wordcount_rows_per_sec": round(wordcount_rps),
+                "embed_tokens_per_sec": round(batch * 64 * n_embed_batches / (t3 - t2)),
+                "embed_mfu": mfu,
+                "parallel": parallel,
+                "data_plane": data_plane,
                 "n_docs": n_docs,
                 "embed_dim": enc.dimensions,
                 "backend": backend,

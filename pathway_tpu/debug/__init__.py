@@ -167,14 +167,32 @@ def table_from_rows(
 ) -> Table:
     colnames = schema.column_names()
     pk = schema.primary_key_columns()
+    if not is_stream:
+        # columnar ingest: transpose once, batch-hash auto keys, feed the
+        # engine a struct-of-arrays batch (no per-row event tuples)
+        rows = [tuple(r) for r in rows]
+        n = len(rows)
+        if pk:
+            pk_idx = [colnames.index(c) for c in pk]
+            keys = [ref_scalar(*[r[i] for i in pk_idx]) for r in rows]
+        else:
+            # same auto-key scheme as the event path below and markdown
+            # tables, so static/streamed tables over the same ordinal rows
+            # keep identical universes
+            keys = [ref_scalar("#row", i) for i in range(n)]
+        from ..engine.columnar import ColumnarBatch
+        from ..internals.datasource import ColumnarStaticSource
+
+        cols = [list(c) for c in zip(*rows)] if rows else [[] for _ in colnames]
+        batch = ColumnarBatch(keys, cols, [1] * n)
+        source = ColumnarStaticSource([(0, batch)])
+        node = pg.new_node("input", [], source=source)
+        return Table(node, colnames, dict(schema.dtypes()), Universe(), name="rows")
     events = []
     auto = itertools.count()
     for r in rows:
         r = tuple(r)
-        if is_stream:
-            *vals, t, diff = r
-        else:
-            vals, t, diff = list(r), 0, 1
+        *vals, t, diff = r
         if pk:
             key = ref_scalar(*[vals[colnames.index(c)] for c in pk])
         else:

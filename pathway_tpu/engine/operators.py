@@ -48,9 +48,20 @@ class EnvBuilder:
 
 
 class InputOperator(Operator):
-    """Entry node; the runner pushes update batches into it."""
+    """Entry node; the runner pushes update batches into it.
+
+    Large row batches transpose to struct-of-arrays ONCE here, so every
+    downstream vectorized operator reuses the columns instead of
+    re-extracting them (engine/columnar.py)."""
 
     def process(self, port: int, updates: list[Update], time: Time) -> None:
+        from .columnar import ColumnarBatch
+        from .vectorize import VEC_THRESHOLD
+
+        if not isinstance(updates, ColumnarBatch) and len(updates) >= VEC_THRESHOLD:
+            cb = ColumnarBatch.from_updates(updates)
+            if cb is not None:
+                updates = cb
         self.emit(time, updates)
 
 
@@ -141,7 +152,8 @@ class StatelessRowwise(Operator):
         self.emit(time, out)
 
     def process(self, port, updates, time):
-        from .vectorize import VEC_THRESHOLD, try_columns
+        from .columnar import ColumnarBatch
+        from .vectorize import STATS, VEC_THRESHOLD, try_columns
 
         if self._batched is not None and len(updates) > 1:
             self._process_batched_apply(updates, time)
@@ -155,21 +167,36 @@ class StatelessRowwise(Operator):
 
                 n = len(updates)
                 try:
-                    outs = plan(cols)
+                    outs = plan(cols, n)
                 except Exception:
                     outs = None  # fall back to per-row error poisoning
                 if outs is not None:
-                    out_lists = [
-                        o.tolist() if isinstance(o, np.ndarray) and o.ndim == 1
-                        else [o.item() if isinstance(o, np.ndarray) else o] * n
-                        for o in outs
-                    ]
-                    rows = list(zip(*out_lists)) if out_lists else [()] * n
-                    self.emit(
-                        time,
-                        [(u[0], rows[i], u[2]) for i, u in enumerate(updates)],
-                    )
+                    # output columns stay columnar: arrays/lists ride the
+                    # ColumnarBatch straight into the next operator
+                    out_cols = []
+                    for o in outs:
+                        if isinstance(o, np.ndarray) and o.ndim == 1:
+                            out_cols.append(o)
+                        elif isinstance(o, list):
+                            out_cols.append(o)
+                        else:
+                            v = o.item() if isinstance(o, np.ndarray) else o
+                            out_cols.append([v] * n)
+                    if isinstance(updates, ColumnarBatch):
+                        keys, diffs = updates.keys, updates.diffs
+                        prevalidated = updates.validated_ids()
+                    else:
+                        keys = [u[0] for u in updates]
+                        diffs = [u[2] for u in updates]
+                        prevalidated = {}
+                    cb = ColumnarBatch(keys, out_cols, diffs)
+                    for ci, o in enumerate(out_cols):
+                        if id(o) in prevalidated:
+                            cb._np_cache[ci] = o  # passthrough column
+                    self.emit(time, cb)
                     return
+        if len(updates) >= VEC_THRESHOLD:
+            STATS["row_batches"] += 1  # a real fallback, not a tiny batch
         out: list[Update] = []
         build = self.env.build
         exprs = self.exprs
@@ -226,14 +253,15 @@ class StatelessFilter(Operator):
     def process(self, port, updates, time):
         import numpy as np
 
-        from .vectorize import VEC_THRESHOLD, try_columns
+        from .columnar import ColumnarBatch
+        from .vectorize import STATS, VEC_THRESHOLD, try_columns
 
         plan = self._get_plan() if len(updates) >= VEC_THRESHOLD else None
         if plan is not None:
             cols = try_columns(updates, self.n_in_cols, plan.used_columns)
             if cols is not None:
                 try:
-                    [mask] = plan(cols)
+                    [mask] = plan(cols, len(updates))
                 except Exception:
                     mask = None
                 if mask is not None:
@@ -241,8 +269,15 @@ class StatelessFilter(Operator):
                     if mask.ndim == 0:
                         mask = np.broadcast_to(mask, (len(updates),))
                     if mask.dtype == bool and mask.shape == (len(updates),):
-                        self.emit(time, [u for u, m in zip(updates, mask) if m])
+                        if isinstance(updates, ColumnarBatch):
+                            self.emit(time, updates.select_mask(mask))
+                        else:
+                            self.emit(
+                                time, [u for u, m in zip(updates, mask) if m]
+                            )
                         return
+        if len(updates) >= VEC_THRESHOLD:
+            STATS["row_batches"] += 1  # a real fallback, not a tiny batch
         out: list[Update] = []
         for key, row, diff in updates:
             v = self.predicate(self.env.build(key, row))
@@ -510,26 +545,57 @@ class GroupbyOperator(Operator):
         self._dirty: set[Key] = set()
 
     def _process_bulk(self, updates) -> bool:
-        """Columnar ingest for plain-column groupings with count/sum/avg
-        reducers: one state update per touched group per batch instead of
-        one per row (the wordcount hot path)."""
+        """Columnar ingest for plain-column groupings with
+        count/sum/avg/min/max reducers: one state update per touched group
+        per batch instead of one per row (the wordcount hot path).
+        ColumnarBatch inputs read their columns directly — no row tuples
+        are ever built."""
+        from .columnar import ColumnarBatch
+
         gb_pos, red_plan = self.simple_spec
+        minmax = {i for i, spec in enumerate(red_plan) if spec[0] in ("min", "max")}
+        if isinstance(updates, ColumnarBatch):
+            gb_cols = [updates.list_col(p) for p in gb_pos]
+            val_cols = [
+                updates.list_col(spec[1]) if spec[0] != "count" else None
+                for spec in red_plan
+            ]
+            diffs = updates.diffs
+            n = len(updates.keys)
+        else:
+            gb_cols = None
+            n = len(updates)
         acc: dict[tuple, list] = {}
         try:
-            for key, row, diff in updates:
-                gvals = tuple(row[p] for p in gb_pos)
+            for j in range(n):
+                if gb_cols is not None:
+                    gvals = tuple(c[j] for c in gb_cols)
+                    diff = diffs[j]
+                else:
+                    _key, row, diff = updates[j]
+                    gvals = tuple(row[p] for p in gb_pos)
                 entry = acc.get(gvals)
                 if entry is None:
                     # int zeros so integer sums stay int (type parity with
-                    # the row path)
-                    entry = acc[gvals] = [0, [0] * len(red_plan)]
+                    # the row path); min/max accumulate value->count dicts
+                    entry = acc[gvals] = [
+                        0, [({} if i in minmax else 0) for i in range(len(red_plan))]
+                    ]
                 entry[0] += diff
                 sums = entry[1]
                 for i, spec in enumerate(red_plan):
-                    if spec[0] != "count":
+                    if spec[0] == "count":
+                        continue
+                    if gb_cols is not None:
+                        v = val_cols[i][j]
+                    else:
                         v = row[spec[1]]
-                        if v is None or isinstance(v, Error):
-                            return False  # slow path handles skips/poison
+                    if v is None or isinstance(v, Error):
+                        return False  # slow path handles skips/poison
+                    if i in minmax:
+                        d = sums[i]
+                        d[v] = d.get(v, 0) + diff
+                    else:
                         sums[i] += v * diff
         except TypeError:
             return False  # unhashable group values
@@ -550,20 +616,150 @@ class GroupbyOperator(Operator):
                 group = [gvals, states, 0]
                 self.groups[gkey] = group
             group[2] += total_diff
-            for st, spec, ws in zip(group[1], red_plan, sums):
-                st.bulk_add(total_diff, ws if spec[0] != "count" else None)
+            for i, (st, spec, ws) in enumerate(zip(group[1], red_plan, sums)):
+                if i in minmax:
+                    st.bulk_merge(ws)
+                elif spec[0] == "count":
+                    st.bulk_add(total_diff, None)
+                else:
+                    st.bulk_add(total_diff, ws)
             self._dirty.add(gkey)
+        return True
+
+    @staticmethod
+    def _factorize(arr):
+        """(uniq, codes) group factorization: pandas' C hashtable when
+        available (O(n) on string columns vs np.unique's comparison sort),
+        np.unique otherwise."""
+        import numpy as np
+
+        try:
+            import pandas as pd
+
+            codes, uniq = pd.factorize(arr)
+            if len(codes) and codes.min() < 0:
+                return None, None  # null-like slipped through
+            return np.asarray(uniq), np.asarray(codes)
+        except Exception:
+            pass
+        try:
+            u, c = np.unique(arr, return_inverse=True)
+            return u, c
+        except Exception:
+            return None, None
+
+    def _process_bulk_np(self, batch) -> bool:
+        """Factorized columnar ingest (single plain group column): group
+        codes via np.unique, count/sum via scatter-add, min/max via a
+        lexsort + run-length pass over (code, value) pairs — the whole
+        batch reduces in C with one Python step per TOUCHED GROUP, not per
+        row.  Falls back (False) whenever types/bounds make the numpy
+        result diverge from Python semantics."""
+        import numpy as np
+
+        gb_pos, red_plan = self.simple_spec
+        if len(gb_pos) != 1:
+            return False
+        garr = batch.np_col(gb_pos[0])
+        if garr is None:
+            return False
+        n = len(batch.keys)
+        diffs = np.asarray(batch.diffs, np.int64)
+        total_abs_diff = int(np.sum(np.abs(diffs))) if n else 0
+        uniq, codes = self._factorize(garr)
+        if uniq is None:
+            return False
+        val_arrs: list = []
+        for spec in red_plan:
+            if spec[0] == "count":
+                val_arrs.append(None)
+                continue
+            v = batch.np_col(spec[1])
+            if v is None or v.dtype == object:
+                return False
+            if v.dtype == np.float64 and spec[0] in ("min", "max"):
+                if np.any(np.isnan(v)):
+                    return False  # NaN breaks multiset netting either way
+            if spec[0] in ("sum", "avg") and v.dtype == np.int64:
+                # exactness guard: per-batch int sums accumulate in int64
+                amax = int(np.max(np.abs(v))) if n else 0
+                if amax * max(total_abs_diff, 1) >= 2**62:
+                    return False
+            val_arrs.append(v)
+        G = len(uniq)
+        total = np.zeros(G, np.int64)
+        np.add.at(total, codes, diffs)
+        red_results: list = []
+        for spec, v in zip(red_plan, val_arrs):
+            if spec[0] == "count":
+                red_results.append(None)
+            elif spec[0] in ("sum", "avg"):
+                acc = np.zeros(G, v.dtype)
+                np.add.at(acc, codes, v * diffs)
+                red_results.append(acc)
+            else:  # min/max: net (code, value) multiset deltas
+                order = np.lexsort((v, codes))
+                c_s, v_s, d_s = codes[order], v[order], diffs[order]
+                boundary = np.empty(len(order), bool)
+                if len(order):
+                    boundary[0] = True
+                    boundary[1:] = (c_s[1:] != c_s[:-1]) | (v_s[1:] != v_s[:-1])
+                starts = np.flatnonzero(boundary)
+                netd = np.add.reduceat(d_s, starts) if len(starts) else np.array([])
+                red_results.append((c_s[starts], v_s[starts], netd))
+        from . import reducers_impl
+
+        uniq_list = uniq.tolist()
+        total_list = total.tolist()
+        gstates: list = [None] * G
+        for gi in range(G):
+            gvals = (uniq_list[gi],)
+            gkey = self._gkey_cache.get(gvals)
+            if gkey is None:
+                gkey = ref_scalar(*gvals)
+                if len(self._gkey_cache) < 1_000_000:
+                    self._gkey_cache[gvals] = gkey
+            group = self.groups.get(gkey)
+            if group is None:
+                states = [
+                    reducers_impl.make_state(rid, kw)
+                    for rid, _, kw in self.reducer_specs
+                ]
+                group = [gvals, states, 0]
+                self.groups[gkey] = group
+            group[2] += total_list[gi]
+            gstates[gi] = group[1]
+            self._dirty.add(gkey)
+        for st_i, (spec, res) in enumerate(zip(red_plan, red_results)):
+            if spec[0] == "count":
+                for gi in range(G):
+                    gstates[gi][st_i].bulk_add(total_list[gi], None)
+            elif spec[0] in ("sum", "avg"):
+                res_list = res.tolist()
+                for gi in range(G):
+                    gstates[gi][st_i].bulk_add(total_list[gi], res_list[gi])
+            else:
+                c_u, v_u, d_u = res
+                per_group: dict[int, dict] = {}
+                for c, vv, dd in zip(c_u.tolist(), v_u.tolist(), d_u.tolist()):
+                    per_group.setdefault(c, {})[vv] = dd
+                for gi, vc in per_group.items():
+                    gstates[gi][st_i].bulk_merge(vc)
         return True
 
     def process(self, port, updates, time):
         from . import reducers_impl
+        from .columnar import ColumnarBatch
 
-        if (
-            self.simple_spec is not None
-            and len(updates) >= 64
-            and self._process_bulk(updates)
-        ):
-            return
+        if self.simple_spec is not None and len(updates) >= 64:
+            if (
+                isinstance(updates, ColumnarBatch)
+                and len(updates) >= 1024
+                and self._process_bulk_np(updates)
+            ):
+                return
+            if self._process_bulk(updates):
+                return
         for key, row, diff in updates:
             e = self.env.build(key, row)
             gvals = tuple(f(e) for f in self.gb_fns)

@@ -144,6 +144,14 @@ class _OrderState(ReducerState, _MultisetMixin):
         return not self.ms and self.error_count == 0
 
 
+    def bulk_merge(self, val_counts: dict) -> None:
+        """Columnar fast path: merge per-batch (value -> net diff) counts."""
+        for v, d in val_counts.items():
+            if d:
+                self._ms_update(self.ms, v, d)
+        self._cache_valid = False
+
+
 class MinState(_OrderState):
     _agg = min
 

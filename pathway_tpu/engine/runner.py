@@ -35,8 +35,9 @@ class LoweredGraph:
 
 
 def _groupby_simple_spec(src: Table, p: dict):
-    """Columnar-ingest plan for plain-column groupbys with count/sum/avg
-    reducers; None when anything needs the row interpreter."""
+    """Columnar-ingest plan for plain-column groupbys with
+    count/sum/avg/min/max reducers; None when anything needs the row
+    interpreter."""
     from ..internals.expression import ColumnReference
 
     if p.get("id_expr") is not None or p.get("sort_by") is not None:
@@ -60,7 +61,7 @@ def _groupby_simple_spec(src: Table, p: dict):
     for rid, args, kw in p["reducers"]:
         if rid == "count":
             red_plan.append(("count",))
-        elif rid in ("sum", "avg") and len(args) == 1:
+        elif rid in ("sum", "avg", "min", "max") and len(args) == 1:
             i = pos_of(args[0])
             if i is None:
                 return None
@@ -68,6 +69,16 @@ def _groupby_simple_spec(src: Table, p: dict):
         else:
             return None
     return (gb_pos, red_plan)
+
+
+def _use_static_batches(source) -> bool:
+    """The columnar fast path is only sound when static_events has not been
+    instance-wrapped (persistence journaling/replay overrides it on the
+    instance; bypassing the wrapper would skip the journal)."""
+    return (
+        hasattr(source, "static_batches")
+        and "static_events" not in source.__dict__
+    )
 
 
 def _env_for(table: Table) -> ops.EnvBuilder:
@@ -287,15 +298,25 @@ class GraphRunner:
 
     def run_batch(self) -> dict[int, CapturedStream]:
         """Feed all static events, process times in order, finish."""
-        by_time: dict[int, dict[int, list[Update]]] = defaultdict(lambda: defaultdict(list))
+        by_time: dict[int, dict[int, list]] = defaultdict(lambda: defaultdict(list))
+        columnar: list[tuple[Operator, int, Any]] = []
         for op, source in self.lg.input_ops:
+            if _use_static_batches(source):
+                # struct-of-arrays sources skip event-tuple plumbing
+                for t, batch in source.static_batches():
+                    columnar.append((op, t, batch))
+                continue
             for t, key, row, diff in source.static_events():
                 by_time[t][op.id].append((key, row, diff))
         sched = self.lg.scheduler
         op_by_id = {op.id: op for op, _ in self.lg.input_ops}
-        for t in sorted(by_time):
-            for op_id, updates in by_time[t].items():
+        times = sorted(set(by_time) | {t for _op, t, _b in columnar})
+        for t in times:
+            for op_id, updates in by_time.get(t, {}).items():
                 sched.push_input(op_by_id[op_id], t, updates)
+            for op, bt, batch in columnar:
+                if bt == t:
+                    sched.push_input(op, t, batch)
         sched.finish()
         return self.lg.captures
 
@@ -313,6 +334,11 @@ class GraphRunner:
             if source.is_live():
                 source.start()
                 live.append((op, source))
+            elif _use_static_batches(source):
+                for t, batch in sorted(
+                    source.static_batches(), key=lambda tb: tb[0]
+                ):
+                    sched.push_input(op, t, batch)
             else:
                 events = source.static_events()
                 if events:

@@ -2,9 +2,17 @@
 
 The reference evaluates expressions batch-vectorized per AST node
 (src/engine/expression.rs Expressions::eval over whole batches,
-dataflow.rs:1572-1604).  Here the same idea lowers to numpy on host; the
-JAX/device lowering for very large batches plugs into the same compile_plan
-seam (ops/ kernels use it for dense index/embedding paths).
+dataflow.rs:1572-1604).  Here the same plan compiles twice:
+
+  - a numpy tier (host SIMD) used for any batch over VEC_THRESHOLD rows;
+  - a JAX tier (jit -> XLA, fused elementwise chains; the TPU lowering)
+    used for numeric plans over JAX_THRESHOLD rows — built lazily on first
+    use and traced under enable_x64 so int64/float64 results stay
+    byte-identical to the Python row interpreter.
+
+Batches arrive either as row-tuple lists (extracted via try_columns) or as
+ColumnarBatch struct-of-arrays (columns reused directly — no per-row
+extraction; see engine/columnar.py).
 
 Correctness contract vs the row interpreter:
   - any arithmetic fault or unsupported value shape aborts the columnar
@@ -23,13 +31,18 @@ import numpy as np
 
 from ..internals import expression as E
 from ..internals.value import Error
+from .columnar import ColumnarBatch
 
 VEC_THRESHOLD = 32
+JAX_THRESHOLD = 65536
 # per-column magnitude bound enforced at extraction time; 2**44 admits
 # millisecond epoch timestamps while keeping sums/products analyzable
 _INT_LEAF_BOUND = 2**44
 _INT_LEAF_EXP = 44
 _INT_SAFE_EXP = 62  # results must provably fit in int64
+
+# observability: which tier actually executed (tests assert on these)
+STATS = {"np_batches": 0, "jax_batches": 0, "row_batches": 0}
 
 
 class Unsupported(Exception):
@@ -37,19 +50,87 @@ class Unsupported(Exception):
 
 
 class _Node:
-    __slots__ = ("fn", "kind", "exp")
+    __slots__ = ("fn", "kind", "exp", "jaxable", "nonefree")
 
-    def __init__(self, fn, kind: str, exp: int):
+    def __init__(self, fn, kind: str, exp: int, jaxable: bool = True,
+                 nonefree: bool = True):
         self.fn = fn
         self.kind = kind  # "int" | "float" | "bool" | "str" | "any"
         self.exp = exp  # log2 magnitude bound for ints (overflow analysis)
+        self.jaxable = jaxable
+        # provably never None within a vectorized batch (input columns are
+        # None-free by extraction; method-call results are NOT)
+        self.nonefree = nonefree
+
+
+class Plan:
+    """Compiled columnar evaluator. plan(cols) -> list of arrays/scalars."""
+
+    def __init__(self, exprs, nodes: list[_Node], used: set[int], positions):
+        self.nodes = nodes
+        self.used_columns = used
+        self._exprs = exprs
+        self._positions = positions
+        # XLA offload covers the jaxable SUBSET of output expressions (a
+        # string passthrough column must not block fusing the numeric ones);
+        # the exact subset depends on runtime column dtypes, so jitted
+        # callables are cached per subset signature
+        self._jax_static = [i for i, nd in enumerate(nodes) if nd.jaxable]
+        self._node_deps: list[set[int]] = []
+        for e in exprs:
+            deps = set()
+            for r in e._dependencies():
+                ci = positions.get((id(r.table), r._name))
+                if ci is not None:
+                    deps.add(ci)
+            self._node_deps.append(deps)
+        self._jax_cache: dict[tuple, Any] = {}
+
+    def _get_jax(self, idx: tuple):
+        if idx not in self._jax_cache:
+            self._jax_cache[idx] = _build_jax(
+                [self._exprs[i] for i in idx], self._positions
+            )
+        return self._jax_cache[idx]
+
+    def __call__(self, cols: list, n: int | None = None):
+        if n is not None and n >= JAX_THRESHOLD and self._jax_static:
+            numeric = {
+                ci
+                for ci in self.used_columns
+                if isinstance(cols[ci], np.ndarray) and cols[ci].dtype != object
+            }
+            idx = tuple(
+                i for i in self._jax_static if self._node_deps[i] <= numeric
+            )
+            jf = self._get_jax(idx) if idx else None
+            if jf is not None:
+                try:
+                    jouts = jf(cols)
+                except Exception:
+                    jouts = None  # non-numeric inputs etc.: numpy tier
+                if jouts is not None:
+                    out: list = [None] * len(self.nodes)
+                    for i, o in zip(idx, jouts):
+                        out[i] = np.asarray(o)
+                    with np.errstate(
+                        divide="raise", invalid="raise", over="raise"
+                    ):
+                        for i, node in enumerate(self.nodes):
+                            if out[i] is None:
+                                out[i] = node.fn(cols)
+                    STATS["jax_batches"] += 1
+                    return out
+        # error-poisoning parity: arithmetic faults abort the columnar path;
+        # the caller falls back to the row interpreter
+        with np.errstate(divide="raise", invalid="raise", over="raise"):
+            out = [node.fn(cols) for node in self.nodes]
+        STATS["np_batches"] += 1
+        return out
 
 
 def compile_plan(exprs, positions: dict[tuple[int, str], int]):
-    """Compile expressions to a columnar fn(cols) -> list of arrays/scalars.
-
-    Returns None when any expression shape is unsupported.
-    """
+    """Compile expressions to a columnar Plan; None when unsupported."""
     try:
         nodes = [_compile(e, positions) for e in exprs]
     except Unsupported:
@@ -61,18 +142,98 @@ def compile_plan(exprs, positions: dict[tuple[int, str], int]):
             idx = positions.get((id(ref.table), ref._name))
             if idx is not None:
                 used.add(idx)
-
-    def plan(cols: list[np.ndarray]):
-        # error-poisoning parity: arithmetic faults abort the columnar path;
-        # the caller falls back to the row interpreter
-        with np.errstate(divide="raise", invalid="raise", over="raise"):
-            return [n.fn(cols) for n in nodes]
-
-    plan.used_columns = used  # type: ignore[attr-defined]
-    return plan
+    return Plan(exprs, nodes, used, positions)
 
 
-def _compile(e, positions) -> _Node:
+_JAX_HEALTHY: bool | None = None
+
+
+def _jax_healthy(timeout_s: float = 15.0) -> bool:
+    """One-time backend probe in a daemon thread: a wedged device tunnel
+    (PJRT claim never granted) must disable the jax tier, not hang the
+    data plane."""
+    global _JAX_HEALTHY
+    if _JAX_HEALTHY is None:
+        import threading
+
+        result: dict = {}
+
+        def probe():
+            try:
+                import jax
+
+                jax.devices()
+                result["ok"] = True
+            except Exception:
+                result["ok"] = False
+
+        th = threading.Thread(target=probe, daemon=True, name="pw-jax-probe")
+        th.start()
+        th.join(timeout_s)
+        ok = result.get("ok", False)
+        if ok:
+            import os
+
+            import jax
+
+            # on a CPU backend numpy wins (no dispatch/transfer overhead);
+            # the jax tier exists for accelerators.  PW_FORCE_JAX_TIER=1
+            # exercises it in tests.
+            if (
+                jax.default_backend() == "cpu"
+                and os.environ.get("PW_FORCE_JAX_TIER") != "1"
+            ):
+                ok = False
+        _JAX_HEALTHY = ok
+    return _JAX_HEALTHY
+
+
+def _build_jax(exprs, positions):
+    """JAX tier: trace the same AST over jnp under x64 so dtypes match the
+    row engine exactly; jit gives XLA fusion (and the device path on TPU)."""
+    if not _jax_healthy():
+        return None
+    try:
+        import jax
+        import jax.numpy as jnp
+    except Exception:  # pragma: no cover - jax is baked in
+        return None
+    try:
+        nodes = [_compile(e, positions, xp=jnp) for e in exprs]
+    except Unsupported:
+        return None
+    used = sorted(
+        {
+            positions[(id(r.table), r._name)]
+            for e in exprs
+            for r in e._dependencies()
+            if (id(r.table), r._name) in positions
+        }
+    )
+    pos_map = {ci: j for j, ci in enumerate(used)}
+
+    def raw(arrs):
+        cols: list = [None] * (max(used) + 1 if used else 0)
+        for ci, j in pos_map.items():
+            cols[ci] = arrs[j]
+        return [node.fn(cols) for node in nodes]
+
+    jitted = jax.jit(raw)
+
+    def call(all_cols):
+        arrs = [all_cols[ci] for ci in used]
+        if any(
+            a is None or not isinstance(a, np.ndarray) or a.dtype == object
+            for a in arrs
+        ):
+            raise Unsupported("non-numeric column in jax tier")
+        with jax.enable_x64():
+            return jitted(arrs)
+
+    return call
+
+
+def _compile(e, positions, xp=np) -> _Node:
     if isinstance(e, E.ColumnReference):
         if e._name == "id":
             raise Unsupported("id column")
@@ -94,13 +255,13 @@ def _compile(e, positions) -> _Node:
         if isinstance(v, float):
             return _Node(lambda cols: v, "float", 0)
         if isinstance(v, str):
-            return _Node(lambda cols: v, "str", 0)
+            return _Node(lambda cols: v, "str", 0, jaxable=False)
         raise Unsupported("const type")
     if isinstance(e, E.BinaryOpExpression):
-        n1 = _compile(e._left, positions)
-        n2 = _compile(e._right, positions)
+        n1 = _compile(e._left, positions, xp)
+        n2 = _compile(e._right, positions, xp)
         op = e._op
-        fn = _VEC_BINOPS.get(op)
+        fn = _vec_binop(op, xp)
         if fn is None:
             raise Unsupported(op)
         exp = _bound(op, n1, n2)
@@ -108,28 +269,136 @@ def _compile(e, positions) -> _Node:
             raise Unsupported("possible int64 overflow")
         f1, f2 = n1.fn, n2.fn
         kind = "bool" if op in _CMP_OPS else "any"
-        return _Node(lambda cols: fn(f1(cols), f2(cols)), kind, exp)
+        # division stays on the numpy tier: XLA's x/0 yields inf (int: 0)
+        # where the row interpreter poisons with Error — errstate parity
+        # exists only under numpy
+        jaxable = n1.jaxable and n2.jaxable and op not in ("/", "//", "%")
+        return _Node(
+            lambda cols: fn(f1(cols), f2(cols)), kind, exp,
+            jaxable=jaxable,
+            nonefree=n1.nonefree and n2.nonefree,
+        )
     if isinstance(e, E.UnaryOpExpression):
-        n1 = _compile(e._expr, positions)
+        n1 = _compile(e._expr, positions, xp)
         f1 = n1.fn
         if e._op == "-":
-            return _Node(lambda cols: -f1(cols), n1.kind, n1.exp + 1)
+            return _Node(lambda cols: -f1(cols), n1.kind, n1.exp + 1, n1.jaxable)
 
         def invert(cols):
-            a = np.asarray(f1(cols))
+            a = xp.asarray(f1(cols))
             return ~a
 
-        return _Node(invert, n1.kind, n1.exp)
+        return _Node(invert, n1.kind, n1.exp, n1.jaxable, n1.nonefree)
     if isinstance(e, E.IfElseExpression):
-        nc = _compile(e._cond, positions)
-        nt = _compile(e._then, positions)
-        ne = _compile(e._else, positions)
+        nc = _compile(e._cond, positions, xp)
+        nt = _compile(e._then, positions, xp)
+        ne = _compile(e._else, positions, xp)
         fc, ft, fe = nc.fn, nt.fn, ne.fn
         return _Node(
-            lambda cols: np.where(fc(cols), ft(cols), fe(cols)),
+            lambda cols: xp.where(fc(cols), ft(cols), fe(cols)),
             "any", max(nt.exp, ne.exp),
+            nc.jaxable and nt.jaxable and ne.jaxable,
+            nc.nonefree and nt.nonefree and ne.nonefree,
+        )
+    if isinstance(e, E.IsNoneExpression):
+        # the static shortcut is only sound for provably None-free operands
+        # (input columns); a method-call result CAN be None — row path then
+        inner = _compile(e._expr, positions, xp)
+        if not inner.nonefree:
+            raise Unsupported("is_none over maybe-None operand")
+        result = isinstance(e, E.IsNotNoneExpression)
+        return _Node(lambda cols: result, "bool", 0)
+    if isinstance(e, E.CoalesceExpression):
+        # None-free first argument wins outright; maybe-None args (method
+        # calls) fall back to the row interpreter
+        for a in e._args:
+            if isinstance(a, E.ConstExpression) and a._value is None:
+                continue
+            node = _compile(a, positions, xp)
+            if not node.nonefree:
+                raise Unsupported("coalesce over maybe-None argument")
+            return node
+        raise Unsupported("coalesce of all-None")
+    if isinstance(e, E.CastExpression):
+        inner = _compile(e._expr, positions, xp)
+        from ..internals import dtype as dt
+
+        if not inner.nonefree:
+            raise Unsupported("cast over maybe-None operand")
+        target = e._target.strip_optional()
+        fi = inner.fn
+        if target == dt.FLOAT:
+            return _Node(
+                lambda cols: xp.asarray(fi(cols), _f64(xp)), "float", 0,
+                inner.jaxable,
+            )
+        if target == dt.INT:
+            return _Node(
+                lambda cols: xp.asarray(fi(cols), _i64(xp)), "int",
+                _INT_LEAF_EXP, inner.jaxable,
+            )
+        raise Unsupported("cast target")
+    if isinstance(e, E.MethodCallExpression) and xp is np:
+        # .dt/.str/.num method calls vectorize as a single fused column map:
+        # no per-row env dicts, one Python-level loop per batch (host tier
+        # only — the per-value fn is arbitrary Python)
+        arg_nodes = [_compile(a, positions, np) for a in e._args]
+        fn = e._fn
+        if fn is None:
+            raise Unsupported("method without fn")
+        if len(arg_nodes) == 1:
+            f1 = arg_nodes[0].fn
+
+            def mapped(cols, _fn=fn, _f1=f1):
+                a = _f1(cols)
+                if isinstance(a, np.ndarray):
+                    vals = a.tolist()
+                elif isinstance(a, list):
+                    vals = a
+                else:
+                    return _fn(a)
+                # object dtype: results may be None/heterogeneous, and any
+                # consumer must do elementwise Python ops, never list concat
+                out = np.empty(len(vals), object)
+                out[:] = [_fn(v) for v in vals]
+                return out
+
+            return _Node(
+                mapped, "any", _INT_LEAF_EXP, jaxable=False, nonefree=False
+            )
+
+        fns = [a.fn for a in arg_nodes]
+
+        def mapped_n(cols, _fn=fn, _fns=fns):
+            vals = [f(cols) for f in _fns]
+            n = None
+            for v in vals:
+                if isinstance(v, (np.ndarray, list)):
+                    n = len(v)
+                    break
+            if n is None:
+                return _fn(*vals)
+            lists = [
+                v.tolist() if isinstance(v, np.ndarray)
+                else (v if isinstance(v, list) else [v] * n)
+                for v in vals
+            ]
+            out = np.empty(n, object)
+            out[:] = [_fn(*vs) for vs in zip(*lists)]
+            return out
+
+        return _Node(
+            mapped_n, "any", _INT_LEAF_EXP, jaxable=False, nonefree=False
         )
     raise Unsupported(type(e).__name__)
+
+
+def _f64(xp):
+    return np.float64 if xp is np else xp.float64
+
+
+def _i64(xp):
+    return np.int64 if xp is np else xp.int64
 
 
 _CMP_OPS = {"==", "!=", "<", "<=", ">", ">="}
@@ -153,15 +422,16 @@ def _bound(op: str, n1: _Node, n2: _Node) -> int:
     return 63
 
 
-def _true_div(a, b):
-    return np.asarray(a, np.float64) / b
+def _vec_binop(op: str, xp):
+    if op == "/":
+        return lambda a, b: xp.asarray(a, _f64(xp)) / b
+    return _PY_BINOPS.get(op)
 
 
-_VEC_BINOPS = {
+_PY_BINOPS = {
     "+": lambda a, b: a + b,
     "-": lambda a, b: a - b,
     "*": lambda a, b: a * b,
-    "/": _true_div,
     "//": lambda a, b: a // b,
     "%": lambda a, b: a % b,
     "==": lambda a, b: a == b,
@@ -179,12 +449,21 @@ _VEC_BINOPS = {
 def try_columns(updates, ncols: int, used: set[int]):
     """Extract used columns as homogeneous numpy arrays.
 
+    ColumnarBatch inputs reuse their cached column arrays (no per-row work).
     Returns None (forcing the row-interpreter path) when a column mixes
     types, contains None/Error, or holds ints outside the overflow-safe
     leaf bound.
     """
+    if isinstance(updates, ColumnarBatch):
+        cols: list = [None] * max(ncols, len(updates.cols))
+        for ci in used:
+            arr = updates.np_col(ci)
+            if arr is None:
+                return None
+            cols[ci] = arr
+        return cols
     n = len(updates)
-    cols: list = [None] * ncols
+    cols = [None] * ncols
     for ci in used:
         kinds = set()
         for _k, row, _d in updates:
@@ -209,13 +488,13 @@ def try_columns(updates, ncols: int, used: set[int]):
             # int semantics; bool columns stay on the row interpreter
             return None
         if kind == "int":
-            dt = np.int64
+            dt_ = np.int64
         elif kind == "float":
-            dt = np.float64
+            dt_ = np.float64
         else:
-            dt = object  # strings
+            dt_ = object  # strings
         try:
-            arr = np.empty(n, dt)
+            arr = np.empty(n, dt_)
             for i, (_k, row, _d) in enumerate(updates):
                 arr[i] = row[ci]
             if kind == "int" and (

@@ -46,6 +46,27 @@ class StaticDataSource(DataSource):
         return self._events
 
 
+class ColumnarStaticSource(DataSource):
+    """Static source already in struct-of-arrays form: the engine ingests the
+    ColumnarBatch directly — no per-row event tuples are ever built (the
+    columnar input tier of SURVEY.md §7's design stance)."""
+
+    def __init__(self, batches: list):
+        self._batches = batches  # [(time, ColumnarBatch)]
+
+    def static_batches(self) -> list:
+        return self._batches
+
+    def static_events(self) -> list[Event]:
+        # compatibility materialization (cluster replicated injection,
+        # persistence journaling)
+        return [
+            (t, key, row, diff)
+            for t, b in self._batches
+            for (key, row, diff) in b
+        ]
+
+
 def rows_to_events(
     rows: Iterable[tuple],
     colnames: list[str],

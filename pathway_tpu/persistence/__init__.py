@@ -103,8 +103,12 @@ class FilesystemBackend(Backend):
         return sorted(out)
 
     def put_metadata(self, key: str, value: bytes) -> None:
-        with open(os.path.join(self.path, f"{key}.meta"), "wb") as f:
+        # atomic replace: cluster processes read this concurrently
+        p = os.path.join(self.path, f"{key}.meta")
+        tmp = f"{p}.tmp.{os.getpid()}"
+        with open(tmp, "wb") as f:
             f.write(value)
+        os.replace(tmp, p)
 
     def get_metadata(self, key: str) -> bytes | None:
         p = os.path.join(self.path, f"{key}.meta")
@@ -178,8 +182,12 @@ def attach_persistence(runner, config: Config) -> None:
         base = _stream_name(idx, source)
         streams.extend(sorted(set(backend.list_streams(base)) | {base}))
     ver_b = backend.get_metadata("journal_format")
-    if ver_b is not None:
-        ver = int(ver_b)
+    try:
+        ver_parsed = int(ver_b) if ver_b else None
+    except ValueError:
+        ver_parsed = None  # torn concurrent write: fall through to heuristic
+    if ver_parsed is not None:
+        ver = ver_parsed
     elif any(backend.read_all(s) for s in streams):
         # journals exist but carry no version stamp: written by round-1 code
         # (which predates the metadata key) — that is format v1
