@@ -64,6 +64,24 @@ class Operator:
         assert self.scheduler is not None
         self.scheduler.route(self, time, updates)
 
+    # -- operator persistence ----------------------------------------------
+    # names of attributes that constitute this operator's durable state
+    # (reference: operator snapshots, src/persistence/operator_snapshot.rs:21-372);
+    # empty tuple = stateless
+    _STATE_ATTRS: tuple[str, ...] = ()
+
+    def snapshot_state(self):
+        """Picklable durable state, or None for stateless operators.
+        Raises if a state attribute cannot be captured (the snapshot
+        manager then disables snapshots for the run)."""
+        if not self._STATE_ATTRS:
+            return None
+        return {a: getattr(self, a) for a in self._STATE_ATTRS}
+
+    def restore_state(self, st) -> None:
+        for a, v in st.items():
+            setattr(self, a, v)
+
 
 class Scheduler:
     def __init__(self) -> None:
@@ -237,6 +255,8 @@ class DiffOutputOperator(Operator):
     logical time, so downstream sees one retract+insert per changed key per
     time regardless of intra-time churn.
     """
+
+    _STATE_ATTRS = ("state", "last_out")
 
     def __init__(self, n_inputs: int, name: str = ""):
         super().__init__(name)

@@ -374,10 +374,13 @@ class FlattenOperator(Operator):
 class JoinOperator(Operator):
     """Incremental binary join with inner/left/right/outer modes.
 
+
     Re-design of join_tables (dataflow.rs:2720): per-side arrangements keyed
     by join key; each delta joins against the opposite arrangement; outer
     padding rows are maintained via per-join-key multiplicity totals.
     """
+
+    _STATE_ATTRS = ("left", "right", "left_total", "right_total")
 
     def __init__(
         self,
@@ -398,6 +401,7 @@ class JoinOperator(Operator):
         self.how = how
         self.id_policy = id_policy
         self.left_ncols, self.right_ncols = left_ncols, right_ncols
+        # durable arrangement state (operator snapshots)
         # jk -> {row_key: (row, count)}
         self.left: dict[Any, dict[Key, tuple[Row, int]]] = defaultdict(dict)
         self.right: dict[Any, dict[Key, tuple[Row, int]]] = defaultdict(dict)
@@ -517,6 +521,8 @@ class GroupbyOperator(Operator):
     Output stabilizes once per logical time: per dirty group, the operator
     diffs the freshly-computed row against the last emitted one.
     """
+
+    _STATE_ATTRS = ("groups", "last_out")
 
     def __init__(
         self,
@@ -828,6 +834,8 @@ class IxOperator(DiffOutputOperator):
     (reference: ix/ix_ref, internals/table.py; restrict/with_universe_of uses
     the identity pointer)."""
 
+    _STATE_ATTRS = ("state", "last_out", "fwd", "rev")
+
     def __init__(
         self,
         src_env: EnvBuilder,
@@ -938,6 +946,8 @@ class UpdateCellsOperator(DiffOutputOperator):
 class DeduplicateOperator(Operator):
     """Stateful deduplication with a user acceptor
     (reference: deduplicate, dataflow.rs:3858; stdlib/stateful/deduplicate.py)."""
+
+    _STATE_ATTRS = ("accepted",)
 
     def __init__(
         self,

@@ -32,6 +32,7 @@ class LoweredGraph:
         self.input_ops: list[tuple[ops.InputOperator, Any]] = []  # (op, source)
         self.captures: dict[int, CapturedStream] = {}
         self.output_callbacks: list[Callable[[], None]] = []
+        self.writers: list[Any] = []  # file sinks (snapshot-resume trimming)
 
 
 def _groupby_simple_spec(src: Table, p: dict):
@@ -262,6 +263,7 @@ def _make_operator(node: pg.OpNode, lg: LoweredGraph) -> Operator:
     if kind == "output":
         writer = p["writer"]
         colnames = p["colnames"]
+        lg.writers.append(writer)
 
         def on_time(t, updates, _w=writer):
             _w.write_batch(t, colnames, updates)
@@ -391,6 +393,9 @@ class GraphRunner:
             else:
                 slept = autocommit_ms / 1000.0
                 _time.sleep(slept)
+            mgr = getattr(self, "_snapshot_mgr", None)
+            if mgr is not None:
+                mgr.maybe_snapshot()
             now = _time.monotonic()
             if tracker is not None:
                 # busy fraction = non-sleep time / loop time (work in poll,

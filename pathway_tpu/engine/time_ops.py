@@ -47,6 +47,8 @@ class BufferOperator(Operator):
     the max time-column value seen so far.
     """
 
+    _STATE_ATTRS = ("pending", "frontier")
+
     def __init__(self, env, threshold_fn, time_fn, name="buffer"):
         super().__init__(name)
         self.env = env
@@ -96,6 +98,8 @@ class ForgetOperator(Operator):
     """Retract rows once the event-time frontier passes their threshold;
     retractions flow at odd times (reference: forget)."""
 
+    _STATE_ATTRS = ("live", "frontier")
+
     def __init__(self, env, threshold_fn, time_fn, mark_forgetting: bool = True,
                  name="forget"):
         super().__init__(name)
@@ -144,6 +148,8 @@ class ForgetOperator(Operator):
 class FreezeOperator(Operator):
     """Ignore updates arriving after their threshold passed
     (reference: freeze / CommonBehavior.cutoff)."""
+
+    _STATE_ATTRS = ("frontier",)
 
     def __init__(self, env, threshold_fn, time_fn, name="freeze"):
         super().__init__(name)

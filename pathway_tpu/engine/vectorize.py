@@ -31,14 +31,13 @@ import numpy as np
 
 from ..internals import expression as E
 from ..internals.value import Error
-from .columnar import ColumnarBatch
+from .columnar import ColumnarBatch, _INT_LEAF_BOUND
 
 VEC_THRESHOLD = 32
 JAX_THRESHOLD = 65536
-# per-column magnitude bound enforced at extraction time; 2**44 admits
-# millisecond epoch timestamps while keeping sums/products analyzable
-_INT_LEAF_BOUND = 2**44
-_INT_LEAF_EXP = 44
+# the column magnitude bound is enforced at extraction time in columnar.py;
+# 2**44 admits millisecond epoch timestamps while keeping sums analyzable
+_INT_LEAF_EXP = _INT_LEAF_BOUND.bit_length() - 1
 _INT_SAFE_EXP = 62  # results must provably fit in int64
 
 # observability: which tier actually executed (tests assert on these)
@@ -282,7 +281,10 @@ def _compile(e, positions, xp=np) -> _Node:
         n1 = _compile(e._expr, positions, xp)
         f1 = n1.fn
         if e._op == "-":
-            return _Node(lambda cols: -f1(cols), n1.kind, n1.exp + 1, n1.jaxable)
+            return _Node(
+                lambda cols: -f1(cols), n1.kind, n1.exp + 1, n1.jaxable,
+                n1.nonefree,
+            )
 
         def invert(cols):
             a = xp.asarray(f1(cols))

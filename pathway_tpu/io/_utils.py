@@ -230,15 +230,25 @@ class FilePollingSource(DataSource):
 
 
 class FileWriter:
-    """Base sink writing consolidated update batches."""
+    """Base sink writing consolidated update batches.
+
+    The file opens lazily on first write so operator-snapshot recovery can
+    inspect/trim the previous run's output BEFORE it would be truncated
+    (persistence/snapshots.py calls resume())."""
 
     def __init__(self, path: str):
         self.path = path
-        self._fh = open(path, "w", encoding="utf-8")
+        self._fh = None
+        self._mode = "w"
         self._lock = threading.Lock()
+
+    def _ensure_open(self):
+        if self._fh is None:
+            self._fh = open(self.path, self._mode, encoding="utf-8")
 
     def write_batch(self, time: int, colnames: list[str], updates: list) -> None:
         with self._lock:
+            self._ensure_open()
             for key, row, diff in updates:
                 self.write_row(time, colnames, key, unwrap_row(row), diff)
             self._fh.flush()
@@ -246,9 +256,29 @@ class FileWriter:
     def write_row(self, time, colnames, key, row, diff):
         raise NotImplementedError
 
+    def resume(self, keep_le_time: int) -> None:
+        """Exactly-once resume: drop output entries from times AFTER the
+        restored snapshot frontier (they will be re-emitted by the tail
+        replay), keep the rest, and append from here on (reference: the
+        persistence metadata tracker's committed output frontiers,
+        src/persistence/tracker.rs:51-275)."""
+        with self._lock:
+            assert self._fh is None, "resume() must precede the first write"
+            if os.path.exists(self.path):
+                kept = self._filter_lines(self.path, keep_le_time)
+                tmp = f"{self.path}.tmp"
+                with open(tmp, "w", encoding="utf-8") as f:
+                    f.writelines(kept)
+                os.replace(tmp, self.path)
+                self._mode = "a"
+
+    def _filter_lines(self, path: str, keep_le_time: int) -> list[str]:
+        raise NotImplementedError
+
     def close(self) -> None:
         try:
-            self._fh.close()
+            if self._fh is not None:
+                self._fh.close()
         except Exception:
             pass
 
@@ -260,6 +290,16 @@ class JsonlinesWriter(FileWriter):
         obj["diff"] = diff
         self._fh.write(json.dumps(obj, default=str) + "\n")
 
+    def _filter_lines(self, path, keep_le_time):
+        kept = []
+        for ln in open(path, encoding="utf-8"):
+            try:
+                if json.loads(ln).get("time", 0) <= keep_le_time:
+                    kept.append(ln)
+            except Exception:
+                continue
+        return kept
+
 
 class CsvWriter(FileWriter):
     def __init__(self, path: str):
@@ -269,8 +309,28 @@ class CsvWriter(FileWriter):
     def write_row(self, time, colnames, key, row, diff):
         if self._writer is None:
             self._writer = _csv.writer(self._fh)
-            self._writer.writerow(list(colnames) + ["time", "diff"])
+            if self._mode == "w":
+                self._writer.writerow(list(colnames) + ["time", "diff"])
         self._writer.writerow([_csvable(v) for v in row] + [time, diff])
+
+    def _filter_lines(self, path, keep_le_time):
+        # parse with the csv module (quoted fields may span physical lines)
+        import io as _io2
+
+        with open(path, encoding="utf-8", newline="") as f:
+            rows = list(_csv.reader(f))
+        if not rows:
+            return []
+        out = _io2.StringIO()
+        w = _csv.writer(out)
+        w.writerow(rows[0])  # header
+        for r in rows[1:]:
+            try:
+                if int(r[-2]) <= keep_le_time:
+                    w.writerow(r)
+            except (ValueError, IndexError):
+                continue
+        return [out.getvalue()]
 
 
 def _jsonable(v):

@@ -53,18 +53,56 @@ class KafkaSource(DataSource):
         self._consumer = None
         self._kind = None
         self._n = 0
+        # partition -> next offset to consume (the reference's
+        # OffsetAntichain; persisted inside journal records so a restart
+        # seeks past consumed messages instead of trusting the consumer
+        # group's committed offsets, src/connectors/mod.rs:319-388)
+        self._offsets: dict[int, int] = {}
+        self._seek_to: dict | None = None
 
     def is_live(self) -> bool:
         return True
 
+    # -- offset frontier (persistence) -------------------------------------
+    def get_offsets(self) -> dict:
+        return {"__n": self._n, **{f"p{p}": o for p, o in self._offsets.items()}}
+
+    def seek(self, offsets: dict) -> None:
+        self._seek_to = dict(offsets)
+        self._n = int(offsets.get("__n", 0))
+        self._offsets = {
+            int(k[1:]): int(v) for k, v in offsets.items() if k.startswith("p")
+        }
+
     def start(self) -> None:
         self._kind, self._consumer = _get_consumer(self.settings, self.topic)
+        if self._seek_to is not None and self._offsets:
+            try:
+                if self._kind == "confluent":
+                    from confluent_kafka import TopicPartition
+
+                    self._consumer.assign(
+                        [
+                            TopicPartition(self.topic, p, o)
+                            for p, o in self._offsets.items()
+                        ]
+                    )
+                else:
+                    from kafka import TopicPartition
+
+                    parts = [TopicPartition(self.topic, p) for p in self._offsets]
+                    self._consumer.assign(parts)
+                    for tp in parts:
+                        self._consumer.seek(tp, self._offsets[tp.partition])
+            except Exception:
+                pass  # fall back to group-committed positions
 
     def poll(self):
         events = []
         colnames = self.schema.column_names()
         dtypes = self.schema.dtypes()
         pk = self.schema.primary_key_columns()
+        pk_idx = [colnames.index(c) for c in pk]
         msgs: list[bytes] = []
         if self._kind == "confluent":
             while True:
@@ -74,10 +112,16 @@ class KafkaSource(DataSource):
                 if m.error():
                     continue
                 msgs.append(m.value())
+                try:
+                    self._offsets[m.partition()] = m.offset() + 1
+                except Exception:
+                    pass
         else:
             polled = self._consumer.poll(timeout_ms=0)
-            for batch in polled.values():
-                msgs.extend(r.value for r in batch)
+            for tp, batch in polled.items():
+                for r in batch:
+                    msgs.append(r.value)
+                    self._offsets[getattr(tp, "partition", 0)] = r.offset + 1
         for raw in msgs:
             if self.format == "debezium":
                 events.extend(
@@ -92,8 +136,12 @@ class KafkaSource(DataSource):
                 except Exception:
                     continue
                 row = tuple(coerce_value(d.get(c), dtypes[c]) for c in colnames)
-                key = ref_scalar(*[d.get(c) for c in pk]) if pk else ref_scalar(
-                    self.topic, self._n
+                # keys hash the COERCED pk values (pointer_from parity),
+                # read back from the already-coerced row
+                key = (
+                    ref_scalar(*[row[i] for i in pk_idx])
+                    if pk
+                    else ref_scalar(self.topic, self._n)
                 )
             else:  # plaintext / raw
                 v = raw.decode("utf-8", "replace") if self.format == "plaintext" else raw
@@ -131,12 +179,14 @@ def parse_debezium(raw: bytes, colnames, dtypes, pk) -> list:
     op = payload.get("op", "c")
     out = []
 
+    pk_idx = [colnames.index(c) for c in pk]
+
     def ev(record, diff):
         if record is None:
             return
         row = tuple(coerce_value(record.get(c), dtypes[c]) for c in colnames)
         key = (
-            ref_scalar(*[record.get(c) for c in pk])
+            ref_scalar(*[row[i] for i in pk_idx])
             if pk
             else ref_scalar("dbz", tuple(sorted(record.items(), key=lambda kv: kv[0])))
         )

@@ -437,7 +437,14 @@ class ClusterRunner:
                 elif rescale_code is not None:
                     cmd = ("rescale", rescale_code)
                 else:
-                    cmd = ("tick", logical)
+                    # coordinated snapshot wave: every process snapshots
+                    # after draining the SAME tick, so the per-process
+                    # snapshots form one consistent cut of the cluster
+                    snap_now = False
+                    mgr0 = getattr(self, "_snapshot_mgr", None)
+                    if mgr0 is not None and mgr0.due():
+                        snap_now = True
+                    cmd = ("tick", logical, snap_now)
                 cmd = self._broadcast(cmd)
             else:
                 slept = 0.0
@@ -472,6 +479,9 @@ class ClusterRunner:
             # every process drains unconditionally: the agreement protocol
             # itself discovers whether any peer has work at any time
             self._agreed_drain()
+            mgr = getattr(self, "_snapshot_mgr", None)
+            if mgr is not None and len(cmd) > 2 and cmd[2]:
+                mgr.snapshot()
             # gather round state
             reports = self._gather(
                 (len(finished), got_any, has_completions, self.frontier)

@@ -220,25 +220,51 @@ def attach_persistence(runner, config: Config) -> None:
     nprocs = getattr(runner, "nprocs", 1)
     pid = getattr(runner, "pid", 0)
     owns_event = getattr(runner, "owns_event", None)
+    # operator snapshots (O(state) restart): enabled with an interval or the
+    # explicit mode (reference: PersistenceMode::OperatorPersisting)
+    snapshots_on = (
+        config.snapshot_interval_ms > 0
+        or config.persistence_mode == "operator_persisting"
+    )
+    snap = None
+    if snapshots_on:
+        from . import snapshots as snapmod
+
+        snap = snapmod.try_restore(runner, backend, {})
+    journal_seqs: dict[str, int] = {}
     for idx, (op, source) in enumerate(lg.input_ops):
         base_stream = _stream_name(idx, source)
         write_stream = (
             f"{base_stream}__p{pid}" if nprocs > 1 else base_stream
         )
-        # replay journal through a wrapper source; each journal record is
-        # (events, offsets_after) so journal+offsets commit atomically
         read_streams = [base_stream]
         if hasattr(backend, "list_streams"):
             read_streams = sorted(
                 set(backend.list_streams(base_stream)) | {base_stream}
             )
+        # each journal record is (seq, events, offsets_after): seq-numbered
+        # so snapshot watermarks survive journal trimming; offsets travel
+        # inside records so journal+offsets commit atomically
         replayed: list = []
         last_offsets: dict | None = None
+        if snap is not None and idx in snap.get("offsets", {}):
+            so = snap["offsets"][idx]
+            if so:
+                last_offsets = dict(so)
         n_records = 0
+        folded = snap.get("journal_seqs", {}) if snap is not None else {}
         for rs in read_streams:
-            for rec in backend.read_all(rs):
+            fold_seq = folded.get(rs, -1)
+            keep_raw: list[bytes] = []
+            raw = backend.read_all(rs)
+            max_seq = -1
+            for i, rec in enumerate(raw):
+                seq, events, offsets = _parse_record(rec, i)
+                max_seq = max(max_seq, seq)
+                if seq <= fold_seq:
+                    continue  # folded into the restored operator state
                 n_records += 1
-                events, offsets = pickle.loads(rec)
+                keep_raw.append(rec)
                 replayed.extend(events)
                 if offsets is not None:
                     if last_offsets is None:
@@ -247,23 +273,62 @@ def attach_persistence(runner, config: Config) -> None:
                         for k, v in offsets.items():
                             cur = last_offsets.get(k)
                             last_offsets[k] = v if cur is None else max(cur, v)
+            if rs == write_stream:
+                # never regress below the snapshot watermark: a trimmed-empty
+                # stream must not reissue already-folded sequence numbers
+                journal_seqs[rs] = max(max_seq, fold_seq)
+            # trim folded records (safe any time: watermarks are seqs, not
+            # positions); only the owning process rewrites its stream
+            if (
+                snap is not None
+                and len(keep_raw) < len(raw)
+                and (rs == write_stream or nprocs <= 1)
+                and hasattr(backend, "replace_all")
+            ):
+                backend.replace_all(rs, keep_raw)
         replayed.sort(key=lambda e: e[0])  # merge streams by logical time
         # journal compaction (reference: operator_snapshot.rs background
         # merging): squash the replay into one consolidated record so the
         # journal doesn't grow with history.  Single-process only: cluster
         # startup reads the same streams concurrently, so rewriting them
         # here would race with peers' reads.
-        if nprocs <= 1 and n_records > 8 and hasattr(backend, "replace_all"):
+        if (
+            snap is None
+            and nprocs <= 1
+            and n_records > 8
+            and hasattr(backend, "replace_all")
+        ):
             compacted = _compact_events(replayed)
+            seq = journal_seqs.get(base_stream, n_records - 1)
             backend.replace_all(
-                base_stream, [pickle.dumps((compacted, last_offsets))]
+                base_stream, [pickle.dumps((seq, compacted, last_offsets))]
             )
             replayed = compacted
         _wrap_source_with_persistence(
             source, backend, write_stream, replayed, last_offsets,
             owns_event=owns_event if nprocs > 1 else None,
             is_replay_injector=(pid == 0 or nprocs <= 1),
+            seq_holder=journal_seqs,
         )
+    if snapshots_on:
+        from .snapshots import SnapshotManager
+
+        mgr = SnapshotManager(
+            runner, backend,
+            config.snapshot_interval_ms or 3000,
+            {},
+        )
+        mgr.journal_seqs = journal_seqs
+        runner._snapshot_mgr = mgr
+
+
+def _parse_record(rec: bytes, position: int):
+    """(seq, events, offsets) — legacy 2-tuple records get positional seqs."""
+    data = pickle.loads(rec)
+    if len(data) == 3:
+        return data
+    events, offsets = data
+    return position, events, offsets
 
 
 def _stream_name(idx: int, source) -> str:
@@ -303,14 +368,25 @@ def _compact_events(events: list) -> list:
 def _wrap_source_with_persistence(source, backend: Backend, stream: str,
                                   replayed: list, last_offsets,
                                   owns_event=None,
-                                  is_replay_injector: bool = True) -> None:
+                                  is_replay_injector: bool = True,
+                                  seq_holder: dict | None = None) -> None:
     """`owns_event` (cluster mode) filters what THIS process journals, so the
     union of all processes' streams is exactly one copy of the input.
     `is_replay_injector` gates live-source replay to a single process —
     live events are injected exclusively (shipped to owners), so exactly one
-    process may replay them."""
+    process may replay them.  `seq_holder[stream]` tracks the last journal
+    sequence number written (operator-snapshot watermarks)."""
     orig_static = source.static_events
     orig_poll = source.poll
+    if seq_holder is None:
+        seq_holder = {}
+    seq_holder.setdefault(stream, -1)
+
+    def _append(events, offsets):
+        seq_holder[stream] += 1
+        backend.append(
+            stream, pickle.dumps((seq_holder[stream], events, offsets))
+        )
 
     # restore the reader's offset frontier so already-consumed rows are not
     # re-read (reference: rewind_from_disk_snapshot + frontier_for,
@@ -323,7 +399,7 @@ def _wrap_source_with_persistence(source, backend: Backend, stream: str,
         if owns_event is not None:
             events = [e for e in events if owns_event(e)]
         if events or offsets is not None:
-            backend.append(stream, pickle.dumps((events, offsets)))
+            _append(events, offsets)
 
     def static_events():
         live = orig_static()
@@ -356,7 +432,7 @@ def _wrap_source_with_persistence(source, backend: Backend, stream: str,
             offsets = source.get_offsets() if hasattr(source, "get_offsets") else None
             # the exclusive reader journals everything it read (no ownership
             # filter: no other process sees these events)
-            backend.append(stream, pickle.dumps((events, offsets)))
+            _append(events, offsets)
         return events
 
     source.static_events = static_events

@@ -1,0 +1,210 @@
+"""Operator-state snapshots: O(state) restart instead of O(history) replay.
+
+Reference: src/persistence/operator_snapshot.rs:21-372 (compacted operator
+state chunks) + src/engine/dataflow/persist.rs + the metadata commit tracker
+(tracker.rs:51-275).  Here a snapshot is one atomic metadata record per
+worker process:
+
+    { shape, frontier, ops: {(shard, pos): pickled-state},
+      offsets: {input_idx: reader-offsets}, journal_counts: {stream: n} }
+
+written at commit frontiers every `snapshot_interval_ms`.  On restart:
+
+  1. restore each stateful operator's state by (shard, topo-position) — the
+     lowering is deterministic, so positions are a stable identity;
+  2. replay ONLY the journal tail (records appended after the snapshot);
+  3. seek connector offsets; trim the journal to the tail;
+  4. trim file-sink output back to the snapshot frontier (the tail replay
+     re-emits anything after it exactly once).
+
+A shape change (elastic rescale) or any unpicklable operator state falls
+back to the full-journal replay path, which remains correct.
+"""
+
+from __future__ import annotations
+
+import logging
+import pickle
+import time as _time
+from typing import Any
+
+logger = logging.getLogger(__name__)
+
+_META_KEY = "opsnapshot"
+
+
+def _ops_by_identity(runner):
+    """[(identity, op)] — identity = (shard, topo_pos) on the cluster
+    runner, (0, topo_pos) on the single GraphRunner."""
+    out = []
+    if hasattr(runner, "graphs"):  # ClusterRunner
+        for s, g in runner.graphs.items():
+            for pos, op in enumerate(g.scheduler.topo_order()):
+                out.append(((s, pos), op))
+    else:
+        for pos, op in enumerate(runner.lg.scheduler.topo_order()):
+            out.append(((0, pos), op))
+    return out
+
+
+def _runner_shape(runner) -> tuple[int, int]:
+    return (
+        getattr(runner, "nprocs", 1),
+        getattr(runner, "threads", 1),
+    )
+
+
+def _meta_key(runner) -> str:
+    pid = getattr(runner, "pid", 0)
+    return f"{_META_KEY}_p{pid}"
+
+
+class SnapshotManager:
+    def __init__(self, runner, backend, interval_ms: int,
+                 stream_names: dict[int, str]):
+        self.runner = runner
+        self.backend = backend
+        self.interval_s = max(interval_ms, 250) / 1000.0
+        self.stream_names = stream_names  # input_idx -> journal stream
+        # stream -> last journal seq written; shared with the journaling
+        # wrappers, so a snapshot's watermarks survive journal trimming
+        self.journal_seqs: dict[str, int] = {}
+        self._last = _time.monotonic()
+        self._disabled = False
+
+    # -- write side ---------------------------------------------------------
+    def due(self) -> bool:
+        """Interval check for the coordinator of a cluster snapshot wave."""
+        if self._disabled:
+            return False
+        now = _time.monotonic()
+        if now - self._last < self.interval_s:
+            return False
+        self._last = now
+        return True
+
+    def maybe_snapshot(self) -> None:
+        if self.due():
+            self.snapshot()
+
+    def snapshot(self) -> None:
+        runner = self.runner
+        try:
+            ops_state: dict = {}
+            for ident, op in _ops_by_identity(runner):
+                st = op.snapshot_state()
+                if st is not None:
+                    ops_state[ident] = pickle.dumps(
+                        st, protocol=pickle.HIGHEST_PROTOCOL
+                    )
+            offsets = {}
+            for idx, (_op, source) in enumerate(runner.lg.input_ops):
+                if hasattr(source, "get_offsets"):
+                    offsets[idx] = source.get_offsets()
+            frontier = (
+                runner.frontier
+                if hasattr(runner, "frontier")
+                else runner.lg.scheduler.frontier
+            )
+            payload = {
+                "shape": _runner_shape(runner),
+                "frontier": frontier,
+                "ops": ops_state,
+                "offsets": offsets,
+                "journal_seqs": dict(self.journal_seqs),
+            }
+            self.backend.put_metadata(
+                _meta_key(runner),
+                pickle.dumps(payload, protocol=pickle.HIGHEST_PROTOCOL),
+            )
+        except Exception as exc:
+            logger.warning(
+                "operator snapshot failed (%s); snapshots disabled for this "
+                "run — recovery falls back to journal replay", exc,
+            )
+            self._disabled = True
+
+
+def try_restore(runner, backend, stream_names: dict[int, str]) -> dict | None:
+    """Load + apply the latest snapshot.  Returns {"frontier", "offsets",
+    "journal_seqs"} on success (attach then replays only journal tails),
+    or None (attach uses the full-replay path).
+
+    Cluster mode reads EVERY process's snapshot: they were written as one
+    coordinated wave at the same frontier, so their fold watermarks merge
+    into a consistent cut; any frontier mismatch (a crash mid-wave) rejects
+    the whole set."""
+    raw = backend.get_metadata(_meta_key(runner))
+    if not raw:
+        return None
+    try:
+        snap = pickle.loads(raw)
+    except Exception:
+        logger.warning("unreadable operator snapshot; ignoring")
+        return None
+    if snap.get("shape") != _runner_shape(runner):
+        logger.info(
+            "cluster shape changed %s -> %s: ignoring operator snapshot, "
+            "re-deriving state from the journal",
+            snap.get("shape"), _runner_shape(runner),
+        )
+        return None
+    merged_seqs = dict(snap.get("journal_seqs", {}))
+    nprocs = getattr(runner, "nprocs", 1)
+    if nprocs > 1:
+        my_pid = getattr(runner, "pid", 0)
+        for peer in range(nprocs):
+            if peer == my_pid:
+                continue
+            praw = backend.get_metadata(f"{_META_KEY}_p{peer}")
+            if not praw:
+                logger.warning(
+                    "peer %d snapshot missing; ignoring snapshots", peer
+                )
+                return None
+            try:
+                psnap = pickle.loads(praw)
+            except Exception:
+                logger.warning("peer %d snapshot unreadable; ignoring", peer)
+                return None
+            if psnap.get("frontier") != snap.get("frontier") or (
+                psnap.get("shape") != snap.get("shape")
+            ):
+                logger.warning(
+                    "snapshot wave inconsistent (peer %d frontier %s != %s); "
+                    "falling back to journal replay",
+                    peer, psnap.get("frontier"), snap.get("frontier"),
+                )
+                return None
+            merged_seqs.update(psnap.get("journal_seqs", {}))
+    try:
+        by_ident = dict(_ops_by_identity(runner))
+        for ident, blob in snap["ops"].items():
+            op = by_ident.get(ident)
+            if op is None:
+                raise KeyError(f"operator {ident} missing from graph")
+            op.restore_state(pickle.loads(blob))
+    except Exception as exc:
+        logger.warning("operator snapshot restore failed (%s); ignoring", exc)
+        return None
+    frontier = snap["frontier"]
+    # restore the logical clock so new times stay beyond restored state
+    if hasattr(runner, "frontier"):
+        runner.frontier = max(runner.frontier, frontier)
+    else:
+        runner.lg.scheduler.frontier = max(
+            runner.lg.scheduler.frontier, frontier
+        )
+    # exactly-once sink output: drop entries the tail replay will re-emit
+    if getattr(runner, "pid", 0) == 0:
+        for w in runner.lg.writers:
+            if hasattr(w, "resume"):
+                try:
+                    w.resume(frontier)
+                except Exception as exc:
+                    logger.warning("sink resume trim failed: %s", exc)
+    return {
+        "frontier": frontier,
+        "offsets": snap.get("offsets", {}),
+        "journal_seqs": merged_seqs,
+    }

@@ -254,7 +254,7 @@ def test_persistence_no_rejournal_of_net_zero(tmp_path):
 
     replayed = []
     for rec in backend.read_all("s"):
-        evs, _off = pickle.loads(rec)
+        _seq, evs, _off = pickle.loads(rec)
         replayed.extend(evs)
     src2 = FakeSource(live)
     _wrap_source_with_persistence(src2, backend, "s", replayed, None)
