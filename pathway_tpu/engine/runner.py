@@ -270,6 +270,15 @@ def _make_operator(node: pg.OpNode, lg: LoweredGraph) -> Operator:
 
         return ops.OutputOperator(on_time, on_end=getattr(writer, "close", None), name="output")
 
+    if kind == "gradual_broadcast":
+        from .gradual_broadcast import GradualBroadcastOperator
+
+        _src, thr = tables
+        return GradualBroadcastOperator(
+            _compile(p["lower"]), _compile(p["value"]), _compile(p["upper"]),
+            _env_for(thr), name="gradual_broadcast",
+        )
+
     if kind in _EXTRA_LOWERINGS:
         return _EXTRA_LOWERINGS[kind](node, lg)
 

@@ -502,6 +502,35 @@ class Table:
             dtypes[ref.name] = dt.ANY
         return Table(node, self._colnames, dtypes, Universe())
 
+    def _gradual_broadcast(
+        self,
+        threshold_table: "Table",
+        lower_column,
+        value_column,
+        upper_column,
+    ) -> "Table":
+        """Attach `apx_value` to every row, refined incrementally as the
+        threshold table's (lower, value, upper) triplet tightens — rows
+        whose key is under the scaled threshold carry `upper`, the rest
+        `lower`; a triplet move updates only the flipped key band.
+        Reference: Table._gradual_broadcast (internals/table.py:638) over
+        operators/gradual_broadcast.rs."""
+        node = pg.new_node(
+            "gradual_broadcast",
+            [self, threshold_table],
+            lower=threshold_table._desugar(lower_column),
+            value=threshold_table._desugar(value_column),
+            upper=threshold_table._desugar(upper_column),
+        )
+        from . import dtype as _dt
+
+        return Table(
+            node,
+            self._colnames + ["apx_value"],
+            {**self._dtypes, "apx_value": _dt.ANY},
+            self._universe,
+        )
+
     def deduplicate(
         self,
         *,

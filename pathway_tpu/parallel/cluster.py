@@ -35,7 +35,7 @@ from ..engine import runner as runner_mod
 from ..engine.graph import Operator
 from ..engine.types import CapturedStream, Update
 from ..internals import parse_graph as pg
-from .sharded import ShardRouter, edge_router, _CENTRAL, _SHARD_BY_KEY
+from .sharded import ShardRouter, edge_router, _BROADCAST, _CENTRAL, _SHARD_BY_KEY
 from .comm import Fabric
 
 # node kinds whose output keys equal their input keys, so key-routed
@@ -185,6 +185,10 @@ class ClusterRunner:
                 router = routers.get((down_pos, port))
                 if router is None or router.kind == _CENTRAL:
                     self._deliver(time, down_pos, port, 0, updates)
+                    continue
+                if router.kind == _BROADCAST:
+                    for s2 in range(self.n_total):
+                        self._deliver(time, down_pos, port, s2, updates)
                     continue
                 per_shard: dict[int, list[Update]] = defaultdict(list)
                 for u in updates:

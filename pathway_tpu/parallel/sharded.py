@@ -32,6 +32,7 @@ from ..internals.value import ref_scalar
 
 _SHARD_BY_KEY = "key"
 _CENTRAL = "central"
+_BROADCAST = "broadcast"  # replicate to every shard (small side tables)
 
 
 def _route_all_shard0(update, n):
@@ -118,6 +119,13 @@ def edge_router(down_node: pg.OpNode, port: int, n: int) -> ShardRouter:
             return int(ref_scalar(*ivals)) if ivals else 0
 
         return ShardRouter("fn", n, fn)
+    if kind == "gradual_broadcast":
+        # big table stays key-partitioned; the tiny threshold table is
+        # replicated to every shard (reference: value_stream .broadcast(),
+        # operators/gradual_broadcast.rs:96)
+        return ShardRouter(
+            _SHARD_BY_KEY if port == 0 else _BROADCAST, n
+        )
     if kind in _SHARDABLE:
         return ShardRouter(_SHARD_BY_KEY, n)
     if kind in ("capture", "subscribe", "output", "raw_output"):

@@ -15,6 +15,7 @@ from .inner_index import (
 from .retrievers import (
     AbstractRetrieverFactory,
     BruteForceKnnFactory,
+    IvfKnnFactory,
     HybridIndexFactory,
     LshKnnFactory,
     TantivyBM25Factory,
@@ -35,7 +36,7 @@ def default_full_text_document_index(data_column, data_table, *, metadata_column
 __all__ = [
     "DataIndex", "InnerIndex", "BruteForceKnn", "USearchKnn", "LshKnn",
     "TantivyBM25", "HybridIndex", "AbstractRetrieverFactory",
-    "BruteForceKnnFactory", "UsearchKnnFactory", "LshKnnFactory",
+    "BruteForceKnnFactory", "IvfKnnFactory", "UsearchKnnFactory", "LshKnnFactory",
     "TantivyBM25Factory", "HybridIndexFactory",
     "default_vector_document_index", "default_full_text_document_index",
 ]

@@ -9,7 +9,9 @@ from typing import Any, Callable
 from ...internals.expression import MakeTupleExpression
 from ...internals.table import Table
 from .data_index import DataIndex
-from .inner_index import BruteForceKnn, HybridIndex, LshKnn, TantivyBM25, USearchKnn
+from .inner_index import (
+    BruteForceKnn, HybridIndex, IvfKnn, LshKnn, TantivyBM25, USearchKnn,
+)
 
 
 class AbstractRetrieverFactory:
@@ -23,15 +25,21 @@ class BruteForceKnnFactory(AbstractRetrieverFactory):
     reserved_space: int = 1024
     embedder: Callable | None = None
     metric: str = "cos"
+    mesh: Any = None  # jax Mesh: shard the matrix across devices
+    mesh_axis: str = "dp"
 
     _index_cls = BruteForceKnn
 
     def build_index(self, data_column, data_table, metadata_column=None) -> DataIndex:
         cls = type(self)._index_cls
         dim, space, metric = self.dimensions, self.reserved_space, self.metric
+        mesh, axis = self.mesh, self.mesh_axis
 
         def factory():
-            return cls(dim, reserved_space=space, metric=metric)
+            return cls(
+                dim, reserved_space=space, metric=metric, mesh=mesh,
+                mesh_axis=axis,
+            )
 
         return DataIndex(
             data_table,
@@ -47,6 +55,39 @@ class UsearchKnnFactory(BruteForceKnnFactory):
     """Parity with the reference's USearch HNSW factory; exact search here."""
 
     _index_cls = USearchKnn
+
+
+@dataclasses.dataclass
+class IvfKnnFactory(AbstractRetrieverFactory):
+    """Scale-tier ANN (inner_index.IvfKnn): coarse quantizer + gathered
+    exact rescoring — the 10M-vector tier the reference serves with USearch
+    HNSW, re-imagined as dense matmuls."""
+
+    dimensions: int | None = None
+    n_clusters: int = 256
+    nprobe: int = 16
+    metric: str = "cos"
+    train_min: int = 4096
+    reserved_space: int = 1024
+    embedder: Callable | None = None
+
+    def build_index(self, data_column, data_table, metadata_column=None) -> DataIndex:
+        dim = self.dimensions
+        kw = dict(
+            n_clusters=self.n_clusters, nprobe=self.nprobe, metric=self.metric,
+            train_min=self.train_min, reserved_space=self.reserved_space,
+        )
+
+        def factory():
+            return IvfKnn(dim, **kw)
+
+        return DataIndex(
+            data_table,
+            data_column,
+            index_factory=factory,
+            metadata_column=metadata_column,
+            embedder=self.embedder,
+        )
 
 
 @dataclasses.dataclass

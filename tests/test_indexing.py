@@ -156,3 +156,175 @@ def test_knn_index_with_metadata_filter():
     state = run_and_squash(res.select(t=res.text))
     [(t,)] = state.values()
     assert t == ("b",)
+
+
+def test_ivf_index_recall_and_mutation():
+    """IVF scale tier: recall@10 >= 0.95 vs brute force on clustered data;
+    add/remove stay incremental (reference parity: usearch_integration.rs)."""
+    import numpy as np
+
+    from pathway_tpu.stdlib.indexing.inner_index import BruteForceKnn, IvfKnn
+
+    rng = np.random.default_rng(0)
+    d, n_centers, n = 64, 32, 30_000
+    centers = rng.normal(size=(n_centers, d)).astype(np.float32) * 5
+    assign = rng.integers(0, n_centers, n)
+    data = centers[assign] + rng.normal(size=(n, d)).astype(np.float32)
+
+    bf = BruteForceKnn(d, reserved_space=n, device_threshold=10**9)
+    ivf = IvfKnn(d, n_clusters=64, nprobe=8, train_min=2048, reserved_space=n)
+    for i in range(n):
+        bf.add(i, data[i])
+        ivf.add(i, data[i])
+    assert ivf.centroids is not None  # trained
+
+    queries = centers[rng.integers(0, n_centers, 50)] + rng.normal(
+        size=(50, d)
+    ).astype(np.float32)
+    hits = total = 0
+    for q in queries:
+        truth = {k for k, _s in bf.search(q, 10)}
+        got = {k for k, _s in ivf.search(q, 10)}
+        hits += len(truth & got)
+        total += 10
+    recall = hits / total
+    assert recall >= 0.95, f"recall@10 = {recall}"
+
+    # incremental mutation: removals + re-adds keep results consistent
+    for i in range(0, 2000):
+        ivf.remove(i)
+    assert ivf.n == n - 2000
+    q = data[2500]
+    got = [k for k, _s in ivf.search(q, 5)]
+    assert 2500 in got
+    assert all(k >= 2000 for k in got)
+    ivf.add(1, data[1])  # re-add
+    assert ivf.n == n - 1999
+    got = [k for k, _s in ivf.search(data[1], 3)]
+    assert 1 in got
+
+
+def test_ivf_via_data_index():
+    import numpy as np
+
+    import pathway_tpu as pw
+    from pathway_tpu.debug import table_from_rows
+    from pathway_tpu.engine.runner import run_tables
+    from pathway_tpu.internals import parse_graph as pg
+    from pathway_tpu.stdlib.indexing import IvfKnnFactory
+
+    rng = np.random.default_rng(1)
+    vecs = rng.normal(size=(500, 16)).astype(np.float32)
+
+    class D(pw.Schema):
+        v: object
+
+    class Q(pw.Schema):
+        qv: object
+
+    pg.G.clear()
+    dt_ = table_from_rows(D, [(v,) for v in vecs])
+    idx = IvfKnnFactory(dimensions=16, train_min=100, n_clusters=8, nprobe=8).build_index(
+        dt_.v, dt_
+    )
+    qt = table_from_rows(Q, [(vecs[7],)])
+    reply = idx.query(qt.qv, number_of_matches=3)
+    [cap] = run_tables(reply)
+    rows = list(cap.squash().values())
+    assert len(rows) == 1
+    pg.G.clear()
+
+
+def test_sharded_knn_matches_single_device():
+    """Mesh-sharded brute force (shard_map matmul + top-k merge) must
+    return exactly the single-device results (8-device CPU mesh)."""
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from pathway_tpu.ops.knn_sharded import sharded_topk
+    from pathway_tpu.stdlib.indexing.inner_index import BruteForceKnn
+
+    n_dev = min(8, len(jax.devices()))
+    mesh = Mesh(np.asarray(jax.devices()[:n_dev]), ("dp",))
+    rng = np.random.default_rng(3)
+    M = rng.normal(size=(777, 24)).astype(np.float32)  # not divisible by 8
+    Q = rng.normal(size=(3, 24)).astype(np.float32)
+    vals, idx = sharded_topk(mesh, "dp", M, Q, 7, "cos")
+    mn = M / np.linalg.norm(M, axis=1, keepdims=True)
+    qn = Q / np.linalg.norm(Q, axis=1, keepdims=True)
+    scores = qn @ mn.T
+    for i in range(3):
+        ref = np.argsort(-scores[i])[:7]
+        np.testing.assert_array_equal(idx[i], ref)
+
+    # through the index seam
+    bf_mesh = BruteForceKnn(24, mesh=mesh, reserved_space=777)
+    bf = BruteForceKnn(24, reserved_space=777, device_threshold=10**9)
+    for i in range(777):
+        bf_mesh.add(i, M[i])
+        bf.add(i, M[i])
+    for q in Q:
+        assert [k for k, _ in bf_mesh.search(q, 5)] == [
+            k for k, _ in bf.search(q, 5)
+        ]
+
+
+def test_ivf_l2sq_metric():
+    """l2sq must rank by true negative squared distance, not raw dot."""
+    import numpy as np
+
+    from pathway_tpu.stdlib.indexing.inner_index import IvfKnn
+
+    rng = np.random.default_rng(5)
+    # a far-but-long vector must NOT beat a near-but-short one
+    data = rng.normal(size=(5000, 8)).astype(np.float32)
+    data[0] = [0.1] * 8          # close to query
+    data[1] = [100.0] * 8        # long, far
+    ivf = IvfKnn(8, metric="l2sq", n_clusters=16, nprobe=16, train_min=1000)
+    for i in range(len(data)):
+        ivf.add(i, data[i])
+    q = np.zeros(8, np.float32)
+    got = [k for k, _ in ivf.search(q, 1)]
+    truth = int(np.argmin(np.sum((data - q) ** 2, axis=1)))
+    assert got[0] == truth
+
+
+def test_ivf_metadata_filter_scans_past_candidates():
+    import numpy as np
+
+    from pathway_tpu.stdlib.indexing.inner_index import IvfKnn
+
+    rng = np.random.default_rng(6)
+    data = rng.normal(size=(6000, 16)).astype(np.float32)
+    ivf = IvfKnn(16, n_clusters=16, nprobe=16, train_min=1000)
+    for i in range(len(data)):
+        ivf.add(i, data[i], metadata={"grp": "rare" if i % 100 == 0 else "big"})
+    got = ivf.search(data[0], 10, metadata_filter="grp == 'rare'")
+    assert len(got) == 10  # selective filter still fills k
+
+
+def test_gradual_broadcast_same_key_replace():
+    """+new/-old for one key in a single batch must net to the new row
+    (review regression: duplicate sorted_keys corruption)."""
+    from pathway_tpu.engine.gradual_broadcast import GradualBroadcastOperator
+    from pathway_tpu.engine.operators import EnvBuilder
+
+    env1 = EnvBuilder({(9, "l"): 0, (9, "v"): 1, (9, "u"): 2})
+    op = GradualBroadcastOperator(
+        lambda e: e[(9, "l")], lambda e: e[(9, "v")], lambda e: e[(9, "u")],
+        env1,
+    )
+    emitted = []
+    op.emit = lambda t, u: emitted.extend(u)
+    op.process(1, [(1, (0.0, 5.0, 10.0), 1)], 0)
+    op.process(0, [(7, ("old",), 1)], 0)
+    op.flush(0)
+    op.process(0, [(7, ("new",), 1), (7, ("old",), -1)], 2)
+    op.flush(2)
+    net = {}
+    for k, r, d in emitted:
+        net[(k, r)] = net.get((k, r), 0) + d
+    live = {r for (k, r), m in net.items() if m}
+    assert len(live) == 1 and next(iter(live))[0] == "new"
+    assert len(op.sorted_keys) == 1  # no duplicates
