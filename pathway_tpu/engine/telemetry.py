@@ -184,3 +184,108 @@ class ErrorLog:
 
 
 global_error_log = ErrorLog()
+
+
+# ---------------------------------------------------------------------------
+# Tracing spans (reference: src/engine/telemetry.rs:296-601 OTLP export +
+# internals/graph_runner/telemetry.py run-scoped tracer)
+# ---------------------------------------------------------------------------
+
+class Span:
+    __slots__ = ("name", "start", "end", "attributes", "parent")
+
+    def __init__(self, name: str, parent: "Span | None" = None, **attributes):
+        self.name = name
+        self.parent = parent
+        self.attributes = attributes
+        self.start = time.time()
+        self.end: float | None = None
+
+    def finish(self) -> None:
+        self.end = time.time()
+
+    def as_dict(self) -> dict:
+        return {
+            "name": self.name,
+            "start": self.start,
+            "end": self.end,
+            "duration_ms": round(((self.end or time.time()) - self.start) * 1e3, 3),
+            "parent": self.parent.name if self.parent else None,
+            "attributes": self.attributes,
+        }
+
+
+class Tracer:
+    """Run-scoped tracer: spans collect in-process and export (1) to an
+    OpenTelemetry SDK when one is importable, (2) as JSON lines to
+    PATHWAY_TRACE_FILE, (3) always to `tracer.spans` for tests/tools."""
+
+    def __init__(self):
+        self.spans: list[Span] = []
+        self.last_spans: list[Span] = []  # drained on export (inspection)
+        self._stack: list[Span] = []
+        self._otel = None
+        try:  # optional bridge
+            from opentelemetry import trace as _ot
+
+            self._otel = _ot.get_tracer("pathway_tpu")
+        except Exception:
+            self._otel = None
+
+    def span(self, name: str, **attributes) -> "_SpanCtx":
+        return _SpanCtx(self, name, attributes)
+
+    def export(self) -> None:
+        """Drain accumulated spans: write to PATHWAY_TRACE_FILE (if set) and
+        move them to `last_spans`, so repeated pw.run() calls in one process
+        neither re-export nor grow memory without bound."""
+        import json as _json
+        import os as _os
+
+        spans, self.spans = self.spans, []
+        self.last_spans = spans
+        path = _os.environ.get("PATHWAY_TRACE_FILE")
+        if not path:
+            return
+        try:
+            with open(path, "a", encoding="utf-8") as f:
+                for s in spans:
+                    f.write(_json.dumps(s.as_dict()) + "\n")
+        except Exception:
+            pass
+
+
+class _SpanCtx:
+    def __init__(self, tracer: Tracer, name: str, attributes: dict):
+        self.tracer = tracer
+        self.name = name
+        self.attributes = attributes
+        self.span: Span | None = None
+        self._otel_cm = None
+
+    def __enter__(self) -> Span:
+        parent = self.tracer._stack[-1] if self.tracer._stack else None
+        self.span = Span(self.name, parent, **self.attributes)
+        self.tracer._stack.append(self.span)
+        self.tracer.spans.append(self.span)
+        if self.tracer._otel is not None:
+            try:
+                self._otel_cm = self.tracer._otel.start_as_current_span(self.name)
+                self._otel_cm.__enter__()
+            except Exception:
+                self._otel_cm = None
+        return self.span
+
+    def __exit__(self, *exc) -> None:
+        assert self.span is not None
+        self.span.finish()
+        if self.tracer._stack and self.tracer._stack[-1] is self.span:
+            self.tracer._stack.pop()
+        if self._otel_cm is not None:
+            try:
+                self._otel_cm.__exit__(*exc)
+            except Exception:
+                pass
+
+
+global_tracer = Tracer()

@@ -19,6 +19,18 @@ def _m(name, fn, *args, dtype=dt.ANY):
 _EPOCH_NAIVE = datetime.datetime(1970, 1, 1)
 _EPOCH_UTC = datetime.datetime(1970, 1, 1, tzinfo=datetime.timezone.utc)
 
+# reference unit spellings (date_time.py:1119-1140)
+_DURATION_UNITS = {
+    "W": 7 * 86400.0,
+    **{u: 86400.0 for u in ("D", "day", "days")},
+    **{u: 3600.0 for u in ("h", "hr", "hour", "hours")},
+    **{u: 60.0 for u in ("m", "min", "minute", "minutes")},
+    **{u: 1.0 for u in ("s", "sec", "second", "seconds")},
+    **{u: 1e-3 for u in ("ms", "millisecond", "milliseconds", "millis", "milli")},
+    **{u: 1e-6 for u in ("us", "microsecond", "microseconds", "micros", "micro")},
+    **{u: 1e-9 for u in ("ns", "nano", "nanos", "nanosecond", "nanoseconds")},
+}
+
 
 def _epoch_for(v: datetime.datetime) -> datetime.datetime:
     return _EPOCH_UTC if v.tzinfo is not None else _EPOCH_NAIVE
@@ -131,6 +143,47 @@ class DateTimeNamespace:
 
         return _m("dt.from_timestamp", fn, self._e,
                   dtype=dt.DATE_TIME_UTC if tz is not None else dt.DATE_TIME_NAIVE)
+
+    def weeks(self):
+        return _m(
+            "dt.weeks", lambda v: int(v.total_seconds() // (7 * 86400)),
+            self._e, dtype=dt.INT,
+        )
+
+    def to_duration(self, unit="s"):
+        """Integer -> Duration (reference: date_time.py:1119)."""
+        def fn(v, u):
+            mult = _DURATION_UNITS.get(u)
+            if mult is None:
+                raise ValueError(f"unknown duration unit {u!r}")
+            return datetime.timedelta(seconds=v * mult)
+
+        return _m("dt.to_duration", fn, self._e, wrap(unit), dtype=dt.DURATION)
+
+    def utc_from_timestamp(self, unit: str = "s"):
+        """int/float timestamp -> DateTimeUtc (reference: date_time.py:1563)."""
+        mult = {"ns": 1e-9, "us": 1e-6, "ms": 1e-3, "s": 1.0}[unit]
+
+        def fn(v):
+            return datetime.datetime.fromtimestamp(v * mult, datetime.timezone.utc)
+
+        return _m("dt.utc_from_timestamp", fn, self._e, dtype=dt.DATE_TIME_UTC)
+
+    # timezone-aware arithmetic (reference: date_time.py:840-980 — composed
+    # exactly as the reference composes them, so DST transitions match)
+    def add_duration_in_timezone(self, duration, timezone):
+        return (self.to_utc(timezone) + wrap(duration)).dt.to_naive_in_timezone(
+            timezone
+        )
+
+    def subtract_duration_in_timezone(self, duration, timezone):
+        return (self.to_utc(timezone) - wrap(duration)).dt.to_naive_in_timezone(
+            timezone
+        )
+
+    def subtract_date_time_in_timezone(self, date_time, timezone):
+        other = wrap(date_time)
+        return self.to_utc(timezone) - other.dt.to_utc(timezone)
 
     def round(self, duration):
         def fn(v, d):

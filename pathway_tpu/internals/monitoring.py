@@ -1,13 +1,20 @@
-"""Monitoring levels + console stats (reference: internals/monitoring.py).
+"""Monitoring levels + live console dashboard (reference:
+internals/monitoring.py:56-249 — a rich-TUI table of per-connector message
+counts, latency and logs).
 
-The rich-TUI dashboard equivalent lives in utils/console; here we keep the
-public enum and a lightweight stats snapshotter fed by engine operator
-counters (engine/graph.py Operator.rows_in/rows_out).
+The dashboard here renders with raw ANSI (the rich library is not in this
+image): a background thread redraws a table of connectors and operators —
+rows in/out, rates since the previous frame, and commit-frontier lag — once
+a second while the run loop executes.  On a non-tty it degrades to periodic
+plain-text summaries (ProgressReporter behavior).
 """
 
 from __future__ import annotations
 
 import enum
+import sys
+import threading
+import time
 
 
 class MonitoringLevel(enum.Enum):
@@ -33,3 +40,101 @@ class StatsMonitor:
             "frontier": self.scheduler.frontier,
             "operators": ops,
         }
+
+
+_CLEAR = "\x1b[2J\x1b[H"
+_BOLD = "\x1b[1m"
+_DIM = "\x1b[2m"
+_RESET = "\x1b[0m"
+
+
+class MonitoringDashboard:
+    """Live terminal dashboard fed by engine operator counters."""
+
+    def __init__(self, scheduler, level: MonitoringLevel,
+                 interval_s: float = 1.0, file=None):
+        self.scheduler = scheduler
+        self.level = level
+        self.interval_s = interval_s
+        self.file = file or sys.stderr
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._prev: dict[int, tuple[int, int]] = {}
+        self._prev_t = time.monotonic()
+        self._started = time.monotonic()
+        self._last_frontier = -1
+        self._frontier_at = time.monotonic()
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name="pw-dashboard"
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+        # leave a final plain summary behind
+        try:
+            self.file.write(self._render(final=True) + "\n")
+            self.file.flush()
+        except Exception:
+            pass
+
+    def _loop(self) -> None:
+        tty = getattr(self.file, "isatty", lambda: False)()
+        while not self._stop.wait(self.interval_s):
+            try:
+                frame = self._render()
+                if tty:
+                    self.file.write(_CLEAR + frame + "\n")
+                else:
+                    self.file.write(frame + "\n")
+                self.file.flush()
+            except Exception:
+                return
+
+    def _rows(self):
+        now = time.monotonic()
+        dt_s = max(now - self._prev_t, 1e-9)
+        out = []
+        ops = self.scheduler.operators
+        if self.level != MonitoringLevel.ALL:
+            ops = [
+                op for op in ops
+                if not op.downstream or not op.inputs  # sources + sinks
+            ]
+        for op in ops:
+            pin, pout = self._prev.get(op.id, (0, 0))
+            rate_in = (op.rows_in - pin) / dt_s
+            rate_out = (op.rows_out - pout) / dt_s
+            out.append((
+                f"{op.name}#{op.id}", op.rows_in, op.rows_out,
+                rate_in, rate_out,
+            ))
+            self._prev[op.id] = (op.rows_in, op.rows_out)
+        self._prev_t = now
+        return out
+
+    def _render(self, final: bool = False) -> str:
+        frontier = self.scheduler.frontier
+        now = time.monotonic()
+        if frontier != self._last_frontier:
+            self._last_frontier = frontier
+            self._frontier_at = now
+        lag = now - self._frontier_at
+        lines = [
+            f"{_BOLD}pathway-tpu{_RESET}  "
+            f"uptime {now - self._started:6.1f}s   "
+            f"frontier {frontier}   commit lag {lag * 1000:6.0f}ms",
+            f"{_DIM}{'operator':<28}{'rows in':>12}{'rows out':>12}"
+            f"{'in/s':>10}{'out/s':>10}{_RESET}",
+        ]
+        for name, rin, rout, rate_in, rate_out in self._rows():
+            lines.append(
+                f"{name:<28}{rin:>12}{rout:>12}{rate_in:>10.0f}{rate_out:>10.0f}"
+            )
+        if final:
+            lines.append(f"{_DIM}(run finished){_RESET}")
+        return "\n".join(lines)

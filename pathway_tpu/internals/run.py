@@ -41,57 +41,83 @@ def run(
     n_procs = max(1, pathway_config.processes)
     streaming = has_live_sources(sinks)
 
-    # exactly one runner is built and instrumented
-    if n_shards > 1 or n_procs > 1:
-        from ..parallel.cluster import ClusterRunner
+    from ..engine.telemetry import global_tracer
 
-        runner: Any = ClusterRunner(
-            sinks,
-            n_local_shards=n_shards,
-            pid=pathway_config.process_id,
-            nprocs=n_procs,
-            first_port=pathway_config.first_port,
-        )
-        if terminate_on_error:
-            from ..engine import operators as _o
+    _build_span = global_tracer.span("pathway.graph_build", sinks=len(sinks))
+    _build_span.__enter__()
+    try:
+        # exactly one runner is built and instrumented
+        if n_shards > 1 or n_procs > 1:
+            from ..parallel.cluster import ClusterRunner
 
-            for lg in runner.graphs.values():
-                for op in lg.scheduler.operators:
-                    if isinstance(op, _o.OutputOperator):
-                        op.terminate_on_error = True
-        scheduler = runner.lg.scheduler  # first-owned-shard replicas carry counters
-    else:
-        runner = GraphRunner(sinks, terminate_on_error=terminate_on_error)
-        scheduler = runner.lg.scheduler
+            runner: Any = ClusterRunner(
+                sinks,
+                n_local_shards=n_shards,
+                pid=pathway_config.process_id,
+                nprocs=n_procs,
+                first_port=pathway_config.first_port,
+            )
+            if terminate_on_error:
+                from ..engine import operators as _o
 
-    if persistence_config is not None:
-        from ..persistence import attach_persistence
+                for lg in runner.graphs.values():
+                    for op in lg.scheduler.operators:
+                        if isinstance(op, _o.OutputOperator):
+                            op.terminate_on_error = True
+            scheduler = runner.lg.scheduler  # first-owned-shard counters
+        else:
+            runner = GraphRunner(sinks, terminate_on_error=terminate_on_error)
+            scheduler = runner.lg.scheduler
 
-        attach_persistence(runner, persistence_config)
+        if persistence_config is not None:
+            from ..persistence import attach_persistence
 
-    metrics = reporter = None
+            attach_persistence(runner, persistence_config)
+    finally:
+        _build_span.__exit__(None, None, None)
+
+    metrics = reporter = dashboard = None
     if with_http_server:
         from ..engine.telemetry import MetricsServer
 
         metrics = MetricsServer(scheduler)
         metrics.start()
-    from ..internals.monitoring import MonitoringLevel
+    from ..internals.monitoring import MonitoringDashboard, MonitoringLevel
 
     if monitoring_level not in (None, MonitoringLevel.NONE):
-        from ..engine.telemetry import ProgressReporter
+        import sys as _sys
 
-        reporter = ProgressReporter(scheduler)
-        reporter.start()
-    try:
-        if streaming:
-            runner.run_streaming(
-                autocommit_ms=autocommit_duration_ms,
-                timeout_s=timeout_s,
-                idle_stop_s=idle_stop_s,
+        if streaming and _sys.stderr.isatty():
+            # live TUI for interactive streaming runs (reference:
+            # internals/monitoring.py:56-249)
+            dashboard = MonitoringDashboard(
+                scheduler,
+                monitoring_level
+                if isinstance(monitoring_level, MonitoringLevel)
+                else MonitoringLevel.IN_OUT,
             )
+            dashboard.start()
         else:
-            runner.run_batch()
+            from ..engine.telemetry import ProgressReporter
+
+            reporter = ProgressReporter(scheduler)
+            reporter.start()
+    try:
+        with global_tracer.span(
+            "pathway.run", streaming=streaming, shards=n_shards, procs=n_procs
+        ):
+            if streaming:
+                runner.run_streaming(
+                    autocommit_ms=autocommit_duration_ms,
+                    timeout_s=timeout_s,
+                    idle_stop_s=idle_stop_s,
+                )
+            else:
+                runner.run_batch()
     finally:
+        global_tracer.export()
+        if dashboard is not None:
+            dashboard.stop()
         if reporter is not None:
             reporter.stop()
         if metrics is not None:

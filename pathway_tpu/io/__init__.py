@@ -13,7 +13,7 @@ import sys
 import types
 from typing import Any
 
-from . import csv, fs, jsonlines, kafka, python, sqlite
+from . import csv, fs, jsonlines, kafka, postgres, python, s3, sqlite
 from ._subscribe import subscribe
 from ._synchronization import register_input_synchronization_group
 
@@ -45,12 +45,10 @@ def _make_stub(name: str, needs: str) -> types.ModuleType:
 
 
 # long-tail connectors behind the same seam (reference: src/connectors/data_storage/)
-s3 = _make_stub("s3", "boto3")
 s3_csv = _make_stub("s3_csv", "boto3")
 minio = _make_stub("minio", "boto3")
 gdrive = _make_stub("gdrive", "google-api-python-client")
 sharepoint = _make_stub("sharepoint", "Office365-REST client")
-postgres = _make_stub("postgres", "psycopg")
 mysql = _make_stub("mysql", "pymysql")
 mongodb = _make_stub("mongodb", "pymongo")
 elasticsearch = _make_stub("elasticsearch", "elasticsearch client")

@@ -24,8 +24,8 @@ class Backend:
         return FilesystemBackend(path)
 
     @classmethod
-    def s3(cls, root_path: str, bucket_settings: Any = None) -> "Backend":
-        raise NotImplementedError("s3 persistence backend requires boto3 wiring")
+    def s3(cls, root_path: str, bucket_settings: Any = None) -> "S3Backend":
+        return S3Backend(root_path, bucket_settings)
 
     @classmethod
     def azure(cls, root_path: str, account_settings: Any = None) -> "Backend":
@@ -116,6 +116,109 @@ class FilesystemBackend(Backend):
             return None
         with open(p, "rb") as f:
             return f.read()
+
+
+class S3Backend(Backend):
+    """Journal/metadata over an object store (reference:
+    src/persistence/backends/s3.rs).  Objects have no append, so each
+    journal record is its own object `{stream}/{seq:012d}`; `replace_all`
+    rewrites the stream's prefix.  The client comes from AwsS3Settings'
+    seam (boto3 or an injected fake)."""
+
+    def __init__(self, root_path: str, bucket_settings: Any = None):
+        from ..io.s3 import AwsS3Settings, resolve_path
+
+        self.settings = bucket_settings or AwsS3Settings()
+        self.bucket, prefix = resolve_path(root_path, self.settings)
+        self.prefix = prefix.rstrip("/")
+        self._client = None
+        self._next_seq: dict[str, int] = {}
+
+    def _c(self):
+        if self._client is None:
+            self._client = self.settings.make_client()
+        return self._client
+
+    def _skey(self, stream: str) -> str:
+        safe = stream.replace("/", "_")
+        return f"{self.prefix}/streams/{safe}"
+
+    def _list(self, key_prefix: str) -> list[str]:
+        from ..io.s3 import list_keys_paginated
+
+        return list_keys_paginated(self._c(), self.bucket, key_prefix)
+
+    def append(self, stream: str, record: bytes) -> None:
+        base = self._skey(stream)
+        seq = self._next_seq.get(stream)
+        if seq is None:
+            existing = self._list(base + "/")
+            seq = (
+                int(existing[-1].rsplit("/", 1)[1]) + 1 if existing else 0
+            )
+        self._next_seq[stream] = seq + 1
+        self._c().put_object(
+            Bucket=self.bucket, Key=f"{base}/{seq:012d}", Body=record
+        )
+
+    def read_all(self, stream: str) -> list[bytes]:
+        base = self._skey(stream)
+        out = []
+        for key in self._list(base + "/"):
+            resp = self._c().get_object(Bucket=self.bucket, Key=key)
+            out.append(resp["Body"].read())
+        return out
+
+    def replace_all(self, stream: str, records: list[bytes]) -> None:
+        base = self._skey(stream)
+        for key in self._list(base + "/"):
+            self._c().delete_object(Bucket=self.bucket, Key=key)
+        self._next_seq[stream] = len(records)
+        for i, rec in enumerate(records):
+            self._c().put_object(
+                Bucket=self.bucket, Key=f"{base}/{i:012d}", Body=rec
+            )
+
+    def list_streams(self, prefix: str) -> list[str]:
+        base = f"{self.prefix}/streams/"
+        safe = prefix.replace("/", "_")
+        names = set()
+        for key in self._list(base + safe):
+            rest = key[len(base):]
+            names.add(rest.rsplit("/", 1)[0])
+        return sorted(names)
+
+    def put_metadata(self, key: str, value: bytes) -> None:
+        self._c().put_object(
+            Bucket=self.bucket, Key=f"{self.prefix}/meta/{key}", Body=value
+        )
+
+    def get_metadata(self, key: str) -> bytes | None:
+        try:
+            resp = self._c().get_object(
+                Bucket=self.bucket, Key=f"{self.prefix}/meta/{key}"
+            )
+            return resp["Body"].read()
+        except Exception as exc:
+            if _is_missing_key_error(exc):
+                return None
+            # transient errors must NOT read as "no metadata" — the
+            # journal-format heuristic would mistake an existing journal
+            # for v1 and destroy it
+            raise
+
+
+def _is_missing_key_error(exc: Exception) -> bool:
+    if isinstance(exc, KeyError):
+        return True  # in-process fakes raise KeyError for absent objects
+    code = ""
+    resp = getattr(exc, "response", None)
+    if isinstance(resp, dict):
+        code = str(resp.get("Error", {}).get("Code", ""))
+    name = type(exc).__name__
+    return code in ("NoSuchKey", "404", "NotFound") or name in (
+        "NoSuchKey", "NotFound",
+    )
 
 
 class MockBackend(Backend):

@@ -257,23 +257,34 @@ def _apply_behavior(base: Table, behavior: Behavior | None) -> Table:
         return base
     from .temporal_behavior import CommonBehavior, ExactlyOnceBehavior
 
+    # ORDER MATTERS: freeze (cutoff) must see the RAW stream so its
+    # event-time frontier advances on every arriving row — a freeze placed
+    # after the buffer only observes buffer survivors and misses the clock
+    # rows still sitting in the buffer, letting late rows through
+    # (reference fuses both in one operator, time_column.rs:38-50)
     if isinstance(behavior, ExactlyOnceBehavior):
         shift = behavior.shift
-        thr = base._pw_window_end + shift if shift is not None else base._pw_window_end
-        out = base._buffer(thr, base._pw_t)
-        out = out._freeze(thr, out._pw_t)
+
+        def thr_of(tbl):
+            return (
+                tbl._pw_window_end + shift if shift is not None
+                else tbl._pw_window_end
+            )
+
+        out = base._freeze(thr_of(base), base._pw_t)
+        out = out._buffer(thr_of(out), out._pw_t)
         return out
     if isinstance(behavior, CommonBehavior):
         out = base
-        if behavior.delay is not None:
-            out = out._buffer(out._pw_window_start + behavior.delay, out._pw_t)
         if behavior.cutoff is not None:
             out = out._freeze(out._pw_window_end + behavior.cutoff, out._pw_t)
-            if not behavior.keep_results:
-                out = out._forget(
-                    out._pw_window_end + behavior.cutoff, out._pw_t,
-                    mark_forgetting_records=False,
-                )
+        if behavior.delay is not None:
+            out = out._buffer(out._pw_window_start + behavior.delay, out._pw_t)
+        if behavior.cutoff is not None and not behavior.keep_results:
+            out = out._forget(
+                out._pw_window_end + behavior.cutoff, out._pw_t,
+                mark_forgetting_records=False,
+            )
         return out
     return base
 

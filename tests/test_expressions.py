@@ -6,7 +6,9 @@ import datetime
 import pytest
 
 import pathway_tpu as pw
-from pathway_tpu.debug import table_from_markdown, table_from_pandas, table_to_pandas
+from pathway_tpu.debug import (
+    table_from_markdown, table_from_pandas, table_from_rows, table_to_pandas,
+)
 
 from .utils import run_and_squash
 
@@ -202,3 +204,70 @@ def test_concat_same_columns_different_order():
     out = t1.concat_reindex(t2)
     vals = sorted(run_and_squash(out).values())
     assert vals == [(1, "x"), (2, "y")]
+
+
+def test_dt_timezone_arithmetic():
+    """DST-aware add/subtract (reference: date_time.py:840-980 examples)."""
+    import datetime
+
+    rows = [
+        (datetime.datetime(2023, 3, 26, 1, 23),),   # before EU DST jump
+        (datetime.datetime(2023, 10, 29, 1, 23),),  # before fall-back
+    ]
+
+    class S(pw.Schema):
+        d: object
+
+    t = table_from_rows(S, rows)
+    out = t.select(
+        plus=t.d.dt.add_duration_in_timezone(
+            datetime.timedelta(hours=2), "Europe/Warsaw"
+        ),
+        minus=t.d.dt.subtract_duration_in_timezone(
+            datetime.timedelta(hours=1), "Europe/Warsaw"
+        ),
+    )
+    res = sorted(run_and_squash(out).values())
+    # 2023-03-26 01:23 + 2h crosses the spring-forward gap -> 04:23
+    assert res[0][0] == datetime.datetime(2023, 3, 26, 4, 23)
+    assert res[0][1] == datetime.datetime(2023, 3, 26, 0, 23)
+    # fall-back day: clock repeats 02:xx, +2h lands on 02:23
+    assert res[1][0] == datetime.datetime(2023, 10, 29, 2, 23)
+
+
+def test_dt_to_duration_weeks_utc_from_timestamp():
+    import datetime
+
+    class S(pw.Schema):
+        n: int
+
+    t = table_from_rows(S, [(14,)])
+    out = t.select(
+        dur=t.n.dt.to_duration("D"),
+        w=t.n.dt.to_duration("D").dt.weeks(),
+        utc=t.n.dt.utc_from_timestamp("s"),
+    )
+    [(dur, w, utc)] = run_and_squash(out).values()
+    assert dur == datetime.timedelta(days=14)
+    assert w == 2
+    assert utc == datetime.datetime(1970, 1, 1, 0, 0, 14,
+                                    tzinfo=datetime.timezone.utc)
+
+
+def test_dt_subtract_date_time_in_timezone():
+    import datetime
+
+    class S(pw.Schema):
+        a: object
+        b: object
+
+    t = table_from_rows(
+        S, [(datetime.datetime(2023, 3, 26, 4, 0),
+             datetime.datetime(2023, 3, 26, 1, 0))]
+    )
+    out = t.select(
+        diff=t.a.dt.subtract_date_time_in_timezone(t.b, "Europe/Warsaw")
+    )
+    [(diff,)] = run_and_squash(out).values()
+    # wall-clock difference is 3h but the DST gap removes one hour
+    assert diff == datetime.timedelta(hours=2)

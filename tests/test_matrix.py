@@ -1,0 +1,31 @@
+"""PATHWAY_THREADS test matrix: a representative core subset re-runs under
+the 4-shard data plane inside the default CI leg (reference pattern:
+suites re-run with PATHWAY_THREADS set, tests/utils.py:44,111 + CI)."""
+
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+
+_SUBSET = [
+    "tests/test_common.py",
+    "tests/test_joins.py",
+    "tests/test_expressions.py",
+    "tests/test_gradual_broadcast.py",
+]
+
+
+def test_core_suites_under_threads_4():
+    env = dict(os.environ)
+    env["PATHWAY_THREADS"] = "4"
+    env["PYTHONPATH"] = str(REPO)
+    res = subprocess.run(
+        [sys.executable, "-m", "pytest", "-q", "-p", "no:cacheprovider",
+         *_SUBSET],
+        env=env, cwd=REPO, capture_output=True, text=True, timeout=600,
+    )
+    assert res.returncode == 0, (
+        f"PATHWAY_THREADS=4 leg failed:\n{res.stdout[-4000:]}\n{res.stderr[-2000:]}"
+    )

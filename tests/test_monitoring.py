@@ -1,0 +1,100 @@
+"""Monitoring dashboard + tracing spans (reference:
+internals/monitoring.py:56-249, src/engine/telemetry.rs:296-601)."""
+
+import io
+import json
+import time
+
+import pathway_tpu as pw
+from pathway_tpu.internals import parse_graph as pg
+
+
+def test_dashboard_renders_operator_table():
+    from pathway_tpu.debug import table_from_rows
+    from pathway_tpu.engine.runner import GraphRunner
+    from pathway_tpu.internals.monitoring import (
+        MonitoringDashboard, MonitoringLevel,
+    )
+
+    class S(pw.Schema):
+        w: str
+
+    pg.G.clear()
+    t = table_from_rows(S, [("a",), ("b",), ("a",)])
+    out = t.groupby(t.w).reduce(t.w, c=pw.reducers.count())
+    runner = GraphRunner([out._materialize_capture()])
+    buf = io.StringIO()
+    dash = MonitoringDashboard(
+        runner.lg.scheduler, MonitoringLevel.ALL, interval_s=0.05, file=buf
+    )
+    dash.start()
+    runner.run_batch()
+    time.sleep(0.15)
+    dash.stop()
+    text = buf.getvalue()
+    assert "pathway-tpu" in text
+    assert "frontier" in text
+    assert "groupby" in text  # per-operator row present at level ALL
+    assert "rows in" in text
+    pg.G.clear()
+
+
+def test_dashboard_in_out_only_shows_endpoints():
+    from pathway_tpu.debug import table_from_rows
+    from pathway_tpu.engine.runner import GraphRunner
+    from pathway_tpu.internals.monitoring import (
+        MonitoringDashboard, MonitoringLevel,
+    )
+
+    class S(pw.Schema):
+        w: str
+
+    pg.G.clear()
+    t = table_from_rows(S, [("a",)])
+    out = t.groupby(t.w).reduce(t.w, c=pw.reducers.count())
+    runner = GraphRunner([out._materialize_capture()])
+    runner.run_batch()
+    buf = io.StringIO()
+    dash = MonitoringDashboard(
+        runner.lg.scheduler, MonitoringLevel.IN_OUT, interval_s=10, file=buf
+    )
+    frame = dash._render()
+    assert "input" in frame
+    assert "groupby" not in frame  # interior ops hidden at IN_OUT
+    pg.G.clear()
+
+
+def test_tracer_spans_and_file_export(tmp_path, monkeypatch):
+    from pathway_tpu.debug import table_from_rows
+    from pathway_tpu.engine import telemetry
+
+    trace_file = tmp_path / "trace.jsonl"
+    monkeypatch.setenv("PATHWAY_TRACE_FILE", str(trace_file))
+    # fresh tracer for the test
+    monkeypatch.setattr(telemetry, "global_tracer", telemetry.Tracer())
+    import pathway_tpu.internals.run  # noqa: F401 - run() re-imports it
+
+    class S(pw.Schema):
+        w: str
+
+    pg.G.clear()
+    t = table_from_rows(S, [("a",), ("b",)])
+    out = t.groupby(t.w).reduce(t.w, c=pw.reducers.count())
+    got = {}
+    pw.io.subscribe(
+        out, on_change=lambda key, row, time, is_addition: got.update({row["w"]: row["c"]})
+    )
+    pw.run(monitoring_level=pw.MonitoringLevel.NONE)
+    assert got == {"a": 1, "b": 1}
+    # export() drains spans into last_spans (repeat runs must not re-export)
+    assert telemetry.global_tracer.spans == []
+    spans = {s.name: s for s in telemetry.global_tracer.last_spans}
+    assert "pathway.graph_build" in spans
+    assert "pathway.run" in spans
+    assert spans["pathway.run"].end is not None
+    exported = [
+        json.loads(ln) for ln in trace_file.read_text().splitlines()
+    ]
+    names = {e["name"] for e in exported}
+    assert {"pathway.graph_build", "pathway.run"} <= names
+    pg.G.clear()

@@ -1,0 +1,190 @@
+"""Temporal-join/window late-data and behavior edge cases + update-stream
+assertions (reference model: python/pathway/tests/temporal/ late-data
+suites; VERDICT r1 item 9)."""
+
+import datetime
+
+import pathway_tpu as pw
+from pathway_tpu.debug import table_from_markdown, table_from_rows
+
+from .utils import (
+    DiffEntry,
+    assert_key_entries_in_stream_consistent,
+    assert_stream_equal,
+    captured_entries,
+    captured_stream,
+    run_and_squash,
+)
+
+
+def test_tumbling_window_late_row_updates_closed_window():
+    """Without a behavior, a late row re-opens its window (full consistency)."""
+    t = table_from_markdown(
+        """
+        | t  | v | __time__
+        | 1  | 1 | 0
+        | 3  | 1 | 0
+        | 12 | 1 | 2
+        | 2  | 1 | 4
+        """
+    )
+    out = t.windowby(
+        t.t, window=pw.temporal.tumbling(duration=10)
+    ).reduce(
+        start=pw.this._pw_window_start, c=pw.reducers.count()
+    )
+    entries = captured_entries(out)
+    # the late (t=2) row must retract (0,2) and re-emit (0,3)
+    assert ({"start": 0, "c": 2}, 4, -1) in entries
+    assert ({"start": 0, "c": 3}, 4, 1) in entries
+    final = {r[0]: r[1] for r in run_and_squash(out).values()}
+    assert final == {0: 3, 10: 1}
+
+
+def test_tumbling_window_exactly_once_behavior_drops_late():
+    """exactly_once_behavior: each window emits once when it closes; later
+    (late) rows are ignored (reference: temporal_behavior.py:21-101)."""
+    t = table_from_markdown(
+        """
+        | t  | v | __time__
+        | 1  | 1 | 0
+        | 3  | 1 | 0
+        | 22 | 1 | 2
+        | 2  | 1 | 4
+        """
+    )
+    out = t.windowby(
+        t.t, window=pw.temporal.tumbling(duration=10),
+        behavior=pw.temporal.exactly_once_behavior(),
+    ).reduce(start=pw.this._pw_window_start, c=pw.reducers.count())
+    entries = captured_entries(out)
+    emitted = [(r["start"], r["c"], d) for r, _t, d in entries]
+    # window [0,10) closes when the frontier passes 10 (via t=22): count=2,
+    # emitted exactly once; the late t=2 row never updates it
+    assert (0, 2, 1) in emitted
+    assert (0, 3, 1) not in emitted
+    assert all(d > 0 for _s, _c, d in emitted)  # no retractions, ever
+
+
+def test_interval_join_late_left_row():
+    left = table_from_markdown(
+        """
+        | t | a | __time__
+        | 1 | x | 0
+        | 9 | y | 4
+        """
+    )
+    right = table_from_markdown(
+        """
+        | t | b | __time__
+        | 2 | p | 0
+        | 8 | q | 2
+        """
+    )
+    j = left.interval_join(
+        right, left.t, right.t, pw.temporal.interval(-2, 2)
+    ).select(a=left.a, b=right.b)
+    rows = sorted(run_and_squash(j).values())
+    # late y@9 still joins q@8 (times 4 vs 2)
+    assert rows == [("x", "p"), ("y", "q")]
+    assert_key_entries_in_stream_consistent(j)
+
+
+def test_asof_join_with_updates_stream_consistent():
+    left = table_from_markdown(
+        """
+          | t | a | __time__ | __diff__
+        1 | 5 | x | 0        | 1
+        1 | 5 | x | 2        | -1
+        1 | 6 | x | 2        | 1
+        """
+    )
+    right = table_from_markdown(
+        """
+        | t | r | __time__
+        | 4 | A | 0
+        | 6 | B | 2
+        """
+    )
+    j = left.asof_join(
+        right, left.t, right.t, how=pw.JoinMode.LEFT
+    ).select(a=left.a, r=right.r)
+    assert_key_entries_in_stream_consistent(j)
+    rows = list(run_and_squash(j).values())
+    assert rows == [("x", "B")]  # moved to t=6: latest right <= 6 is B
+
+
+def test_session_window_merge_on_late_row():
+    """A late row bridging two sessions must merge them (retract both)."""
+    t = table_from_markdown(
+        """
+        | t  | __time__
+        | 1  | 0
+        | 10 | 0
+        | 5  | 2
+        """
+    )
+    out = t.windowby(
+        t.t, window=pw.temporal.session(max_gap=6)
+    ).reduce(c=pw.reducers.count())
+    entries = captured_entries(out)
+    finals = [r[0] for r in run_and_squash(out).values()]
+    assert finals == [3]  # one merged session
+    # at time 0 there were two separate sessions, later retracted
+    at0 = [(r["c"], d) for r, tm, d in entries if tm == 0]
+    assert (1, 1) in at0
+    retractions = [(r["c"], d) for r, tm, d in entries if tm == 2 and d < 0]
+    assert len(retractions) == 2
+
+
+def test_stream_equal_utility_wordcount():
+    """DiffEntry-style whole-stream assertion (reference tests/utils.py:183)."""
+    t = table_from_markdown(
+        """
+        | w | __time__
+        | a | 0
+        | a | 2
+        """
+    )
+    out = t.groupby(t.w).reduce(w=t.w, c=pw.reducers.count())
+    assert_stream_equal(out, [
+        DiffEntry({"w": "a", "c": 1}, 0, 1),
+        DiffEntry({"w": "a", "c": 1}, 2, -1),
+        DiffEntry({"w": "a", "c": 2}, 2, 1),
+    ])
+
+
+def test_deduplicate_ignores_upstream_retractions_documented():
+    """DOCUMENTED DIVERGENCE (VERDICT r1 weak #8): deduplicate consumes
+    append-only streams; upstream retractions of the accepted row are
+    ignored (the reference re-evaluates in some modes).  This test pins the
+    behavior so any change is deliberate."""
+    t = table_from_markdown(
+        """
+        | v | __time__ | __diff__
+        | 1 | 0        | 1
+        | 5 | 2        | 1
+        | 5 | 4        | -1
+        """
+    )
+    out = t.deduplicate(value=t.v, acceptor=lambda new, old: new > old)
+    rows = [r[0] for r in run_and_squash(out).values()]
+    # the retraction of 5 is ignored: 5 stays accepted (append-only contract)
+    assert rows == [5]
+
+
+def test_windowby_sliding_late_data_consistency():
+    t = table_from_markdown(
+        """
+        | t | __time__
+        | 0 | 0
+        | 4 | 0
+        | 2 | 4
+        """
+    )
+    out = t.windowby(
+        t.t, window=pw.temporal.sliding(hop=2, duration=4)
+    ).reduce(start=pw.this._pw_window_start, c=pw.reducers.count())
+    final = {r[0]: r[1] for r in run_and_squash(out).values()}
+    # windows: [-2,2):{0}, [0,4):{0,2}, [2,6):{4,2}, [4,8):{4}
+    assert final == {-2: 1, 0: 2, 2: 2, 4: 1}

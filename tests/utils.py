@@ -1,9 +1,28 @@
-"""Test helpers mirroring the reference's tests/utils.py:314-365."""
+"""Test helpers mirroring the reference's tests/utils.py:314-365.
+
+PATHWAY_THREADS matrix (reference pattern: tests run under multiple worker
+counts via env, python/pathway/tests/utils.py:44,111 + CI): when
+PATHWAY_THREADS > 1 is set, `run_tables` here routes every test's pipeline
+through the sharded ClusterRunner instead of the single-shard engine, so the
+whole suite doubles as a multi-worker consistency matrix —
+`PATHWAY_THREADS=4 pytest tests/` is the second CI leg (tests/test_matrix.py
+runs a representative subset that way inside the default leg)."""
 
 from __future__ import annotations
 
+import os
+
 import pathway_tpu as pw
-from pathway_tpu.engine.runner import run_tables
+from pathway_tpu.engine.runner import run_tables as _run_tables_single
+
+
+def run_tables(*tables):
+    n = int(os.environ.get("PATHWAY_THREADS", "1"))
+    if n > 1:
+        from pathway_tpu.parallel.cluster import run_tables_sharded
+
+        return run_tables_sharded(*tables, n_shards=n)
+    return _run_tables_single(*tables)
 
 
 def _normalize(state: dict, colnames: list[str]):
@@ -75,3 +94,66 @@ def run_and_squash(table: pw.Table) -> dict:
 def captured_stream(table: pw.Table):
     [cap] = run_tables(table)
     return cap.as_list()
+
+
+# ---------------------------------------------------------------------------
+# Update-stream assertions (reference: DiffEntry +
+# assert_key_entries_in_stream_consistent / assert_stream_equality,
+# python/pathway/tests/utils.py:183-310)
+# ---------------------------------------------------------------------------
+
+class DiffEntry:
+    """One expected update: row values (by column), logical time, diff."""
+
+    __slots__ = ("row", "time", "diff")
+
+    def __init__(self, row: dict, time: int, diff: int):
+        self.row = row
+        self.time = time
+        self.diff = diff
+
+    def __repr__(self):  # pragma: no cover - diagnostics
+        return f"DiffEntry({self.row}, t={self.time}, diff={self.diff})"
+
+
+def captured_entries(table: pw.Table):
+    """[(row_dict, time, diff)] in emission order."""
+    [cap] = run_tables(table)
+    cols = cap.column_names
+    out = []
+    from pathway_tpu.engine.types import unwrap_row
+
+    for e in cap.entries:
+        out.append((dict(zip(cols, unwrap_row(e.row))), e.time, e.diff))
+    return out
+
+
+def assert_stream_equal(table: pw.Table, expected: list[DiffEntry]) -> None:
+    """The captured update stream must contain exactly the expected
+    (row, time, diff) multiset — times included, so behaviors (buffers,
+    forgetting) are observable, not just final state."""
+    from collections import Counter
+
+    got = Counter(
+        (tuple(sorted(r.items())), t, d) for r, t, d in captured_entries(table)
+    )
+    want = Counter(
+        (tuple(sorted(e.row.items())), e.time, e.diff) for e in expected
+    )
+    assert got == want, (
+        f"\nunexpected: {sorted((got - want).items())}"
+        f"\nmissing:    {sorted((want - got).items())}"
+    )
+
+
+def assert_key_entries_in_stream_consistent(table: pw.Table) -> None:
+    """Every key's diffs must form a valid Z-set trajectory: multiplicity
+    never negative and 0/1 at every prefix (single-row keys)."""
+    [cap] = run_tables(table)
+    state: dict = {}
+    for e in sorted(cap.entries, key=lambda e: e.time):
+        cur = state.get(e.key, 0) + e.diff
+        assert cur in (0, 1), (
+            f"key {e.key} multiplicity {cur} at time {e.time}"
+        )
+        state[e.key] = cur
