@@ -82,6 +82,18 @@ class Operator:
         for a, v in st.items():
             setattr(self, a, v)
 
+    def state_size(self) -> int:
+        """Retained entries across this operator's durable state (arrangement
+        size telemetry; reference: ProberStats/operator probes)."""
+        total = 0
+        for a in self._STATE_ATTRS:
+            v = getattr(self, a, None)
+            try:
+                total += len(v)  # type: ignore[arg-type]
+            except TypeError:
+                pass
+        return total
+
 
 class Scheduler:
     def __init__(self) -> None:
@@ -263,6 +275,10 @@ class DiffOutputOperator(Operator):
         self.state: list[KeyedState] = [KeyedState() for _ in range(n_inputs)]
         self.last_out: dict[Key, Row] = {}
         self._dirty: set[Key] = set()
+
+    def state_size(self) -> int:
+        # count retained ROWS, not the number of state cells
+        return sum(len(st.data) for st in self.state) + len(self.last_out)
 
     def dirty_keys_for(self, port: int, key: Key) -> Iterable[Key]:
         return (key,)

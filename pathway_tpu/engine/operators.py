@@ -382,6 +382,13 @@ class JoinOperator(Operator):
 
     _STATE_ATTRS = ("left", "right", "left_total", "right_total")
 
+    def state_size(self) -> int:
+        # retained rows across both arrangements (inner dicts), not the
+        # number of distinct join keys
+        return sum(len(d) for d in self.left.values()) + sum(
+            len(d) for d in self.right.values()
+        )
+
     def __init__(
         self,
         left_env: EnvBuilder,

@@ -34,6 +34,14 @@ class MetricsServer:
             labels = f'operator="{op.name}",id="{op.id}"'
             lines.append(f"pathway_operator_rows_total{{{labels},direction=\"in\"}} {op.rows_in}")
             lines.append(f"pathway_operator_rows_total{{{labels},direction=\"out\"}} {op.rows_out}")
+        lines.append("# TYPE pathway_operator_state_entries gauge")
+        for op in self.scheduler.operators:
+            size = op.state_size()
+            if size:
+                labels = f'operator="{op.name}",id="{op.id}"'
+                lines.append(
+                    f"pathway_operator_state_entries{{{labels}}} {size}"
+                )
         return "\n".join(lines) + "\n"
 
     def render_dashboard(self) -> str:

@@ -111,7 +111,7 @@ class MonitoringDashboard:
             rate_out = (op.rows_out - pout) / dt_s
             out.append((
                 f"{op.name}#{op.id}", op.rows_in, op.rows_out,
-                rate_in, rate_out,
+                rate_in, rate_out, op.state_size(),
             ))
             self._prev[op.id] = (op.rows_in, op.rows_out)
         self._prev_t = now
@@ -129,11 +129,12 @@ class MonitoringDashboard:
             f"uptime {now - self._started:6.1f}s   "
             f"frontier {frontier}   commit lag {lag * 1000:6.0f}ms",
             f"{_DIM}{'operator':<28}{'rows in':>12}{'rows out':>12}"
-            f"{'in/s':>10}{'out/s':>10}{_RESET}",
+            f"{'in/s':>10}{'out/s':>10}{'state':>10}{_RESET}",
         ]
-        for name, rin, rout, rate_in, rate_out in self._rows():
+        for name, rin, rout, rate_in, rate_out, state in self._rows():
             lines.append(
-                f"{name:<28}{rin:>12}{rout:>12}{rate_in:>10.0f}{rate_out:>10.0f}"
+                f"{name:<28}{rin:>12}{rout:>12}{rate_in:>10.0f}"
+                f"{rate_out:>10.0f}{state:>10}"
             )
         if final:
             lines.append(f"{_DIM}(run finished){_RESET}")

@@ -27,18 +27,179 @@ _AGGS = {
 
 
 def sql(query: str, **tables: Table) -> Table:
+    """pw.sql — reference: internals/sql/processing.py (sqlglot transpiler).
+    Native mini-transpiler: SELECT/WHERE/GROUP BY/HAVING/JOIN, UNION
+    [ALL]/INTERSECT/EXCEPT, and subqueries in FROM."""
     q = query.strip().rstrip(";")
+    return _sql_query(q, dict(tables))
+
+
+def _restore_literals(txt: str, lits: list[str]) -> str:
+    def sub(m):
+        return "'" + lits[int(m.group(1))].replace("'", "''") + "'"
+
+    return re.sub(r"\s?__litstr_(\d+)__\s?", sub, txt)
+
+
+def _split_protected(q: str, word: str) -> list[str]:
+    """Split on a top-level keyword, never inside quotes or parens."""
+    protected, lits = _quote_split(q)
+    parts = _split_keyword(protected, word)
+    if len(parts) == 1:
+        return [q]
+    return [_restore_literals(p, lits).strip() for p in parts]
+
+
+def _content_keyed(t: Table) -> Table:
+    """Re-key by row content so set operations use SQL value semantics."""
+    return t.with_id_from(*[t[c] for c in t.column_names()])
+
+
+def _distinct(t: Table) -> Table:
+    cols = t.column_names()
+    return t.groupby(*[t[c] for c in cols]).reduce(**{c: t[c] for c in cols})
+
+
+def _split_setops(q: str) -> list[tuple[str | None, str]]:
+    """[(op, segment)]: top-level UNION [ALL] / EXCEPT splits, in order
+    (equal precedence, left-associative, per the SQL standard)."""
+    protected, lits = _quote_split(q)
+    matches = []
+    depth = 0
+    pat = re.compile(r"(?i)\b(UNION(?:\s+ALL)?|EXCEPT)\b")
+    found = [(m.start(), m.end(), m.group(1)) for m in pat.finditer(protected)]
+    fi = 0
+    cuts: list[tuple[int, int, str]] = []
+    for idx, ch in enumerate(protected):
+        if ch == "(":
+            depth += 1
+        elif ch == ")":
+            depth -= 1
+        while fi < len(found) and found[fi][0] == idx:
+            if depth == 0:
+                cuts.append(found[fi])
+            fi += 1
+    out: list[tuple[str | None, str]] = []
+    last = 0
+    last_op: str | None = None
+    for start, end, op in cuts:
+        out.append((last_op, _restore_literals(protected[last:start], lits).strip()))
+        last_op = re.sub(r"\s+", " ", op.upper())
+        last = end
+    out.append((last_op, _restore_literals(protected[last:], lits).strip()))
+    return out
+
+
+def _sql_query(q: str, tables: dict) -> Table:
+    q = q.strip()
+    # UNION/EXCEPT: equal precedence, left-associative; INTERSECT binds
+    # tighter and is handled per segment below
+    segments = _split_setops(q)
+    if len(segments) > 1:
+        acc = _sql_intersect(segments[0][1], tables)
+        for op, seg in segments[1:]:
+            rhs = _sql_intersect(seg, tables)
+            if op == "UNION ALL":
+                acc = acc.concat_reindex(rhs)
+            elif op == "UNION":
+                acc = _distinct(acc.concat_reindex(rhs))
+            else:  # EXCEPT
+                acc = _content_keyed(acc).difference(_content_keyed(rhs))
+        return acc
+    return _sql_intersect(q, tables)
+
+
+def _sql_intersect(q: str, tables: dict) -> Table:
+    q = q.strip()
+    parts = _split_protected(q, "INTERSECT")
+    if len(parts) > 1:
+        acc = _content_keyed(_sql_select(parts[0], tables))
+        for p in parts[1:]:
+            acc = acc.intersect(_content_keyed(_sql_select(p, tables)))
+        return acc
+    return _sql_select(q, tables)
+
+
+def _extract_from_subquery(q: str, tables: dict) -> str:
+    """FROM (SELECT ...) [AS] alias — evaluate the subquery, register it
+    under the alias, splice the alias into the text."""
+    m = re.search(r"(?is)\bfrom\s*\(", q)
+    if not m:
+        return q
+    start = q.index("(", m.start())
+    depth = 0
+    for i in range(start, len(q)):
+        if q[i] == "(":
+            depth += 1
+        elif q[i] == ")":
+            depth -= 1
+            if depth == 0:
+                end = i
+                break
+    else:
+        raise NotImplementedError(f"unbalanced parens in {q!r}")
+    inner = q[start + 1 : end]
+    rest = q[end + 1 :]
+    am = re.match(r"(?is)^\s*(?:as\s+)?(\w+)(.*)$", rest, re.S)
+    if not am:
+        raise NotImplementedError("FROM subquery requires an alias")
+    alias, tail = am.group(1), am.group(2)
+    tables[alias] = _sql_query(inner.strip(), tables)
+    return q[: m.start()] + f"FROM {alias}" + tail
+
+
+_AGG_CALL = re.compile(r"(?i)\b(count|sum|avg|min|max)\s*\(")
+
+
+def _extract_having_aggs(having: str) -> tuple[str, dict[str, str]]:
+    """Replace aggregate calls in HAVING with hidden aliases computed in the
+    reduce: 'COUNT(*) > 2' -> ('__h0 > 2', {'__h0': 'COUNT(*)'})."""
+    hidden: dict[str, str] = {}
+    out = []
+    i = 0
+    while i < len(having):
+        m = _AGG_CALL.search(having, i)
+        if not m:
+            out.append(having[i:])
+            break
+        out.append(having[i : m.start()])
+        depth = 0
+        j = having.index("(", m.start())
+        for k in range(j, len(having)):
+            if having[k] == "(":
+                depth += 1
+            elif having[k] == ")":
+                depth -= 1
+                if depth == 0:
+                    break
+        else:
+            raise NotImplementedError(f"unbalanced parens in HAVING {having!r}")
+        call = having[m.start() : k + 1]
+        name = f"__h{len(hidden)}"
+        hidden[name] = call
+        out.append(name)
+        i = k + 1
+    return "".join(out), hidden
+
+
+def _sql_select(q: str, tables: dict) -> Table:
+    q = q.strip()
+    # subquery aliases stay local to THIS select: they must not shadow real
+    # tables in sibling set-operation branches
+    tables = dict(tables)
+    q = _extract_from_subquery(q, tables)
     m = re.match(
         r"(?is)^select\s+(?P<cols>.*?)\s+from\s+(?P<table>\w+)"
         r"(?P<joins>(?:\s+(?:inner\s+|left\s+|right\s+|outer\s+)?join\s+\w+\s+on\s+.*?(?=\s+(?:inner\s+|left\s+|right\s+|outer\s+)?join|\s+where|\s+group\s+by|\s+order\s+by|\s+limit|$))*)"
         r"(?:\s+where\s+(?P<where>.*?))?"
         r"(?:\s+group\s+by\s+(?P<group>.*?))?"
+        r"(?:\s+having\s+(?P<having>.*?))?"
         r"(?:\s+order\s+by\s+(?P<order>.*?))?"
         r"(?:\s+limit\s+(?P<limit>\d+))?$",
         q,
     )
     if not m:
-        raise NotImplementedError(f"unsupported SQL: {query!r}")
+        raise NotImplementedError(f"unsupported SQL: {q!r}")
     tname = m.group("table")
     if tname not in tables:
         raise ValueError(f"unknown table {tname!r} in SQL query")
@@ -100,7 +261,20 @@ def sql(query: str, **tables: Table) -> Table:
         for c in cols_txt:
             name, e = _parse_output(c, t)
             out[name] = e
+        having_txt = m.group("having")
+        if having_txt:
+            rewritten, hidden = _extract_having_aggs(having_txt)
+            hidden_exprs = {
+                name: _parse_expr(call, t) for name, call in hidden.items()
+            }
+            reduced = t.groupby(*[t[g] for g in gb_cols]).reduce(
+                **out, **hidden_exprs
+            )
+            reduced = reduced.filter(_parse_expr(rewritten, reduced))
+            return reduced.select(**{n: reduced[n] for n in out})
         return t.groupby(*[t[g] for g in gb_cols]).reduce(**out)
+    if m.group("having"):
+        raise NotImplementedError("HAVING requires GROUP BY")
     if len(cols_txt) == 1 and cols_txt[0].strip() == "*":
         return t.select(*[t[n] for n in t.column_names()])
     has_agg = any(re.match(r"(?i)\s*(count|sum|avg|min|max)\s*\(", c) for c in cols_txt)

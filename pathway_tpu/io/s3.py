@@ -136,26 +136,41 @@ class S3ScannerSource(DataSource):
             self._client = self.settings.make_client()
         return self._client
 
-    def _list_keys(self) -> list[str]:
+    def _list_keys(self) -> list[tuple[str, str | None]]:
         client = self._ensure_client()
-        keys = list_keys_paginated(client, self.bucket, self.prefix)
+        entries = list_objects_paginated(client, self.bucket, self.prefix)
         if self._partition is not None:
             pid, n = self._partition
-            keys = [k for k in keys if zlib.crc32(k.encode()) % n == pid]
-        return keys
+            entries = [
+                (k, e) for k, e in entries
+                if zlib.crc32(k.encode()) % n == pid
+            ]
+        return entries
 
     def _scan(self) -> list:
         client = self._ensure_client()
         events = []
-        for key in self._list_keys():
+        for key, listed_etag in self._list_keys():
+            # the listing already carries ETags: unchanged objects skip the
+            # GetObject round-trip entirely
+            if (
+                listed_etag is not None
+                and self._etags.get(key) == listed_etag
+                and key in self._progress
+            ):
+                continue
             try:
                 resp = client.get_object(Bucket=self.bucket, Key=key)
-                etag = resp.get("ETag", "")
+                etag = resp.get("ETag", listed_etag or "")
                 if self._etags.get(key) == etag and key in self._progress:
                     continue
                 body = resp["Body"].read()
             except Exception:
-                continue  # transient: retried next poll
+                if not self._live:
+                    # static mode has no next poll: a persistent read
+                    # failure must surface, not silently drop rows
+                    raise
+                continue  # streaming: transient, retried next poll
             self._etags[key] = etag
             dicts = _parse_object(body, self.format, self.schema.column_names())
             start = self._progress.get(key, 0)
@@ -194,28 +209,34 @@ def resolve_path(path: str, settings: "AwsS3Settings") -> tuple[str, str]:
     path, the WHOLE path is the in-bucket prefix (reference semantics);
     s3:// URLs carry their own bucket component."""
     if path.startswith("s3://"):
-        bucket, prefix = _split_path(path)
-        return settings.bucket_name or bucket, prefix
+        # an explicit s3:// URL names its own bucket
+        return _split_path(path)
     if settings.bucket_name:
         return settings.bucket_name, path
     return _split_path(path)
 
 
-def list_keys_paginated(client, bucket: str, prefix: str) -> list[str]:
-    """Paginated ListObjectsV2 (shared by the scanner and the persistence
-    backend)."""
-    keys: list[str] = []
+def list_objects_paginated(client, bucket: str, prefix: str) -> list[tuple[str, str | None]]:
+    """Paginated ListObjectsV2 -> sorted [(key, etag)] (shared by the
+    scanner and the persistence backend)."""
+    out: list[tuple[str, str | None]] = []
     token = None
     while True:
         kw = {"Bucket": bucket, "Prefix": prefix}
         if token:
             kw["ContinuationToken"] = token
         resp = client.list_objects_v2(**kw)
-        keys.extend(o["Key"] for o in resp.get("Contents", []) or [])
+        out.extend(
+            (o["Key"], o.get("ETag")) for o in resp.get("Contents", []) or []
+        )
         if not resp.get("IsTruncated"):
             break
         token = resp.get("NextContinuationToken")
-    return sorted(keys)
+    return sorted(out)
+
+
+def list_keys_paginated(client, bucket: str, prefix: str) -> list[str]:
+    return [k for k, _e in list_objects_paginated(client, bucket, prefix)]
 
 
 def read(

