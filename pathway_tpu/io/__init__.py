@@ -13,7 +13,10 @@ import sys
 import types
 from typing import Any
 
-from . import csv, fs, jsonlines, kafka, postgres, python, s3, sqlite
+from . import (
+    csv, elasticsearch, fs, jsonlines, kafka, mongodb, postgres, python, s3,
+    sqlite,
+)
 from ._subscribe import subscribe
 from ._synchronization import register_input_synchronization_group
 
@@ -50,8 +53,6 @@ minio = _make_stub("minio", "boto3")
 gdrive = _make_stub("gdrive", "google-api-python-client")
 sharepoint = _make_stub("sharepoint", "Office365-REST client")
 mysql = _make_stub("mysql", "pymysql")
-mongodb = _make_stub("mongodb", "pymongo")
-elasticsearch = _make_stub("elasticsearch", "elasticsearch client")
 deltalake = _make_stub("deltalake", "deltalake")
 iceberg = _make_stub("iceberg", "pyiceberg")
 nats = _make_stub("nats", "nats-py")

@@ -241,3 +241,93 @@ def test_postgres_write_snapshot_upsert_delete():
     pw.run(monitoring_level=pw.MonitoringLevel.NONE)
     assert conn.snapshot == {"a": ("a", 5)}, conn.snapshot
     pg.G.clear()
+
+
+class FakeEsClient:
+    def __init__(self):
+        self.docs = {}
+
+    def index(self, index, id, document):
+        self.docs[(index, id)] = document
+
+    def delete(self, index, id):
+        self.docs.pop((index, id), None)
+
+    def close(self):
+        pass
+
+
+def test_elasticsearch_write_upsert_delete():
+    from pathway_tpu.debug import table_from_rows
+
+    es = FakeEsClient()
+
+    class S(pw.Schema):
+        k: str = pw.column_definition(primary_key=True)
+        v: int
+
+    rows = [("a", 1, 0, 1), ("b", 2, 0, 1), ("a", 1, 2, -1)]
+    pg.G.clear()
+    t = table_from_rows(S, rows, is_stream=True)
+    auth = pw.io.elasticsearch.ElasticSearchAuth("injected", client=es)
+    pw.io.elasticsearch.write(t, "http://localhost:9200", auth, "idx")
+    pw.run(monitoring_level=pw.MonitoringLevel.NONE)
+    vals = sorted(d["k"] for d in es.docs.values())
+    assert vals == ["b"]
+    pg.G.clear()
+
+
+class FakeMongoCollection:
+    def __init__(self):
+        self.docs = {}
+
+    def replace_one(self, flt, doc, upsert=False):
+        self.docs[flt["_id"]] = doc
+
+    def delete_one(self, flt):
+        self.docs.pop(flt["_id"], None)
+
+    def find(self, _q):
+        return [dict(d, _id=i) for i, d in self.docs.items()]
+
+
+class FakeMongoDb:
+    def __init__(self, colls):
+        self._colls = colls
+
+    def __getitem__(self, name):
+        return self._colls.setdefault(name, FakeMongoCollection())
+
+
+class FakeMongoClient:
+    def __init__(self):
+        self.colls = {}
+
+    def __getitem__(self, db):
+        return FakeMongoDb(self.colls)
+
+
+def test_mongodb_write_and_read_roundtrip():
+    from pathway_tpu.debug import table_from_rows
+
+    client = FakeMongoClient()
+
+    class S(pw.Schema):
+        k: str = pw.column_definition(primary_key=True)
+        v: int
+
+    pg.G.clear()
+    t = table_from_rows(S, [("a", 1), ("b", 2)])
+    pw.io.mongodb.write(t, "mongodb://x", "db", "coll", _client=client)
+    pw.run(monitoring_level=pw.MonitoringLevel.NONE)
+    coll = client.colls["coll"]
+    assert sorted(d["k"] for d in coll.docs.values()) == ["a", "b"]
+
+    # read it back through the polling source
+    pg.G.clear()
+    rt = pw.io.mongodb.read(
+        "mongodb://x", "db", "coll", schema=S, mode="static", _client=client
+    )
+    rows = sorted(run_and_squash(rt).values())
+    assert rows == [("a", 1), ("b", 2)]
+    pg.G.clear()
