@@ -250,8 +250,61 @@ class CountDistinctState(UniqueState):
 
 
 class CountDistinctApproxState(CountDistinctState):
-    """Exact for now; HLL++ sketch is a planned Pallas-friendly upgrade
-    (reference: CountDistinctApproximate, src/engine/reduce.rs)."""
+    """HyperLogLog estimate (reference: CountDistinctApproximate,
+    src/engine/reduce.rs HyperLogLog++).
+
+    Retraction support forces keeping the exact multiset anyway (a pure
+    sketch cannot retract); the VALUE is the HLL estimate over the live
+    distinct hashes, computed vectorized with numpy and cached per flush —
+    semantics parity with the reference's approximate reducer."""
+
+    __slots__ = ("_est_valid", "_est")
+    _P = 12  # 4096 registers
+
+    def __init__(self):
+        super().__init__()
+        self._est_valid = False
+        self._est = 0
+
+    def _update(self, args, diff, time, key):
+        super()._update(args, diff, time, key)
+        self._est_valid = False
+
+    def _value(self):
+        if not self.ms:
+            return 0
+        if self._est_valid:
+            return self._est
+        m = 1 << self._P
+        # ms keys are _H wrappers: use their cached STABLE 128-bit-derived
+        # hash (hashing the wrapper object itself would fall through _ser to
+        # repr() and embed a memory address -> nondeterministic estimates)
+        hashes = np.fromiter(
+            (h._h & ((1 << 64) - 1) if isinstance(h, _H)
+             else hash_values(h) & ((1 << 64) - 1)
+             for h in self.ms.keys()),
+            dtype=np.uint64, count=len(self.ms),
+        )
+        idx = (hashes >> np.uint64(64 - self._P)).astype(np.int64)
+        rest = hashes << np.uint64(self._P)
+        # rank = leading zeros of the remaining 64-P bits + 1
+        lz = np.zeros(len(hashes), np.int64)
+        cur = rest
+        # vectorized leading-zero count via float log2 trick
+        nz = cur != 0
+        lz[nz] = 63 - np.floor(np.log2(cur[nz].astype(np.float64))).astype(np.int64)
+        lz[~nz] = 64 - self._P
+        rank = np.minimum(lz + 1, 64 - self._P + 1)
+        registers = np.zeros(m, np.int64)
+        np.maximum.at(registers, idx, rank)
+        alpha = 0.7213 / (1 + 1.079 / m)
+        est = alpha * m * m / np.sum(np.power(2.0, -registers))
+        zeros = int(np.sum(registers == 0))
+        if est <= 2.5 * m and zeros:
+            est = m * np.log(m / zeros)  # linear counting, small range
+        self._est = int(round(est))
+        self._est_valid = True
+        return self._est
 
 
 class SortedTupleState(ReducerState, _MultisetMixin):

@@ -47,9 +47,39 @@ def _make_stub(name: str, needs: str) -> types.ModuleType:
     return mod
 
 
+# s3-compatible aliases (reference: io/s3_csv, io/minio)
+s3_csv = types.ModuleType("pathway_tpu.io.s3_csv")
+s3_csv.read = lambda path, **kw: s3.read(path, format="csv", **kw)
+s3_csv.write = s3.write
+sys.modules["pathway_tpu.io.s3_csv"] = s3_csv
+
+
+class MinIOSettings(s3.AwsS3Settings):
+    """Reference parity: pw.io.minio.MinIOSettings (endpoint-based S3)."""
+
+    def __init__(self, endpoint=None, bucket_name=None, access_key=None,
+                 secret_access_key=None, *, with_path_style=True, **kw):
+        ep = endpoint
+        if ep and not str(ep).startswith(("http://", "https://")):
+            ep = f"https://{ep}"
+        super().__init__(
+            bucket_name=bucket_name, access_key=access_key,
+            secret_access_key=secret_access_key, endpoint=ep,
+            with_path_style=with_path_style, **kw,
+        )
+
+
+minio = types.ModuleType("pathway_tpu.io.minio")
+minio.MinIOSettings = MinIOSettings
+minio.read = lambda path, *, minio_settings=None, **kw: s3.read(
+    path, aws_s3_settings=minio_settings, **kw
+)
+minio.write = lambda table, path, *, minio_settings=None, **kw: s3.write(
+    table, path, aws_s3_settings=minio_settings, **kw
+)
+sys.modules["pathway_tpu.io.minio"] = minio
+
 # long-tail connectors behind the same seam (reference: src/connectors/data_storage/)
-s3_csv = _make_stub("s3_csv", "boto3")
-minio = _make_stub("minio", "boto3")
 gdrive = _make_stub("gdrive", "google-api-python-client")
 sharepoint = _make_stub("sharepoint", "Office365-REST client")
 mysql = _make_stub("mysql", "pymysql")

@@ -82,8 +82,14 @@ class _EsWriter:
             else:
                 try:
                     self._client.delete(index=self.index_name, id=doc_id)
-                except Exception:
-                    pass  # already absent
+                except Exception as exc:
+                    # only an absent document is ignorable; a transient
+                    # failure would silently lose the retraction forever
+                    status = getattr(exc, "status_code", None)
+                    if status != 404 and type(exc).__name__ not in (
+                        "NotFoundError", "KeyError",
+                    ):
+                        raise
 
     def close(self) -> None:
         if self._client is not None:

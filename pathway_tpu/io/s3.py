@@ -47,10 +47,16 @@ class AwsS3Settings:
             return self._client
         try:
             import boto3
+            from botocore.config import Config as _BotoConfig
         except ImportError as exc:
             raise ImportError(
                 "pw.io.s3 requires boto3 (or an injected client for tests)"
             ) from exc
+        cfg = None
+        if self.with_path_style:
+            # MinIO-style deployments have no wildcard DNS for
+            # virtual-hosted addressing
+            cfg = _BotoConfig(s3={"addressing_style": "path"})
         return boto3.client(
             "s3",
             aws_access_key_id=self.access_key,
@@ -58,6 +64,7 @@ class AwsS3Settings:
             aws_session_token=self.session_token,
             region_name=self.region,
             endpoint_url=self.endpoint,
+            config=cfg,
         )
 
 

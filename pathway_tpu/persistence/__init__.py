@@ -28,8 +28,8 @@ class Backend:
         return S3Backend(root_path, bucket_settings)
 
     @classmethod
-    def azure(cls, root_path: str, account_settings: Any = None) -> "Backend":
-        raise NotImplementedError("azure persistence backend not wired")
+    def azure(cls, root_path: str, account_settings: Any = None) -> "AzureBackend":
+        return AzureBackend(root_path, account_settings)
 
     @classmethod
     def mock(cls, events: Any = None) -> "MockBackend":
@@ -206,6 +206,57 @@ class S3Backend(Backend):
             # journal-format heuristic would mistake an existing journal
             # for v1 and destroy it
             raise
+
+
+class AzureBackend(S3Backend):
+    """Azure Blob persistence (reference: src/persistence/backends/azure.rs)
+    — the S3Backend object-per-record layout over an azure-storage-blob
+    container client adapted to the same list/get/put/delete verbs.
+    `account_settings` may carry `_client` (S3-verb fake) for tests or
+    `container_client` (a real azure ContainerClient) wrapped below."""
+
+    def __init__(self, root_path: str, account_settings: Any = None):
+        cc = getattr(account_settings, "container_client", None)
+        if cc is not None:
+            from ..io.s3 import AwsS3Settings
+
+            account_settings = AwsS3Settings(
+                bucket_name=getattr(account_settings, "container", "azure"),
+                _client=_AzureS3Adapter(cc),
+            )
+        super().__init__(root_path, account_settings)
+
+
+class _AzureS3Adapter:
+    """azure ContainerClient -> the S3 verbs the backend speaks."""
+
+    def __init__(self, container_client):
+        self.cc = container_client
+
+    def list_objects_v2(self, Bucket, Prefix="", **_kw):
+        names = [
+            {"Key": b.name} for b in self.cc.list_blobs(name_starts_with=Prefix)
+        ]
+        return {"Contents": names, "IsTruncated": False}
+
+    def get_object(self, Bucket, Key):
+        import io as _io3
+
+        data = self.cc.download_blob(Key).readall()
+        return {"Body": _io3.BytesIO(data)}
+
+    def put_object(self, Bucket, Key, Body):
+        self.cc.upload_blob(Key, Body, overwrite=True)
+
+    def delete_object(self, Bucket, Key):
+        try:
+            self.cc.delete_blob(Key)
+        except Exception as exc:
+            # delete is idempotent for MISSING blobs only; a transient
+            # failure leaving stale journal objects must surface (replay
+            # would otherwise apply ghost records)
+            if type(exc).__name__ not in ("ResourceNotFoundError", "KeyError"):
+                raise
 
 
 def _is_missing_key_error(exc: Exception) -> bool:

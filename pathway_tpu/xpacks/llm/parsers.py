@@ -40,7 +40,47 @@ class Utf8Parser(ParserBase):
 ParseUtf8 = Utf8Parser
 
 
+def _native_pdf_extract(contents: bytes) -> list[str]:
+    """Dependency-free PDF text extraction fallback: inflate FlateDecode
+    content streams and read the text-showing operators (Tj / TJ / ').
+    Covers straightforwardly-encoded PDFs; complex encodings (CID fonts,
+    octal-heavy escapes) degrade to partial text rather than failing."""
+    import re as _re
+    import zlib as _zlib
+
+    texts: list[str] = []
+    for m in _re.finditer(rb"stream\r?\n(.*?)endstream", contents, _re.S):
+        data = m.group(1)
+        try:
+            data = _zlib.decompress(data)
+        except Exception:
+            pass
+        chunks: list[str] = []
+        # (string) Tj   and   [(a) -120 (b)] TJ
+        for sm in _re.finditer(
+            rb"\((?:\\.|[^\\()])*\)\s*(?:Tj|')|\[(?:[^\]]*)\]\s*TJ", data
+        ):
+            frag = sm.group(0)
+            for lit in _re.finditer(rb"\((?:\\.|[^\\()])*\)", frag):
+                raw = lit.group(0)[1:-1]
+                raw = _re.sub(
+                    rb"\\([nrtbf()\\])",
+                    lambda e: {b"n": b"\n", b"r": b"\r", b"t": b"\t",
+                               b"b": b"\b", b"f": b"\f", b"(": b"(",
+                               b")": b")", b"\\": b"\\"}[e.group(1)],
+                    raw,
+                )
+                chunks.append(raw.decode("latin-1", "replace"))
+            chunks.append(" ")
+        text = "".join(chunks).strip()
+        if text:
+            texts.append(text)
+    return texts
+
+
 class PypdfParser(ParserBase):
+    """pypdf when importable; otherwise the native extractor above."""
+
     def __init__(self, apply_text_cleanup: bool = True, cache_strategy=None):
         self.cleanup = apply_text_cleanup
 
@@ -49,8 +89,14 @@ class PypdfParser(ParserBase):
             import io
 
             from pypdf import PdfReader
-        except ImportError as exc:
-            raise ImportError("PypdfParser requires pypdf") from exc
+        except ImportError:
+            pages = _native_pdf_extract(contents)
+            out = []
+            for i, text in enumerate(pages or [""]):
+                if self.cleanup:
+                    text = " ".join(text.split())
+                out.append((text, {"page": i}))
+            return out
         reader = PdfReader(io.BytesIO(contents))
         out = []
         for i, page in enumerate(reader.pages):

@@ -41,3 +41,83 @@ def test_knn_topk_l2():
     q = m[[10]]
     vals, idx = knn_topk(m, q, k=1, metric="l2sq", use_pallas=False)
     assert idx[0, 0] == 10
+
+
+def test_count_distinct_approximate_hll():
+    """HLL estimate within 5% at 10k distinct; exact-ish at small scale
+    (reference: CountDistinctApproximate / HyperLogLog++)."""
+    from pathway_tpu.engine.reducers_impl import CountDistinctApproxState
+
+    s = CountDistinctApproxState()
+    for i in range(10_000):
+        s._update((f"v{i}",), 1, 0, None)
+    est = s._value()
+    assert abs(est - 10_000) / 10_000 < 0.05
+    for i in range(5_000):
+        s._update((f"v{i}",), -1, 0, None)
+    est = s._value()
+    assert abs(est - 5_000) / 5_000 < 0.05
+
+
+def test_native_pdf_parser_fallback():
+    import zlib
+
+    from pathway_tpu.xpacks.llm.parsers import PypdfParser, _native_pdf_extract
+
+    content = zlib.compress(
+        b"BT /F1 12 Tf (Hello TPU) Tj [(wor) -20 (ld)] TJ ET"
+    )
+    pdf = (
+        b"%PDF-1.4\n1 0 obj\n<< /Filter /FlateDecode >>\nstream\n"
+        + content + b"\nendstream\nendobj\n%%EOF"
+    )
+    [(text, meta)] = PypdfParser()._parse(pdf)
+    assert "Hello TPU" in text
+    assert meta["page"] == 0
+
+
+def test_azure_persistence_backend_via_adapter():
+    import io as _io
+
+    import pathway_tpu as pw
+
+    class FakeBlob:
+        def __init__(self, name):
+            self.name = name
+
+    class FakeContainer:
+        def __init__(self):
+            self.blobs = {}
+
+        def list_blobs(self, name_starts_with=""):
+            return [FakeBlob(n) for n in sorted(self.blobs)
+                    if n.startswith(name_starts_with)]
+
+        def download_blob(self, name):
+            data = self.blobs[name]
+
+            class R:
+                def readall(self):
+                    return data
+
+            return R()
+
+        def upload_blob(self, name, body, overwrite=False):
+            self.blobs[name] = body if isinstance(body, bytes) else body.encode()
+
+        def delete_blob(self, name):
+            self.blobs.pop(name, None)
+
+    class Settings:
+        container = "c"
+        container_client = FakeContainer()
+
+    b = pw.persistence.Backend.azure("az://c/root", Settings())
+    b.append("s1", b"r0")
+    b.append("s1", b"r1")
+    assert b.read_all("s1") == [b"r0", b"r1"]
+    b.put_metadata("k", b"v")
+    assert b.get_metadata("k") == b"v"
+    b.replace_all("s1", [b"x"])
+    assert b.read_all("s1") == [b"x"]
+    assert b.list_streams("s") == ["s1"]
