@@ -285,6 +285,13 @@ class CountDistinctApproxState(CountDistinctState):
              for h in self.ms.keys()),
             dtype=np.uint64, count=len(self.ms),
         )
+        # _h is 63-bit (sign-masked): splitmix-style avalanche redistributes
+        # it over all 64 bits so register indices and ranks stay unbiased
+        with np.errstate(over="ignore"):
+            hashes = hashes * np.uint64(0x9E3779B97F4A7C15)
+            hashes ^= hashes >> np.uint64(31)
+            hashes = hashes * np.uint64(0xBF58476D1CE4E5B9)
+            hashes ^= hashes >> np.uint64(27)
         idx = (hashes >> np.uint64(64 - self._P)).astype(np.int64)
         rest = hashes << np.uint64(self._P)
         # rank = leading zeros of the remaining 64-P bits + 1
