@@ -187,7 +187,9 @@ Table.asof_now_join_left = asof_now_join_left
 Table.diff = ordered.diff
 Table.interpolate = statistical.interpolate
 Table.show = utils.viz_show
-Table.plot = utils.viz_plot
+from .stdlib import viz as _viz
+
+Table.plot = _viz.plot
 Table.sort = temporal.sort
 
 from .internals import universes  # noqa: E402

@@ -123,3 +123,22 @@ def test_state_size_telemetry():
     assert "pathway_operator_state_entries" in metrics
     assert 'operator="groupby"' in metrics
     pg.G.clear()
+
+
+def test_viz_plot_renders_png(tmp_path):
+    """stdlib.viz.plot renders a live matplotlib chart per commit
+    (reference: Bokeh/Panel live plots)."""
+    from pathway_tpu.debug import table_from_rows
+    from pathway_tpu.stdlib import viz
+
+    class S(pw.Schema):
+        x: int
+        y: float
+
+    pg.G.clear()
+    t = table_from_rows(S, [(i, i * 0.5) for i in range(20)])
+    out_png = tmp_path / "plot.png"
+    viz.plot(t, x="x", y="y", output_file=str(out_png))
+    pw.run(monitoring_level=pw.MonitoringLevel.NONE)
+    assert out_png.exists() and out_png.stat().st_size > 1000
+    pg.G.clear()
