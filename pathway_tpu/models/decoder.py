@@ -16,7 +16,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .encoder import EncoderConfig, _attention, _layer_norm, init_params
+from .encoder import (EncoderConfig, _attention, _layer_norm, _resolve_dtype,
+                      init_params)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -27,7 +28,7 @@ class DecoderConfig:
     n_heads: int = 8
     d_ff: int = 2048
     max_len: int = 1024
-    dtype: Any = jnp.bfloat16
+    dtype: Any = "auto"  # bf16 on TPU, f32 on CPU (see encoder._resolve_dtype)
     ln_eps: float = 1e-6
     act: str = "gelu_tanh"  # gelu (exact erf) | gelu_tanh | relu
 
@@ -67,9 +68,10 @@ def forward_logits(params: dict, cfg: DecoderConfig, token_ids: jax.Array) -> ja
     weights map directly (models/hf_import.py)."""
     from .encoder import _proj
 
-    x = params["embed"].astype(cfg.dtype)[token_ids]
+    dtype = _resolve_dtype(cfg.dtype)
+    x = params["embed"].astype(dtype)[token_ids]
     T = token_ids.shape[1]
-    x = x + params["pos_embed"].astype(cfg.dtype)[:T][None, :, :]
+    x = x + params["pos_embed"].astype(dtype)[:T][None, :, :]
     eps = cfg.ln_eps
 
     def act(v):

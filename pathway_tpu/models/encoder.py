@@ -19,6 +19,15 @@ import jax.numpy as jnp
 import numpy as np
 
 
+def _resolve_dtype(d: Any):
+    """"auto" picks the compute dtype by backend: bf16 feeds the MXU on TPU;
+    on CPU fallback bf16 is *emulated* (oneDNN upconverts per-op) and was
+    measured 1.5-2.9x slower than f32, so f32 is the CPU choice."""
+    if isinstance(d, str) and d == "auto":
+        return jnp.bfloat16 if jax.default_backend() == "tpu" else jnp.float32
+    return d
+
+
 @dataclasses.dataclass(frozen=True)
 class EncoderConfig:
     vocab_size: int = 32768
@@ -27,7 +36,7 @@ class EncoderConfig:
     n_heads: int = 6
     d_ff: int = 1536
     max_len: int = 512
-    dtype: Any = jnp.bfloat16
+    dtype: Any = "auto"
     # "pre" (default, training-friendly) or "post" (BERT-family weight
     # compatibility — see models/hf_import.py)
     ln_placement: str = "pre"
@@ -86,25 +95,39 @@ def _proj(layer, x, w_name: str, b_name: str):
 
 
 def _attention(layer, x, mask, n_heads: int):
+    """mask=None means "every position is real" (exact-fit bucket): the
+    masking `where` is skipped entirely.  QKV projections are fused into one
+    (D, 3D) matmul — one big MXU tile instead of three narrow ones."""
     B, T, D = x.shape
     H = n_heads
     hd = D // H
-    q = _proj(layer, x, "wq", "bq").reshape(B, T, H, hd)
-    k = _proj(layer, x, "wk", "bk").reshape(B, T, H, hd)
-    v = _proj(layer, x, "wv", "bv").reshape(B, T, H, hd)
+    wqkv = jnp.concatenate(
+        [layer["wq"], layer["wk"], layer["wv"]], axis=1
+    ).astype(x.dtype)
+    qkv = x @ wqkv
+    if layer.get("bq") is not None:
+        qkv = qkv + jnp.concatenate(
+            [layer["bq"], layer["bk"], layer["bv"]]
+        ).astype(x.dtype)
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    q = q.reshape(B, T, H, hd)
+    k = k.reshape(B, T, H, hd)
+    v = v.reshape(B, T, H, hd)
     scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(hd)
-    scores = jnp.where(mask[:, None, None, :], scores, -1e9)
+    if mask is not None:
+        scores = jnp.where(mask[:, None, None, :], scores, -1e9)
     probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(x.dtype)
     out = jnp.einsum("bhqk,bkhd->bqhd", probs, v).reshape(B, T, D)
     return _proj(layer, out, "wo", "bo")
 
 
 def encode_tokens(params: dict, cfg: EncoderConfig, token_ids: jax.Array,
-                  mask: jax.Array) -> jax.Array:
+                  mask: jax.Array | None) -> jax.Array:
     """(B, T) -> (B, T, d_model) contextual embeddings."""
-    x = params["embed"].astype(cfg.dtype)[token_ids]
+    dtype = _resolve_dtype(cfg.dtype)
+    x = params["embed"].astype(dtype)[token_ids]
     T = token_ids.shape[1]
-    x = x + params["pos_embed"].astype(cfg.dtype)[:T][None, :, :]
+    x = x + params["pos_embed"].astype(dtype)[:T][None, :, :]
     eps = cfg.ln_eps
     if cfg.ln_placement == "post" and "ln_e_scale" in params:
         x = _layer_norm(x, params["ln_e_scale"], params["ln_e_bias"], eps)
@@ -135,14 +158,20 @@ def encode_tokens(params: dict, cfg: EncoderConfig, token_ids: jax.Array,
     return x
 
 
-def encode(params: dict, cfg: EncoderConfig, token_ids: jax.Array, mask: jax.Array) -> jax.Array:
-    """(B, T) int32 tokens + (B, T) bool mask -> (B, d_model) L2-normed f32."""
+def encode(params: dict, cfg: EncoderConfig, token_ids: jax.Array,
+           mask: jax.Array | None) -> jax.Array:
+    """(B, T) int32 tokens + (B, T) bool mask -> (B, d_model) L2-normed f32.
+
+    mask=None is the exact-fit fast path (all positions real)."""
     x = encode_tokens(params, cfg, token_ids, mask)
     # masked mean pooling + L2 norm (SentenceTransformer-style)
-    m = mask[:, :, None].astype(jnp.float32)
-    pooled = jnp.sum(x.astype(jnp.float32) * m, axis=1) / jnp.maximum(
-        jnp.sum(m, axis=1), 1.0
-    )
+    if mask is None:
+        pooled = jnp.mean(x.astype(jnp.float32), axis=1)
+    else:
+        m = mask[:, :, None].astype(jnp.float32)
+        pooled = jnp.sum(x.astype(jnp.float32) * m, axis=1) / jnp.maximum(
+            jnp.sum(m, axis=1), 1.0
+        )
     return pooled / (jnp.linalg.norm(pooled, axis=-1, keepdims=True) + 1e-12)
 
 
@@ -157,10 +186,17 @@ class JaxEncoder:
                  seq_buckets=(32, 128, 512), batch_buckets=(1, 8, 64, 256),
                  params: dict | None = None, tokenizer=None):
         self.cfg = cfg or EncoderConfig()
+        if isinstance(self.cfg.dtype, str):
+            self.cfg = dataclasses.replace(
+                self.cfg, dtype=_resolve_dtype(self.cfg.dtype)
+            )
         self.params = (
             params if params is not None
             else init_params(self.cfg, jax.random.PRNGKey(seed))
         )
+        # per-stage wall-time accumulators (surfaced by bench.py / telemetry)
+        self.stats = {"tokenize_s": 0.0, "pad_s": 0.0, "device_s": 0.0,
+                      "texts": 0, "calls": 0}
         self.seq_buckets = [b for b in seq_buckets if b <= self.cfg.max_len] or [
             self.cfg.max_len
         ]
@@ -203,18 +239,38 @@ class JaxEncoder:
                 for i in range(0, len(texts), max_b)
             ]
             return np.concatenate(parts, axis=0)
+        import time as _time
+
+        t0 = _time.perf_counter()
         toks = [self.tokenizer.encode(t)[: self.cfg.max_len] for t in texts]
+        t1 = _time.perf_counter()
         max_t = max(1, max(len(t) for t in toks))
         T = self._bucket(max_t, self.seq_buckets)
         B = self._bucket(len(texts), self.batch_buckets)
         ids = np.zeros((B, T), np.int32)
-        mask = np.zeros((B, T), bool)
-        for i, t in enumerate(toks):
-            t = t[:T]
-            ids[i, : len(t)] = t
-            mask[i, : len(t)] = True
-        out = np.asarray(self._fwd(self.params, token_ids=jnp.asarray(ids),
-                                   mask=jnp.asarray(mask)))
+        if len(texts) == B and all(len(t) == T for t in toks):
+            # exact-fit bucket: no padding anywhere -> skip the attention
+            # mask entirely (one `where` + masked pooling saved per layer)
+            for i, t in enumerate(toks):
+                ids[i] = t
+            mask = None
+        else:
+            mask = np.zeros((B, T), bool)
+            for i, t in enumerate(toks):
+                t = t[:T]
+                ids[i, : len(t)] = t
+                mask[i, : len(t)] = True
+        t2 = _time.perf_counter()
+        out = np.asarray(self._fwd(
+            self.params, token_ids=jnp.asarray(ids),
+            mask=None if mask is None else jnp.asarray(mask),
+        ))
+        t3 = _time.perf_counter()
+        self.stats["tokenize_s"] += t1 - t0
+        self.stats["pad_s"] += t2 - t1
+        self.stats["device_s"] += t3 - t2
+        self.stats["texts"] += len(texts)
+        self.stats["calls"] += 1
         return out[: len(texts)]
 
     def embed(self, text: str) -> np.ndarray:

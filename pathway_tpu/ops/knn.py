@@ -12,16 +12,20 @@ import functools
 
 import numpy as np
 
-_MATRIX_VERSION: dict[int, int] = {}
-_DEVICE_MATRIX: dict[int, object] = {}
-
-
 @functools.lru_cache(maxsize=1)
 def _jax():
     import jax
     import jax.numpy as jnp
 
     return jax, jnp
+
+
+def to_device(matrix: np.ndarray):
+    """Pin an index matrix on the accelerator once; callers cache the result
+    and pass it back to device_topk_scores so serving queries don't re-upload
+    the corpus (host->HBM transfer per query would dominate TPU latency)."""
+    jax, jnp = _jax()
+    return jax.device_put(matrix)
 
 
 @functools.lru_cache(maxsize=8)
@@ -35,6 +39,12 @@ def _scores_fn(metric: str):
         return mn @ qn
 
     @jax.jit
+    def cos_prenorm(m, q):
+        # matrix rows already L2-normalized (pinned once via to_device);
+        # per-query work is one (N,d)@(d,) matmul
+        return m @ (q / (jnp.linalg.norm(q) + 1e-12))
+
+    @jax.jit
     def dot(m, q):
         return m @ q
 
@@ -43,11 +53,13 @@ def _scores_fn(metric: str):
         # -(|m|^2 - 2 m.q + |q|^2); matmul form keeps the MXU busy
         return 2.0 * (m @ q) - jnp.sum(m * m, axis=1) - jnp.sum(q * q)
 
-    return {"cos": cos, "dot": dot, "l2sq": l2sq}[metric]
+    return {"cos": cos, "cos_prenorm": cos_prenorm, "dot": dot,
+            "l2sq": l2sq}[metric]
 
 
-def device_topk_scores(matrix: np.ndarray, query: np.ndarray, metric: str = "cos") -> np.ndarray:
-    """Full score vector computed on device (bf16 matmul, f32 accumulate)."""
+def device_topk_scores(matrix, query: np.ndarray, metric: str = "cos") -> np.ndarray:
+    """Full score vector computed on device.  `matrix` may be a host ndarray
+    or a device array previously pinned with to_device (zero-copy reuse)."""
     jax, jnp = _jax()
     m = jnp.asarray(matrix)
     q = jnp.asarray(query)

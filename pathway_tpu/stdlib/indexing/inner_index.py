@@ -146,7 +146,7 @@ class BruteForceKnn(InnerIndex):
 
             n_dev = self.mesh.shape[self.mesh_axis]
             bucket = ks.row_bucket(self.n, n_dev)
-            cache = self._device_cache
+            cache = (self._device_cache or {}).get("mesh")
             if not (
                 isinstance(cache, tuple) and cache[0] == ("mesh", bucket, self.n)
             ):
@@ -154,7 +154,8 @@ class BruteForceKnn(InnerIndex):
                     self.mesh, self.mesh_axis, self.matrix[: self.n], bucket
                 )
                 cache = (("mesh", bucket, self.n), dm)
-                self._device_cache = cache
+                self._device_cache = {**(self._device_cache or {}),
+                                      "mesh": cache}
             vals, idx = ks.sharded_topk_device(
                 self.mesh, self.mesh_axis, cache[1], q[None, :],
                 min(k, self.n), self.metric, self.n,
@@ -166,9 +167,23 @@ class BruteForceKnn(InnerIndex):
             ]
         if self.n >= self.device_threshold:
             try:
-                from ...ops.knn import device_topk_scores
+                from ...ops.knn import device_topk_scores, to_device
 
-                scores = device_topk_scores(self.matrix[: self.n], q, self.metric)
+                cache = (self._device_cache or {}).get("single")
+                token = ("single", self.n)
+                if not (isinstance(cache, tuple) and cache[0] == token):
+                    m = self.matrix[: self.n]
+                    if self.metric == "cos":
+                        # pre-normalize once per index version: serving
+                        # queries pay one matmul, not a 6MB renormalize
+                        m = m / (
+                            np.linalg.norm(m, axis=1, keepdims=True) + 1e-12
+                        )
+                    cache = (token, to_device(m))
+                    self._device_cache = {**(self._device_cache or {}),
+                                          "single": cache}
+                metric = "cos_prenorm" if self.metric == "cos" else self.metric
+                scores = device_topk_scores(cache[1], q, metric)
             except Exception:
                 scores = self._scores(q)
         else:
