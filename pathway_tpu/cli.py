@@ -28,11 +28,18 @@ def _spawn_once(program: list[str], threads: int, processes: int, first_port: in
     """
     import time
 
+    import secrets as _secrets
+
     env_base = dict(os.environ)
     env_base["PATHWAY_THREADS"] = str(threads)
     env_base["PATHWAY_PROCESSES"] = str(processes)
     env_base["PATHWAY_FIRST_PORT"] = str(first_port)
     env_base["PATHWAY_SPAWNED"] = "1"  # rescale exits only fire under a supervisor
+    # per-run shared secret: workers mutually authenticate fabric peers
+    # before accepting (pickle) frames
+    env_base["PATHWAY_FABRIC_SECRET"] = (
+        os.environ.get("PATHWAY_FABRIC_SECRET") or _secrets.token_hex(32)
+    )
     if processes == 1:
         env_base["PATHWAY_PROCESS_ID"] = "0"
         return subprocess.call(program, env=env_base)

@@ -60,6 +60,17 @@ def get_lib():
             ctypes.c_char_p, ctypes.c_uint64, ctypes.c_uint64,
             ctypes.POINTER(ctypes.c_uint64), ctypes.POINTER(ctypes.c_uint64),
         ]
+        # self-test against the Python mirror before adopting the native
+        # tier: a stale/foreign .so (e.g. a copied workdir) must never become
+        # the canonical row-key hash
+        hi = ctypes.c_uint64()
+        lo = ctypes.c_uint64()
+        probe = b"pw-native-selftest\x00\x01\x02"
+        lib.pw_hash128(probe, len(probe), 12345,
+                       ctypes.byref(hi), ctypes.byref(lo))
+        if ((hi.value << 64) | lo.value) != _py_hash128(probe, 12345):
+            _build_failed = True
+            return None
         lib.pw_hash_rows.restype = None
         lib.pw_hash_rows.argtypes = [
             ctypes.c_uint64, ctypes.c_uint64,

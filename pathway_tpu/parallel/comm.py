@@ -26,6 +26,9 @@ Connection protocol: i dials every j < i; accepts from every j > i.
 
 from __future__ import annotations
 
+import hmac
+import logging
+import os
 import pickle
 import queue
 import socket
@@ -36,6 +39,17 @@ from collections import defaultdict
 from typing import Any
 
 _LEN = struct.Struct("<I")
+
+# Per-run shared secret for peer authentication (the spawner generates one
+# and passes it via env).  The fabric unpickles frames from its peers; on a
+# multi-user host an unauthenticated listener would hand arbitrary-code
+# pickle execution to any local process that can dial the port.
+_SECRET_ENV = "PATHWAY_FABRIC_SECRET"
+
+
+def _fabric_secret() -> bytes | None:
+    s = os.environ.get(_SECRET_ENV)
+    return s.encode() if s else None
 
 
 class FabricError(RuntimeError):
@@ -60,6 +74,14 @@ class Fabric:
         self._ctl: "queue.Queue[Any]" = queue.Queue()
         self._dead: str | None = None
         self._closed = False
+        self._secret = _fabric_secret()
+        if self._secret is None:
+            logging.getLogger(__name__).warning(
+                "%s not set: fabric peers are UNAUTHENTICATED; any local "
+                "process can deliver pickle payloads to the worker mesh "
+                "(the `spawn` supervisor sets the secret automatically)",
+                _SECRET_ENV,
+            )
         self._connect(host, first_port, connect_timeout_s)
         self._threads = []
         for peer, sock in self._socks.items():
@@ -90,16 +112,56 @@ class Fabric:
         dial_to = [p for p in self.peers if p < self.pid]
         accepted: dict[int, socket.socket] = {}
 
+        def recv_exact(conn, n: int) -> bytes:
+            out = b""
+            while len(out) < n:
+                chunk = conn.recv(n - len(out))
+                if not chunk:
+                    raise FabricError("peer hung up during handshake")
+                out += chunk
+            return out
+
+        def handshake_accept(conn) -> int:
+            """Returns the authenticated peer pid or raises FabricError."""
+            hello = recv_exact(conn, 4)
+            peer = int.from_bytes(hello, "little")
+            if self._secret is not None:
+                # mutual HMAC handshake: dialer proves knowledge of the
+                # run secret before any pickle frame is accepted, and
+                # the reply (bound to the dialer's nonce) proves ours
+                nonce_d = recv_exact(conn, 16)
+                tag_d = recv_exact(conn, 32)
+                want = hmac.new(
+                    self._secret, b"pw-dial" + hello + nonce_d, "sha256"
+                ).digest()
+                if not hmac.compare_digest(tag_d, want):
+                    raise FabricError(
+                        "fabric handshake rejected: bad peer credential"
+                    )
+                nonce_a = os.urandom(16)
+                tag_a = hmac.new(
+                    self._secret, b"pw-acpt" + nonce_d + nonce_a, "sha256"
+                ).digest()
+                conn.sendall(nonce_a + tag_a)
+            return peer
+
         def do_accept():
-            for _ in accept_from:
+            # a failed handshake (attacker / port scanner / crashed dialer)
+            # must not consume a peer slot or kill the acceptor — close it
+            # and keep listening for the real peers
+            while len(accepted) < len(accept_from):
                 conn, _addr = listener.accept()
-                hello = b""
-                while len(hello) < 4:
-                    chunk = conn.recv(4 - len(hello))
-                    if not chunk:
-                        raise FabricError("peer hung up during handshake")
-                    hello += chunk
-                peer = int.from_bytes(hello, "little")
+                try:
+                    peer = handshake_accept(conn)
+                except (FabricError, OSError) as exc:
+                    logging.getLogger(__name__).warning(
+                        "fabric: dropped unauthenticated connection: %s", exc
+                    )
+                    try:
+                        conn.close()
+                    except OSError:
+                        pass
+                    continue
                 conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
                 accepted[peer] = conn
 
@@ -119,7 +181,25 @@ class Fabric:
                         raise FabricError(f"cannot reach peer {peer}")
                     _time.sleep(0.1)
             sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-            sock.sendall(self.pid.to_bytes(4, "little"))
+            pid_bytes = self.pid.to_bytes(4, "little")
+            if self._secret is not None:
+                nonce_d = os.urandom(16)
+                tag_d = hmac.new(
+                    self._secret, b"pw-dial" + pid_bytes + nonce_d, "sha256"
+                ).digest()
+                sock.sendall(pid_bytes + nonce_d + tag_d)
+                reply = recv_exact(sock, 48)
+                nonce_a, tag_a = reply[:16], reply[16:]
+                want = hmac.new(
+                    self._secret, b"pw-acpt" + nonce_d + nonce_a, "sha256"
+                ).digest()
+                if not hmac.compare_digest(tag_a, want):
+                    raise FabricError(
+                        "fabric handshake rejected: listener failed to "
+                        "prove the run secret"
+                    )
+            else:
+                sock.sendall(pid_bytes)
             self._socks[peer] = sock
         if acceptor is not None:
             acceptor.join(timeout_s)

@@ -317,8 +317,66 @@ class Config:
 # Journal format history: v1 (round 1) keyed primary-key rows off raw
 # uncoerced connector values; v2 keys off coerced typed values.  Replaying a
 # journal written under a different keying would silently duplicate rows, so
-# a mismatched journal is discarded (clean re-ingest) with a warning.
+# a mismatched journal must be cleared before re-ingest — but clearing is
+# data loss for sources whose upstream history is gone (expired Kafka
+# retention), so it requires explicit opt-in and archives instead of deleting.
 _JOURNAL_FORMAT_VERSION = 2
+_MIGRATION_ENV = "PATHWAY_ALLOW_JOURNAL_MIGRATION"
+
+
+def _migrate_journal_format(backend, streams, ver, nprocs, pid) -> None:
+    """Archive (never delete) old-format journal streams, opt-in only.
+
+    Cluster mode: only pid 0 performs the archive; peers wait for the version
+    stamp to flip so concurrent processes never race the rewrite."""
+    import logging
+    import time as _t
+
+    log = logging.getLogger(__name__)
+    if os.environ.get(_MIGRATION_ENV, "") != "1":
+        # every process raises the actionable message immediately — peers
+        # must not sit in the wait loop when pid 0 is guaranteed to refuse
+        raise RuntimeError(
+            f"persistence journal format v{ver} is incompatible with current "
+            f"v{_JOURNAL_FORMAT_VERSION}. Replaying it would corrupt state, "
+            f"and discarding it loses any history the sources no longer "
+            f"serve. Set {_MIGRATION_ENV}=1 to archive the old journal "
+            "(streams are renamed, not deleted) and re-ingest from sources, "
+            "or clear the persistence storage manually."
+        )
+    if nprocs > 1 and pid != 0:
+        deadline = _t.monotonic() + 60.0
+        while _t.monotonic() < deadline:
+            cur = backend.get_metadata("journal_format")
+            try:
+                if cur and int(cur) == _JOURNAL_FORMAT_VERSION:
+                    return
+            except ValueError:
+                pass
+            _t.sleep(0.1)
+        raise RuntimeError(
+            f"persistence journal format v{ver} needs migration but process "
+            "0 did not complete it within 60s"
+        )
+    if not hasattr(backend, "replace_all"):
+        raise RuntimeError(
+            f"persistence journal format v{ver} is incompatible with "
+            f"current v{_JOURNAL_FORMAT_VERSION} and this backend cannot "
+            "rewrite streams; clear the persistence storage manually"
+        )
+    log.warning(
+        "persistence journal format v%s != current v%s: archiving journal "
+        "under 'archived_v%s__*' and re-ingesting from sources",
+        ver, _JOURNAL_FORMAT_VERSION, ver,
+    )
+    for s in streams:
+        records = backend.read_all(s)
+        if not records:
+            continue
+        archive = f"archived_v{ver}__{s}"
+        for rec in records:
+            backend.append(archive, rec)
+        backend.replace_all(s, [])
 
 
 def attach_persistence(runner, config: Config) -> None:
@@ -331,6 +389,8 @@ def attach_persistence(runner, config: Config) -> None:
     if backend is None:
         return
     lg = runner.lg
+    nprocs = getattr(runner, "nprocs", 1)
+    pid = getattr(runner, "pid", 0)
     streams: list[str] = []
     for idx, (_op, source) in enumerate(lg.input_ops):
         base = _stream_name(idx, source)
@@ -349,21 +409,7 @@ def attach_persistence(runner, config: Config) -> None:
     else:
         ver = _JOURNAL_FORMAT_VERSION
     if ver != _JOURNAL_FORMAT_VERSION:
-        if not hasattr(backend, "replace_all"):
-            raise RuntimeError(
-                f"persistence journal format v{ver} is incompatible with "
-                f"current v{_JOURNAL_FORMAT_VERSION} and this backend cannot "
-                "discard streams; clear the persistence storage manually"
-            )
-        import logging
-
-        logging.getLogger(__name__).warning(
-            "persistence journal format v%s != current v%s: discarding "
-            "journal and re-ingesting from sources",
-            ver, _JOURNAL_FORMAT_VERSION,
-        )
-        for s in streams:
-            backend.replace_all(s, [])
+        _migrate_journal_format(backend, streams, ver, nprocs, pid)
     backend.put_metadata("journal_format", str(_JOURNAL_FORMAT_VERSION).encode())
     # cluster awareness: each worker process journals ONLY the events it owns
     # into its own per-process stream; replay is the UNION of all processes'
@@ -371,8 +417,6 @@ def attach_persistence(runner, config: Config) -> None:
     # elastic rescaling, where the shard->process assignment changes
     # (reference: per-worker input snapshots redistributed by the metadata
     # tracker, src/persistence/tracker.rs:51-275)
-    nprocs = getattr(runner, "nprocs", 1)
-    pid = getattr(runner, "pid", 0)
     owns_event = getattr(runner, "owns_event", None)
     # operator snapshots (O(state) restart): enabled with an interval or the
     # explicit mode (reference: PersistenceMode::OperatorPersisting)
@@ -407,6 +451,12 @@ def attach_persistence(runner, config: Config) -> None:
                 last_offsets = dict(so)
         n_records = 0
         folded = snap.get("journal_seqs", {}) if snap is not None else {}
+        # per-key counts of events folded into restored operator state: a
+        # static source's live events covered by these counts must NOT be
+        # re-injected (they are already inside the snapshot)
+        from collections import Counter
+
+        fold_counts: Counter = Counter()
         for rs in read_streams:
             fold_seq = folded.get(rs, -1)
             keep_raw: list[bytes] = []
@@ -416,6 +466,8 @@ def attach_persistence(runner, config: Config) -> None:
                 seq, events, offsets = _parse_record(rec, i)
                 max_seq = max(max_seq, seq)
                 if seq <= fold_seq:
+                    for e in events:
+                        fold_counts[e[1]] += 1
                     continue  # folded into the restored operator state
                 n_records += 1
                 keep_raw.append(rec)
@@ -463,6 +515,8 @@ def attach_persistence(runner, config: Config) -> None:
             owns_event=owns_event if nprocs > 1 else None,
             is_replay_injector=(pid == 0 or nprocs <= 1),
             seq_holder=journal_seqs,
+            folded_counts=fold_counts,
+            min_time=snap["frontier"] if snap is not None else None,
         )
     if snapshots_on:
         from .snapshots import SnapshotManager
@@ -523,13 +577,21 @@ def _wrap_source_with_persistence(source, backend: Backend, stream: str,
                                   replayed: list, last_offsets,
                                   owns_event=None,
                                   is_replay_injector: bool = True,
-                                  seq_holder: dict | None = None) -> None:
+                                  seq_holder: dict | None = None,
+                                  folded_counts=None,
+                                  min_time=None) -> None:
     """`owns_event` (cluster mode) filters what THIS process journals, so the
     union of all processes' streams is exactly one copy of the input.
     `is_replay_injector` gates live-source replay to a single process —
     live events are injected exclusively (shipped to owners), so exactly one
     process may replay them.  `seq_holder[stream]` tracks the last journal
-    sequence number written (operator-snapshot watermarks)."""
+    sequence number written (operator-snapshot watermarks).
+
+    After an operator-snapshot restore, `folded_counts` carries per-key
+    counts of journal events already folded into restored operator state
+    (they must not be re-injected), and `min_time` is the restored frontier:
+    any surviving replay/fresh event at a time at or below it is re-timed to
+    `min_time + 1` so push_input's time > frontier invariant holds."""
     orig_static = source.static_events
     orig_poll = source.poll
     if seq_holder is None:
@@ -555,21 +617,36 @@ def _wrap_source_with_persistence(source, backend: Backend, stream: str,
         if events or offsets is not None:
             _append(events, offsets)
 
+    def _retime(events):
+        # post-snapshot-restore, no event may land at or below the restored
+        # frontier (push_input requires time > frontier)
+        if min_time is None or min_time < 0:
+            return events
+        return [
+            (t, k, row, d) if t > min_time else (min_time + 1, k, row, d)
+            for (t, k, row, d) in events
+        ]
+
     def static_events():
         live = orig_static()
-        if not replayed:
+        if not replayed and not folded_counts:
             if live:
                 _journal(live)
-            return live
+            return _retime(live)
         # resumed run over a static source that may have grown: per key, the
         # journal already covers the first count_j(k) live events (static
         # sources replay their event log in a stable order), so only events
         # beyond that prefix are fresh.  This re-ingests a legitimately
         # re-added key after an add+retract pair (live count 3 > journaled 2)
-        # without re-journaling net-zero pairs on every resume.
+        # without re-journaling net-zero pairs on every resume.  Events
+        # folded into a restored operator snapshot count toward the journal
+        # prefix but are NOT returned — their effect is already in the
+        # restored state.
         from collections import Counter
 
         jcount = Counter(e[1] for e in replayed)
+        if folded_counts:
+            jcount.update(folded_counts)
         seen_now: Counter = Counter()
         fresh = []
         for e in live:
@@ -578,7 +655,7 @@ def _wrap_source_with_persistence(source, backend: Backend, stream: str,
                 fresh.append(e)
         if fresh:
             _journal(fresh)
-        return replayed + fresh
+        return _retime(replayed + fresh)
 
     def journaling_poll():
         events = orig_poll()

@@ -266,9 +266,11 @@ def test_persistence_no_rejournal_of_net_zero(tmp_path):
     assert net.get(1, 0) == 0 and net.get(2, 0) == 1
 
 
-def test_journal_version_mismatch_discards(tmp_path):
+def test_journal_version_mismatch_discards(tmp_path, monkeypatch):
+    """A v1 journal blocks startup until the migration opt-in is set; with
+    it, the stale stream is archived (ADVICE r2: never silently deleted)."""
     from pathway_tpu.persistence import (
-        Backend, Config, attach_persistence, _stream_name,
+        _MIGRATION_ENV, Backend, Config, attach_persistence, _stream_name,
     )
     import pickle
 
@@ -295,21 +297,27 @@ def test_journal_version_mismatch_discards(tmp_path):
     backend.put_metadata("journal_format", b"1")
     r = FakeRunner()
     r.lg = type("LG", (), {"input_ops": [(None, src)]})()
+    monkeypatch.delenv(_MIGRATION_ENV, raising=False)
+    with pytest.raises(RuntimeError, match="incompatible"):
+        attach_persistence(r, Config(backend))
+    monkeypatch.setenv(_MIGRATION_ENV, "1")
     attach_persistence(r, Config(backend))
     events = src.static_events()
     keys = {e[1] for e in events}
-    assert 9 not in keys  # stale v1 journal discarded
+    assert 9 not in keys  # stale v1 journal discarded from the live stream
     assert 5 in keys
     assert backend.get_metadata("journal_format") == b"2"
+    # ... but archived, not destroyed
+    assert backend.streams[f"archived_v1__{stream}"]
 
 
-def test_unversioned_journal_treated_as_v1():
-    """Round-1 journals carry no version stamp; they must be discarded, not
-    replayed under v2 keying."""
+def test_unversioned_journal_treated_as_v1(monkeypatch):
+    """Round-1 journals carry no version stamp; they must never replay under
+    v2 keying — startup fails until the migration opt-in archives them."""
     import pickle
 
     from pathway_tpu.persistence import (
-        Backend, Config, attach_persistence, _stream_name,
+        _MIGRATION_ENV, Backend, Config, attach_persistence, _stream_name,
     )
 
     class FakeSource:
@@ -331,7 +339,12 @@ def test_unversioned_journal_treated_as_v1():
     # no journal_format metadata: round-1 layout
     r = type("R", (), {})()
     r.lg = type("LG", (), {"input_ops": [(None, src)]})()
+    monkeypatch.delenv(_MIGRATION_ENV, raising=False)
+    with pytest.raises(RuntimeError, match="incompatible"):
+        attach_persistence(r, Config(backend))
+    monkeypatch.setenv(_MIGRATION_ENV, "1")
     attach_persistence(r, Config(backend))
     keys = {e[1] for e in src.static_events()}
     assert 9 not in keys and 5 in keys
     assert backend.get_metadata("journal_format") == b"2"
+    assert backend.streams[f"archived_v1__{stream}"]

@@ -1,0 +1,213 @@
+"""Regression tests for the round-2 advisor findings (ADVICE.md r2):
+
+1. (high) operator-snapshot restore over a pipeline mixing static + live
+   sources must not re-inject static events already folded into the snapshot
+   (crash: "input at time 0 but frontier already at 2" / silent double count)
+2. (med) the native library must pass a hash self-test before adoption
+3. (med) fabric peers must authenticate with the per-run shared secret
+4. (low) journal-format migration requires explicit opt-in and archives
+   instead of deleting
+"""
+
+import json
+import socket
+import threading
+import time
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.internals import parse_graph as pg
+
+
+def _squash_jsonl(path):
+    state = {}
+    for ln in path.read_text().strip().splitlines():
+        if not ln:
+            continue
+        e = json.loads(ln)
+        key = tuple(
+            sorted((k, v) for k, v in e.items() if k not in ("diff", "time"))
+        )
+        state[key] = state.get(key, 0) + e["diff"]
+    return {k: m for k, m in state.items() if m}
+
+
+def _run_mixed(src_path, out_live, out_static, backend, timeout_s):
+    """A pipeline with BOTH a static source and a live streaming source."""
+    pg.G.clear()
+
+    class S(pw.Schema):
+        word: str
+
+    static_t = pw.debug.table_from_rows(S, [("s1",), ("s2",), ("s1",)])
+    sc = static_t.groupby(static_t.word).reduce(
+        static_t.word, c=pw.reducers.count()
+    )
+    pw.io.jsonlines.write(sc, str(out_static))
+
+    live = pw.io.csv.read(str(src_path), schema=S, mode="streaming")
+    lc = live.groupby(live.word).reduce(live.word, c=pw.reducers.count())
+    pw.io.jsonlines.write(lc, str(out_live))
+
+    pw.run(
+        persistence_config=pw.persistence.Config(
+            backend, snapshot_interval_ms=250
+        ),
+        timeout_s=timeout_s,
+        autocommit_duration_ms=20,
+        monitoring_level=pw.MonitoringLevel.NONE,
+    )
+
+
+def test_snapshot_restart_with_static_source(tmp_path):
+    """ADVICE r2 #1: restart of a static+live pipeline with snapshots on
+    must neither crash on the frontier invariant nor double-count the
+    static rows folded into the restored snapshot."""
+    src = tmp_path / "w.csv"
+    out_live = tmp_path / "live.jsonl"
+    out_static = tmp_path / "static.jsonl"
+    pdir = tmp_path / "ps"
+
+    src.write_text("word\n" + "\n".join(["a"] * 4 + ["b"] * 2) + "\n")
+    backend = pw.persistence.Backend.filesystem(str(pdir))
+    _run_mixed(src, out_live, out_static, backend, timeout_s=1.2)
+    assert backend.get_metadata("opsnapshot_p0"), "no snapshot written"
+
+    first_static = _squash_jsonl(out_static)
+    assert first_static == {
+        (("c", 2), ("word", "s1")): 1,
+        (("c", 1), ("word", "s2")): 1,
+    }
+
+    # phase 2: append live rows and restart over the same persistence dir
+    with open(src, "a") as f:
+        f.write("a\nc\n")
+    backend2 = pw.persistence.Backend.filesystem(str(pdir))
+    _run_mixed(src, out_live, out_static, backend2, timeout_s=1.2)
+
+    # live counts advanced; static counts unchanged (no re-injection)
+    assert _squash_jsonl(out_live) == {
+        (("c", 5), ("word", "a")): 1,
+        (("c", 2), ("word", "b")): 1,
+        (("c", 1), ("word", "c")): 1,
+    }
+    assert _squash_jsonl(out_static) == first_static
+
+
+def test_native_selftest_guards_adoption():
+    """The native tier only activates after pw_hash128 matches the Python
+    mirror on a probe — and when active, the two stay bit-identical."""
+    from pathway_tpu import native
+
+    if not native.available():
+        pytest.skip("no native toolchain")
+    data = b"the quick brown fox"
+    assert native.hash128(data, 7) == native._py_hash128(data, 7)
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_fabric_rejects_unauthenticated_peer(monkeypatch):
+    """ADVICE r2 #3: with the run secret set, a raw local connection that
+    cannot produce the HMAC credential must be rejected."""
+    from pathway_tpu.parallel.comm import Fabric, FabricError
+
+    monkeypatch.setenv("PATHWAY_FABRIC_SECRET", "s3cr3t-run-token")
+    port = _free_port()
+    errs = []
+
+    def accept_side():
+        try:
+            Fabric(0, 2, port, connect_timeout_s=5.0)
+        except FabricError as exc:
+            errs.append(exc)
+
+    th = threading.Thread(target=accept_side, daemon=True)
+    th.start()
+    time.sleep(0.3)
+    # attacker: correct pid header, garbage credential
+    atk = socket.socket()
+    atk.connect(("127.0.0.1", port))
+    atk.sendall((1).to_bytes(4, "little") + b"\x00" * 48)
+    th.join(timeout=10)
+    assert errs and "handshake" in str(errs[0]) or "peers connected" in str(errs[0])
+    atk.close()
+
+
+def test_fabric_mutual_auth_mesh_forms(monkeypatch):
+    """With the same secret on both sides, the mesh forms and carries data."""
+    from pathway_tpu.parallel.comm import Fabric
+
+    monkeypatch.setenv("PATHWAY_FABRIC_SECRET", "another-run-token")
+    port = _free_port()
+    out = {}
+
+    def side(pid):
+        f = Fabric(pid, 2, port, connect_timeout_s=10.0)
+        out[pid] = f
+
+    threads = [threading.Thread(target=side, args=(p,)) for p in (0, 1)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=15)
+    assert set(out) == {0, 1}
+    out[0].send_data(1, 3, 0, 0, 0, 0, [("k", ("row",), 1)])
+    deadline = time.monotonic() + 5
+    got = []
+    while time.monotonic() < deadline and not got:
+        got = out[1].take_data(3, 0)
+        time.sleep(0.02)
+    assert got and got[0][4] == [("k", ("row",), 1)]
+    for f in out.values():
+        f.close()
+
+
+def test_journal_migration_requires_opt_in(monkeypatch):
+    """ADVICE r2 #4: a v1 journal is never silently destroyed — without the
+    env opt-in the run fails; with it, streams are archived then cleared."""
+    from pathway_tpu.persistence import (
+        _MIGRATION_ENV, _migrate_journal_format, MockBackend,
+    )
+
+    backend = MockBackend()
+    backend.streams["input_0_x"] = [b"rec1", b"rec2"]
+    monkeypatch.delenv(_MIGRATION_ENV, raising=False)
+    with pytest.raises(RuntimeError, match="opt-in|archive|incompatible"):
+        _migrate_journal_format(backend, ["input_0_x"], 1, 1, 0)
+    assert backend.streams["input_0_x"] == [b"rec1", b"rec2"]
+
+    monkeypatch.setenv(_MIGRATION_ENV, "1")
+    _migrate_journal_format(backend, ["input_0_x"], 1, 1, 0)
+    assert backend.streams["input_0_x"] == []
+    assert backend.streams["archived_v1__input_0_x"] == [b"rec1", b"rec2"]
+
+
+def test_journal_migration_peer_waits_for_pid0(monkeypatch):
+    """Cluster mode: with the opt-in granted, a non-zero pid waits for the
+    coordinator's stamp instead of racing the archive rewrite."""
+    from pathway_tpu.persistence import (
+        _JOURNAL_FORMAT_VERSION, _MIGRATION_ENV, _migrate_journal_format,
+        MockBackend,
+    )
+
+    monkeypatch.setenv(_MIGRATION_ENV, "1")
+    backend = MockBackend()
+
+    def stamp_later():
+        time.sleep(0.3)
+        backend.put_metadata(
+            "journal_format", str(_JOURNAL_FORMAT_VERSION).encode()
+        )
+
+    th = threading.Thread(target=stamp_later, daemon=True)
+    th.start()
+    _migrate_journal_format(backend, [], 1, nprocs=2, pid=1)  # returns
+    th.join()
