@@ -73,14 +73,7 @@ def forward_logits(params: dict, cfg: DecoderConfig, token_ids: jax.Array) -> ja
     T = token_ids.shape[1]
     x = x + params["pos_embed"].astype(dtype)[:T][None, :, :]
     eps = cfg.ln_eps
-
-    def act(v):
-        if cfg.act == "gelu":
-            return jax.nn.gelu(v, approximate=False)
-        if cfg.act == "gelu_tanh":
-            return jax.nn.gelu(v, approximate=True)
-        return jax.nn.relu(v)
-
+    act = _act_fn(cfg)
     for layer in params["layers"]:
         h = _layer_norm(x, layer["ln1_scale"], layer["ln1_bias"], eps)
         x = x + _causal_attention(layer, h, cfg.n_heads)
@@ -89,6 +82,96 @@ def forward_logits(params: dict, cfg: DecoderConfig, token_ids: jax.Array) -> ja
         x = x + _proj(layer, ff, "w_down", "b_down")
     x = _layer_norm(x, params["ln_f_scale"], params["ln_f_bias"], eps)
     return (x @ params["embed"].astype(x.dtype).T).astype(jnp.float32)
+
+
+def prefill(params: dict, cfg: DecoderConfig, token_ids: jax.Array,
+            n_valid: jax.Array):
+    """Full-context forward over the (padded) prompt, emitting the KV cache
+    and the logits at position n_valid-1 (the next-token distribution).
+
+    One O(T^2) pass at prompt time; every generated token after it is O(T)
+    against the cache (reference serving path: xpacks/llm/llms.py calls an
+    external API per completion — here the whole loop is on-device)."""
+    from .encoder import _proj
+
+    dtype = _resolve_dtype(cfg.dtype)
+    B, T = token_ids.shape
+    H = cfg.n_heads
+    hd = cfg.d_model // H
+    x = params["embed"].astype(dtype)[token_ids]
+    x = x + params["pos_embed"].astype(dtype)[:T][None, :, :]
+    eps = cfg.ln_eps
+    act = _act_fn(cfg)
+    causal = jnp.tril(jnp.ones((T, T), bool))
+    cache = []
+    for layer in params["layers"]:
+        h = _layer_norm(x, layer["ln1_scale"], layer["ln1_bias"], eps)
+        q = _proj(layer, h, "wq", "bq").reshape(B, T, H, hd)
+        k = _proj(layer, h, "wk", "bk").reshape(B, T, H, hd)
+        v = _proj(layer, h, "wv", "bv").reshape(B, T, H, hd)
+        cache.append({"k": k, "v": v})
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(hd)
+        scores = jnp.where(causal[None, None, :, :], scores, -1e9)
+        probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(h.dtype)
+        a = jnp.einsum("bhqk,bkhd->bqhd", probs, v).reshape(B, T, cfg.d_model)
+        x = x + _proj(layer, a, "wo", "bo")
+        h = _layer_norm(x, layer["ln2_scale"], layer["ln2_bias"], eps)
+        ff = act(_proj(layer, h, "w_up", "b_up"))
+        x = x + _proj(layer, ff, "w_down", "b_down")
+    x = _layer_norm(x, params["ln_f_scale"], params["ln_f_bias"], eps)
+    last = jnp.take_along_axis(
+        x, (n_valid - 1)[:, None, None].astype(jnp.int32), axis=1
+    )[:, 0, :]
+    logits = (last @ params["embed"].astype(last.dtype).T).astype(jnp.float32)
+    return logits, cache
+
+
+def decode_step(params: dict, cfg: DecoderConfig, cache: list[dict],
+                token: jax.Array, pos: jax.Array):
+    """One incremental token: (B,) token ids at position `pos` -> (B, V)
+    logits + updated cache.  Attention reads the cache rows <= pos only."""
+    from .encoder import _proj
+
+    dtype = _resolve_dtype(cfg.dtype)
+    B = token.shape[0]
+    H = cfg.n_heads
+    hd = cfg.d_model // H
+    T = cache[0]["k"].shape[1]
+    x = params["embed"].astype(dtype)[token][:, None, :]  # (B, 1, D)
+    x = x + jax.lax.dynamic_slice_in_dim(
+        params["pos_embed"].astype(dtype), pos, 1, axis=0
+    )[None, :, :]
+    eps = cfg.ln_eps
+    act = _act_fn(cfg)
+    valid = (jnp.arange(T) <= pos)[None, None, None, :]  # (1,1,1,T)
+    new_cache = []
+    for layer, kv in zip(params["layers"], cache):
+        h = _layer_norm(x, layer["ln1_scale"], layer["ln1_bias"], eps)
+        q = _proj(layer, h, "wq", "bq").reshape(B, 1, H, hd)
+        k1 = _proj(layer, h, "wk", "bk").reshape(B, 1, H, hd)
+        v1 = _proj(layer, h, "wv", "bv").reshape(B, 1, H, hd)
+        k = jax.lax.dynamic_update_slice_in_dim(kv["k"], k1, pos, axis=1)
+        v = jax.lax.dynamic_update_slice_in_dim(kv["v"], v1, pos, axis=1)
+        new_cache.append({"k": k, "v": v})
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(hd)
+        scores = jnp.where(valid, scores, -1e9)
+        probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(h.dtype)
+        a = jnp.einsum("bhqk,bkhd->bqhd", probs, v).reshape(B, 1, cfg.d_model)
+        x = x + _proj(layer, a, "wo", "bo")
+        h = _layer_norm(x, layer["ln2_scale"], layer["ln2_bias"], eps)
+        ff = act(_proj(layer, h, "w_up", "b_up"))
+        x = x + _proj(layer, ff, "w_down", "b_down")
+    x = _layer_norm(x, params["ln_f_scale"], params["ln_f_bias"], eps)
+    logits = (x[:, 0, :] @ params["embed"].astype(x.dtype).T).astype(jnp.float32)
+    return logits, new_cache
+
+
+def _act_fn(cfg):
+    if cfg.act == "gelu":
+        return lambda v: jax.nn.gelu(v, approximate=False)
+    if cfg.act == "gelu_tanh":
+        return lambda v: jax.nn.gelu(v, approximate=True)
+    return jax.nn.relu
 
 
 def lm_loss(params: dict, cfg: DecoderConfig, token_ids: jax.Array,
@@ -124,12 +207,13 @@ def init_opt_state(params):
 
 
 class JaxDecoderLM:
-    """Host-facing text generator.
+    """Host-facing text generator with a static-shape KV cache.
 
-    Greedy decoding over a FIXED padded shape per bucket: causal attention
-    ignores positions after the cursor, so padding the tail keeps results
-    exact while XLA compiles once per bucket instead of once per token.
-    """
+    The prompt runs once through `prefill` (O(T^2), one compile per bucket);
+    each generated token then runs `decode_step` — O(T) attention against
+    the cached keys/values, with the cache donated so XLA updates it in
+    place.  Bucketed shapes keep compilation one-per-bucket, per the TPU
+    static-shape rule."""
 
     def __init__(self, cfg: DecoderConfig | None = None, seed: int = 0,
                  seq_buckets=(64, 256, 1024), params: dict | None = None,
@@ -147,12 +231,17 @@ class JaxDecoderLM:
         self.seq_buckets = [b for b in seq_buckets if b <= self.cfg.max_len] or [
             self.cfg.max_len
         ]
+        _cfg = self.cfg
 
-        def next_token(params, token_ids, pos):
-            logits = forward_logits(params, self.cfg, token_ids)
-            return jnp.argmax(logits[0, pos])
+        def _prefill_fn(params, token_ids, n_valid):
+            return prefill(params, _cfg, token_ids, n_valid)
 
-        self._next_token = jax.jit(next_token)
+        def _step_fn(params, cache, token, pos):
+            return decode_step(params, _cfg, cache, token, pos)
+
+        self._prefill = jax.jit(_prefill_fn)
+        # cache donated: each step consumes the previous cache buffers in place
+        self._step = jax.jit(_step_fn, donate_argnums=(1,))
 
     @classmethod
     def from_hf(cls, model_name_or_path: str, **kwargs) -> "JaxDecoderLM":
@@ -173,24 +262,36 @@ class JaxDecoderLM:
                 return b
         return self.seq_buckets[-1]
 
-    def generate(self, prompt: str, max_new_tokens: int = 32) -> str:
+    def generate(self, prompt: str, max_new_tokens: int = 32,
+                 stop_token: int | None = None) -> str:
         ids = self.tokenizer.encode(prompt)
         keep = self.cfg.max_len - max_new_tokens
         ids = ids[-max(keep, 1):] or [4]
         L = self._bucket(len(ids) + max_new_tokens)
+        if len(ids) + max_new_tokens > L:
+            # largest bucket smaller than prompt+completion: keep the most
+            # recent context that still leaves room for every new token
+            ids = ids[-max(L - max_new_tokens, 1):]
+        n = len(ids)
         buf = np.zeros((1, L), np.int32)
-        n = min(len(ids), L)
-        buf[0, :n] = ids[-n:]  # most recent context wins when truncating
-        out = []
-        for _ in range(max_new_tokens):
-            nxt = int(self._next_token(self.params, jnp.asarray(buf), n - 1))
-            out.append(nxt)
-            if n < L:
-                buf[0, n] = nxt
-                n += 1
-            else:
-                buf[0, :-1] = buf[0, 1:]
-                buf[0, -1] = nxt
+        buf[0, :n] = ids
+        logits, kv = self._prefill(
+            self.params, token_ids=jnp.asarray(buf),
+            n_valid=jnp.asarray([n], jnp.int32),
+        )
+        out = [int(jnp.argmax(logits[0]))]
+        for _ in range(max_new_tokens - 1):
+            nxt = out[-1]
+            if stop_token is not None and nxt == stop_token:
+                break
+            if n >= L:
+                break
+            logits, kv = self._step(
+                self.params, kv, jnp.asarray([nxt], jnp.int32),
+                jnp.asarray(n, jnp.int32),
+            )
+            n += 1
+            out.append(int(jnp.argmax(logits[0])))
         if hasattr(self.tokenizer, "decode"):
             return self.tokenizer.decode(out)
         return " ".join(f"<{t}>" for t in out)

@@ -151,8 +151,12 @@ class Fabric:
             # and keep listening for the real peers
             while len(accepted) < len(accept_from):
                 conn, _addr = listener.accept()
+                # handshake under its own timeout: an idle connection must
+                # not stall the acceptor (that would be a trivial DoS)
+                conn.settimeout(10.0)
                 try:
                     peer = handshake_accept(conn)
+                    conn.settimeout(None)
                 except (FabricError, OSError) as exc:
                     logging.getLogger(__name__).warning(
                         "fabric: dropped unauthenticated connection: %s", exc
@@ -187,8 +191,10 @@ class Fabric:
                 tag_d = hmac.new(
                     self._secret, b"pw-dial" + pid_bytes + nonce_d, "sha256"
                 ).digest()
+                sock.settimeout(10.0)  # a silent listener must not hang us
                 sock.sendall(pid_bytes + nonce_d + tag_d)
                 reply = recv_exact(sock, 48)
+                sock.settimeout(None)
                 nonce_a, tag_a = reply[:16], reply[16:]
                 want = hmac.new(
                     self._secret, b"pw-acpt" + nonce_d + nonce_a, "sha256"

@@ -374,8 +374,10 @@ def _migrate_journal_format(backend, streams, ver, nprocs, pid) -> None:
         if not records:
             continue
         archive = f"archived_v{ver}__{s}"
-        for rec in records:
-            backend.append(archive, rec)
+        # idempotent: a crash between archive-write and source-clear leaves
+        # the archive complete, and a retry rewrites (not appends) it
+        if not backend.read_all(archive):
+            backend.replace_all(archive, records)
         backend.replace_all(s, [])
 
 

@@ -137,7 +137,9 @@ def test_fabric_rejects_unauthenticated_peer(monkeypatch):
     atk.connect(("127.0.0.1", port))
     atk.sendall((1).to_bytes(4, "little") + b"\x00" * 48)
     th.join(timeout=10)
-    assert errs and "handshake" in str(errs[0]) or "peers connected" in str(errs[0])
+    assert errs and (
+        "handshake" in str(errs[0]) or "peers connected" in str(errs[0])
+    )
     atk.close()
 
 

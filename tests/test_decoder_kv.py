@@ -1,0 +1,121 @@
+"""KV-cached incremental decoding (VERDICT r2 item 2).
+
+The cached path must be token-for-token identical to full-context
+recomputation, and a decode step must cost O(T) (not O(T^2)) — asserted
+as a wall-clock ratio at context 512.
+"""
+
+import time
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pathway_tpu.models.decoder import (
+    DecoderConfig, JaxDecoderLM, decode_step, forward_logits,
+    init_decoder_params, prefill,
+)
+
+import jax
+
+_CFG = DecoderConfig(
+    vocab_size=512, d_model=64, n_layers=2, n_heads=4, d_ff=128, max_len=128
+)
+
+
+def _greedy_full_recompute(params, cfg, ids, n_new):
+    """Oracle: argmax over full-context logits each token (the old path)."""
+    buf = list(ids)
+    out = []
+    for _ in range(n_new):
+        logits = forward_logits(
+            params, cfg, jnp.asarray([buf], jnp.int32)
+        )
+        nxt = int(jnp.argmax(logits[0, len(buf) - 1]))
+        out.append(nxt)
+        buf.append(nxt)
+    return out
+
+
+def test_kv_generation_matches_full_recompute():
+    params = init_decoder_params(_CFG, jax.random.PRNGKey(0))
+    lm = JaxDecoderLM(_CFG, params=params, seq_buckets=(32, 128))
+
+    prompt = "alpha beta gamma delta"
+    ids = lm.tokenizer.encode(prompt)
+    want = _greedy_full_recompute(params, _CFG, ids, 12)
+
+    assert isinstance(lm.generate(prompt, max_new_tokens=12), str)
+    # compare token-by-token via the internal path (decode doesn't roundtrip)
+    L = lm._bucket(len(ids) + 12)
+    buf = np.zeros((1, L), np.int32)
+    buf[0, : len(ids)] = ids
+    logits, kv = lm._prefill(
+        params, token_ids=jnp.asarray(buf),
+        n_valid=jnp.asarray([len(ids)], jnp.int32),
+    )
+    got = [int(jnp.argmax(logits[0]))]
+    n = len(ids)
+    for _ in range(11):
+        logits, kv = lm._step(
+            params, kv, jnp.asarray([got[-1]], jnp.int32),
+            jnp.asarray(n, jnp.int32),
+        )
+        n += 1
+        got.append(int(jnp.argmax(logits[0])))
+    assert got == want
+
+
+def test_prefill_logits_match_forward():
+    params = init_decoder_params(_CFG, jax.random.PRNGKey(1))
+    ids = [5, 9, 200, 3, 77]
+    L = 32
+    buf = np.zeros((1, L), np.int32)
+    buf[0, : len(ids)] = ids
+    logits, cache = prefill(
+        params, _CFG, jnp.asarray(buf), jnp.asarray([len(ids)], jnp.int32)
+    )
+    full = forward_logits(params, _CFG, jnp.asarray([ids], jnp.int32))
+    np.testing.assert_allclose(
+        np.asarray(logits[0]), np.asarray(full[0, -1]), rtol=2e-4, atol=2e-4
+    )
+    assert cache[0]["k"].shape == (1, L, _CFG.n_heads,
+                                   _CFG.d_model // _CFG.n_heads)
+
+
+def test_decode_step_is_o_t_not_o_t2():
+    """At context 512 a cached step must beat full-context recompute by a
+    wide margin (the VERDICT gate is 10x on the generation loop)."""
+    cfg = DecoderConfig(
+        vocab_size=1024, d_model=256, n_layers=4, n_heads=8, d_ff=1024,
+        max_len=512,
+    )
+    params = init_decoder_params(cfg, jax.random.PRNGKey(2))
+    L = 512
+    buf = jnp.asarray(np.random.default_rng(0).integers(0, 1024, (1, L)),
+                      jnp.int32)
+
+    step = jax.jit(lambda p, c, t, i: decode_step(p, cfg, c, t, i))
+    full = jax.jit(lambda p, token_ids: forward_logits(p, cfg, token_ids))
+
+    _, cache = prefill(params, cfg, buf, jnp.asarray([L - 1], jnp.int32))
+    tok = jnp.asarray([7], jnp.int32)
+    pos = jnp.asarray(L - 1, jnp.int32)
+    step(params, cache, tok, pos)[0].block_until_ready()  # compile
+    full(params, token_ids=buf).block_until_ready()
+
+    n = 8
+    t0 = time.perf_counter()
+    for _ in range(n):
+        step(params, cache, tok, pos)[0].block_until_ready()
+    t_step = (time.perf_counter() - t0) / n
+
+    t0 = time.perf_counter()
+    for _ in range(max(n // 4, 2)):
+        full(params, token_ids=buf).block_until_ready()
+    t_full = (time.perf_counter() - t0) / max(n // 4, 2)
+
+    assert t_full / t_step >= 10, (
+        f"cached step {t_step*1e3:.2f}ms vs full {t_full*1e3:.2f}ms — "
+        f"only {t_full/t_step:.1f}x"
+    )
