@@ -1,13 +1,18 @@
-"""Table.sort — prev/next pointers per instance.
+"""Table.sort — prev/next pointers per instance, maintained incrementally.
 
 Reference: sort_table (dataflow.rs:2296) + prev_next.rs (895 LoC): maintains,
 for each row, pointers to its predecessor/successor in (instance, key-expr)
-order.  Incremental here via per-instance recompute of the affected
-neighborhood (full instance group, v1).
+order.  Here each instance keeps a sorted array of (orderable-key, row-key);
+a delta bisects to its position (O(log n) search + C-level memmove), and
+only the touched row and its adjacent neighbors are marked dirty — the
+engine's diff layer then emits exactly the changed pointer rows.  Bulk
+batches (cold load / backfill) skip per-event bisection and rebuild the
+touched instances with one sort.
 """
 
 from __future__ import annotations
 
+import bisect
 from collections import defaultdict
 from typing import Any
 
@@ -18,18 +23,26 @@ from ...internals import parse_graph as pg
 from ...internals.table import Table
 from ...internals.value import hash_values
 
+_BULK_THRESHOLD = 1024
+
 
 class SortOperator(DiffOutputOperator):
     """Output universe = input universe; columns = (prev, next)."""
+
+    # orders/entry are derived-but-durable: snapshot restore must bring the
+    # sort index back with the row state (cf. gradual_broadcast.py)
+    _STATE_ATTRS = ("state", "last_out", "orders", "entry")
 
     def __init__(self, env, key_fn, inst_fn, name="sort"):
         super().__init__(1, name)
         self.env = env
         self.key_fn = key_fn
         self.inst_fn = inst_fn
-        self.by_inst: dict[Any, set] = defaultdict(set)
-        self.key_of: dict[Any, tuple] = {}
-        self.inst_of: dict[Any, Any] = {}
+        # instance -> sorted list of (orderable_sort_key, row_key)
+        self.orders: dict[Any, list] = defaultdict(list)
+        # row_key -> (item, instance) where item is the tuple in the list
+        self.entry: dict[Any, tuple] = {}
+        self._extra_dirty: set = set()
 
     def _sort_entry(self, key, row):
         env = self.env.build(key, row)
@@ -41,38 +54,108 @@ class SortOperator(DiffOutputOperator):
             inst = hash_values(inst)
         return sk, inst
 
+    # -- incremental structure upkeep ---------------------------------------
+    def _mark_neighbors(self, lst, pos):
+        if pos > 0:
+            self._extra_dirty.add(lst[pos - 1][1])
+        if pos < len(lst):
+            self._extra_dirty.add(lst[pos][1])
+
+    def _remove_entry(self, key):
+        ent = self.entry.pop(key, None)
+        if ent is None:
+            return
+        item, inst = ent
+        lst = self.orders[inst]
+        pos = bisect.bisect_left(lst, item)
+        if pos < len(lst) and lst[pos] == item:
+            del lst[pos]
+            # the rows now adjacent across the gap get fresh pointers
+            self._mark_neighbors(lst, pos)
+
     def pre_apply(self, port, key, row, diff):
-        if diff > 0:
-            sk, inst = self._sort_entry(key, row)
-            old_inst = self.inst_of.get(key)
-            if old_inst is not None:
-                self.by_inst[old_inst].discard(key)
-            self.by_inst[inst].add(key)
-            self.inst_of[key] = inst
-            self.key_of[key] = sk
+        # membership follows the POST-update Z-set multiplicity (state still
+        # holds the pre-update count here): a +1 landing on a negative count
+        # must not enter the index, a -1 leaving a positive count must stay
+        cnt = self.state[0].data.get(key)
+        new_count = (cnt[1] if cnt is not None else 0) + diff
+        if new_count <= 0:
+            self._remove_entry(key)
+            return
+        sk, inst = self._sort_entry(key, row)
+        item = (_orderable(sk), key)
+        old = self.entry.get(key)
+        if old is not None:
+            if old[0] == item and old[1] == inst:
+                return  # multiplicity bump, position unchanged
+            self._remove_entry(key)
+        lst = self.orders[inst]
+        pos = bisect.bisect_left(lst, item)
+        self._mark_neighbors(lst, pos)  # future prev and next of `key`
+        lst.insert(pos, item)
+        self.entry[key] = (item, inst)
 
     def dirty_keys_for(self, port, key):
-        inst = self.inst_of.get(key)
-        if inst is None:
-            return (key,)
-        return tuple(self.by_inst.get(inst, ())) + (key,)
+        extra = self._extra_dirty
+        self._extra_dirty = set()
+        extra.add(key)
+        return extra
+
+    # -- bulk path: one sort per touched instance ---------------------------
+    def process(self, port, updates, time):
+        if len(updates) < _BULK_THRESHOLD:
+            super().process(port, updates, time)
+            return
+        st = self.state[port]
+        touched_keys = set()
+        for key, row, diff in updates:
+            st.apply(key, row, diff)
+            touched_keys.add(key)
+        # sync entries to the post-batch state, collecting touched instances
+        touched_insts = set()
+        for key in touched_keys:
+            old = self.entry.get(key)
+            if old is not None:
+                touched_insts.add(old[1])
+            row = st.get_row(key)
+            if row is None:
+                self.entry.pop(key, None)
+            else:
+                sk, inst = self._sort_entry(key, row)
+                self.entry[key] = ((_orderable(sk), key), inst)
+                touched_insts.add(inst)
+        regroup: dict[Any, list] = {inst: [] for inst in touched_insts}
+        for ent in self.entry.values():
+            if ent[1] in regroup:
+                regroup[ent[1]].append(ent[0])
+        for inst, members in regroup.items():
+            members.sort()
+            self.orders[inst] = members
+            self._dirty.update(k for _sk, k in members)
+        self._dirty.update(touched_keys)
 
     def compute(self, key):
-        row = self.state[0].get_row(key)
-        if row is None:
-            inst = self.inst_of.pop(key, None)
-            if inst is not None:
-                self.by_inst[inst].discard(key)
-            self.key_of.pop(key, None)
+        if self.state[0].get_row(key) is None:
             return None
-        inst = self.inst_of.get(key)
-        members = [
-            k for k in self.by_inst.get(inst, ()) if self.state[0].get_row(k) is not None
-        ]
-        members.sort(key=lambda k: (_orderable(self.key_of.get(k)), k))
-        i = members.index(key)
-        prev_k = members[i - 1] if i > 0 else None
-        next_k = members[i + 1] if i + 1 < len(members) else None
+        ent = self.entry.get(key)
+        if ent is None:
+            return None
+        item, inst = ent
+        lst = self.orders[inst]
+        pos = bisect.bisect_left(lst, item)
+        if pos >= len(lst) or lst[pos] != item:
+            return None
+        # neighbors must be live output rows (a stale index entry for a
+        # retracted key must never be pointed at)
+        get_row = self.state[0].get_row
+        i = pos - 1
+        while i >= 0 and get_row(lst[i][1]) is None:
+            i -= 1
+        prev_k = lst[i][1] if i >= 0 else None
+        j = pos + 1
+        while j < len(lst) and get_row(lst[j][1]) is None:
+            j += 1
+        next_k = lst[j][1] if j < len(lst) else None
         return (prev_k, next_k)
 
 

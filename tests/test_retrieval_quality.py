@@ -1,0 +1,113 @@
+"""Retrieval-quality parity gate (VERDICT r2 item 3, BEIR-style).
+
+The same MiniLM-architecture checkpoint is run through BOTH retrieval
+stacks — our on-device path (hf_import -> JaxEncoder -> BruteForceKnn) and
+a faithful torch re-creation of the reference's SentenceTransformer path
+(python/pathway/xpacks/llm/embedders.py:77-802) — over a labeled
+scifact-shaped corpus.  recall@10 / NDCG@10 must agree within 1%.
+
+Zero-egress environment: the checkpoint is a deterministic randomly
+initialized BERT saved with save_pretrained (a real on-disk checkpoint;
+training state does not affect the parity property being gated).
+"""
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+pytest.importorskip("transformers")
+
+from pathway_tpu.xpacks.llm.evaluate import (
+    evaluate_retrieval, ndcg_at_k, recall_at_k, synthetic_beir_corpus,
+)
+
+
+def test_metric_definitions():
+    assert recall_at_k(["a", "b", "c"], {"a", "z"}, 2) == 0.5
+    assert ndcg_at_k(["a"], {"a"}, 10) == 1.0
+    assert ndcg_at_k(["x", "a"], {"a"}, 10) == pytest.approx(
+        (1 / np.log2(3)) / 1.0
+    )
+
+
+def _minilm_checkpoint(tmp_path):
+    from transformers import BertConfig, BertModel
+
+    torch.manual_seed(7)
+    cfg = BertConfig(
+        vocab_size=4096, hidden_size=96, num_hidden_layers=3,
+        num_attention_heads=4, intermediate_size=384,
+        max_position_embeddings=128, hidden_act="gelu",
+    )
+    model = BertModel(cfg).eval()
+    path = tmp_path / "minilm-class"
+    model.save_pretrained(str(path))
+    return str(path), model
+
+
+def _torch_reference_search(model, tokenizer, corpus):
+    """The reference path: torch forward + masked mean pooling + L2 norm +
+    numpy brute-force cosine."""
+    doc_ids = list(corpus)
+
+    def embed_many(texts):
+        toks = [tokenizer.encode(t)[:64] for t in texts]
+        T = max(len(t) for t in toks)
+        ids = torch.zeros((len(toks), T), dtype=torch.long)
+        mask = torch.zeros((len(toks), T), dtype=torch.long)
+        for i, t in enumerate(toks):
+            ids[i, : len(t)] = torch.tensor(t)
+            mask[i, : len(t)] = 1
+        with torch.no_grad():
+            h = model(input_ids=ids, attention_mask=mask).last_hidden_state
+        m = mask[:, :, None].float()
+        pooled = (h * m).sum(1) / m.sum(1).clamp(min=1.0)
+        pooled = torch.nn.functional.normalize(pooled, dim=-1)
+        return pooled.numpy()
+
+    mat = embed_many([corpus[d] for d in doc_ids])
+
+    def search(qtext, k):
+        v = embed_many([qtext])[0]
+        scores = mat @ v
+        top = np.argsort(-scores)[:k]
+        return [doc_ids[i] for i in top]
+
+    return search
+
+
+def test_jax_path_matches_torch_reference_on_beir_style_corpus(tmp_path):
+    from pathway_tpu.models.encoder import JaxEncoder
+    from pathway_tpu.stdlib.indexing.inner_index import BruteForceKnn
+
+    ckpt, model = _minilm_checkpoint(tmp_path)
+    corpus, queries, qrels = synthetic_beir_corpus(
+        n_topics=20, docs_per_topic=5, n_queries_per_topic=2, seed=3
+    )
+
+    enc = JaxEncoder.from_hf(ckpt, seq_buckets=(64,), batch_buckets=(1, 128))
+    # no tokenizer files in the checkpoint -> both paths use the hash
+    # tokenizer so tokenization is identical
+    tokenizer = enc.tokenizer
+
+    doc_ids = list(corpus)
+    vecs = enc.embed_batch([corpus[d] for d in doc_ids])
+    index = BruteForceKnn(enc.dimensions, device_threshold=1 << 30)
+    for i, d in enumerate(doc_ids):
+        index.add(i, vecs[i])
+
+    def jax_search(qtext, k):
+        got = index.search(enc.embed(qtext), k)
+        return [doc_ids[i] for i, _score in got]
+
+    ours = evaluate_retrieval(jax_search, queries, qrels, k=10)
+    ref_search = _torch_reference_search(model, tokenizer, corpus)
+    ref = evaluate_retrieval(ref_search, queries, qrels, k=10)
+
+    # the corpus is solvable: a working stack must beat random chance by a
+    # wide margin (random recall@10 over 100 docs with 5 relevant ~ 0.10)
+    assert ours["recall"] > 0.5, ours
+    assert ref["recall"] > 0.5, ref
+    # parity gate: both stacks realize the same checkpoint
+    assert abs(ours["recall"] - ref["recall"]) <= 0.01, (ours, ref)
+    assert abs(ours["ndcg"] - ref["ndcg"]) <= 0.01, (ours, ref)

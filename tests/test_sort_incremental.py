@@ -1,0 +1,120 @@
+"""Incremental prev/next sort (VERDICT r2 item 4).
+
+Reference: src/engine/dataflow/operators/prev_next.rs maintains pointers
+incrementally.  The gates here: (1) streamed deltas into a large sorted
+instance touch only the affected neighborhood (wall-clock bound that the
+old full-instance-recompute path misses by orders of magnitude), and
+(2) pointer semantics survive inserts, deletes, updates, and instance moves.
+"""
+
+import time
+
+import pathway_tpu as pw
+from pathway_tpu.debug import table_from_markdown
+from pathway_tpu.internals import parse_graph as pg
+
+from .utils import run_and_squash
+
+
+def _chain_from_state(state):
+    """{key: (prev, next)} -> ordered list of keys by following pointers."""
+    ptrs = dict(state)
+    head = [k for k, (p, _n) in ptrs.items() if p is None]
+    assert len(head) == 1, ptrs
+    out = [head[0]]
+    while ptrs[out[-1]][1] is not None:
+        out.append(ptrs[out[-1]][1])
+    assert len(out) == len(ptrs)
+    return out
+
+
+def test_sort_streaming_updates_maintain_pointers():
+    t = table_from_markdown(
+        """
+          | v  | __time__ | __diff__
+        1 | 30 | 0        | 1
+        2 | 10 | 0        | 1
+        3 | 20 | 0        | 1
+        4 | 15 | 2        | 1
+        3 | 20 | 4        | -1
+        5 | 5  | 6        | 1
+        """
+    )
+    ptrs = t.sort(key=t.v)
+    res = t.select(v=t.v, prev=ptrs.prev, next=ptrs.next)
+    state = run_and_squash(res)
+    by_key = {k: (r[1], r[2]) for k, r in state.items()}
+    vals = {k: r[0] for k, r in state.items()}
+    order = _chain_from_state(by_key)
+    assert [vals[k] for k in order] == [5, 10, 15, 30]
+
+
+def test_sort_instance_move():
+    t = table_from_markdown(
+        """
+          | v | g | __time__ | __diff__
+        1 | 1 | 0 | 0        | 1
+        2 | 2 | 0 | 0        | 1
+        3 | 3 | 1 | 0        | 1
+        2 | 2 | 0 | 2        | -1
+        2 | 9 | 1 | 2        | 1
+        """
+    )
+    ptrs = t.sort(key=t.v, instance=t.g)
+    res = t.select(v=t.v, g=t.g, prev=ptrs.prev, next=ptrs.next)
+    state = run_and_squash(res)
+    by_v = {r[0]: r for r in state.values()}
+    key_of_v = {r[0]: k for k, r in state.items()}
+    # instance 0: just v=1; instance 1: v=3 -> v=9
+    assert by_v[1][2] is None and by_v[1][3] is None
+    assert by_v[3][2] is None and by_v[3][3] == key_of_v[9]
+    assert by_v[9][2] == key_of_v[3] and by_v[9][3] is None
+
+
+def test_sort_large_instance_stream_is_incremental():
+    """100k-row sorted instance + 300 streamed deltas: the incremental
+    pointer maintenance must finish in seconds (the per-delta full-instance
+    recompute of round 2 is O(n^2) here and does not)."""
+    pg.G.clear()
+
+    class S(pw.Schema):
+        k: int = pw.column_definition(primary_key=True)
+        v: int
+
+    n = 100_000
+    val = [i * 7 % 1_000_003 for i in range(n)]
+    # rows: (k, v, __time__, __diff__)
+    events = [(k, val[k], 0, 1) for k in range(n)]
+    # streamed tail at later times: inserts, deletes, updates
+    for j in range(100):
+        events.append((n + 10 + j, j * 13 + 1, 2 + 2 * j, 1))
+    for j in range(100):
+        events.append((j, val[j], 2 + 2 * j, -1))
+    for j in range(100):
+        k = 200 + j
+        events.append((k, val[k], 4 + 2 * j, -1))
+        events.append((k, 5_000_000 + j, 4 + 2 * j, 1))
+
+    from pathway_tpu.debug import table_from_rows
+    from pathway_tpu.engine.runner import run_tables
+
+    t = table_from_rows(S, events, is_stream=True)
+    ptrs = t.sort(key=t.v)
+    t0 = time.perf_counter()
+    [cap] = run_tables(ptrs)
+    elapsed = time.perf_counter() - t0
+    state = cap.squash()
+    assert len(state) == n + 100 - 100 - 0  # inserts - deletes (updates net 0)
+    assert elapsed < 30, f"incremental sort too slow: {elapsed:.1f}s"
+
+    # spot-check pointer integrity on the final state: walk the chain
+    by_key = {k: (r[0], r[1]) for k, r in state.items()}
+    heads = [k for k, (p, _n2) in by_key.items() if p is None]
+    tails = [k for k, (_p, n2) in by_key.items() if n2 is None]
+    assert len(heads) == 1 and len(tails) == 1
+    # every prev/next pair is mutual
+    for k, (p, nx) in by_key.items():
+        if p is not None:
+            assert by_key[p][1] == k
+        if nx is not None:
+            assert by_key[nx][0] == k
