@@ -337,20 +337,9 @@ def bench_retrieval_quality() -> dict:
 
     ours = evaluate_retrieval(jax_search, queries, qrels, k=10)
 
-    def torch_embed(texts):
-        toks = [enc.tokenizer.encode(t)[:64] for t in texts]
-        T = max(len(t) for t in toks)
-        ids = torch.zeros((len(toks), T), dtype=torch.long)
-        mask = torch.zeros((len(toks), T), dtype=torch.long)
-        for i, t in enumerate(toks):
-            ids[i, : len(t)] = torch.tensor(t)
-            mask[i, : len(t)] = 1
-        with torch.no_grad():
-            h = model(input_ids=ids, attention_mask=mask).last_hidden_state
-        m = mask[:, :, None].float()
-        pooled = (h * m).sum(1) / m.sum(1).clamp(min=1.0)
-        return torch.nn.functional.normalize(pooled, dim=-1).numpy()
+    from pathway_tpu.xpacks.llm.evaluate import torch_reference_embedder
 
+    torch_embed = torch_reference_embedder(model, enc.tokenizer)
     mat = torch_embed([corpus[d] for d in doc_ids])
 
     def ref_search(qtext, k):

@@ -157,3 +157,14 @@ class SubjectDataSource(DataSource):
 
     def stop(self) -> None:
         self._finished = True
+
+    # offset persistence delegates to the subject when it participates
+    # (e.g. the airbyte subject's STATE frontier)
+    def get_offsets(self) -> dict:
+        fn = getattr(self.subject, "get_offsets", None)
+        return fn() if fn is not None else {}
+
+    def seek(self, offsets: dict) -> None:
+        fn = getattr(self.subject, "seek", None)
+        if fn is not None:
+            fn(offsets)

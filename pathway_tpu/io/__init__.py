@@ -80,7 +80,7 @@ minio.write = lambda table, path, *, minio_settings=None, **kw: s3.write(
 sys.modules["pathway_tpu.io.minio"] = minio
 
 # long-tail connectors behind the same seam (reference: src/connectors/data_storage/)
-gdrive = _make_stub("gdrive", "google-api-python-client")
+from . import gdrive  # noqa: E402  (real: Drive tree poller behind a client seam)
 sharepoint = _make_stub("sharepoint", "Office365-REST client")
 mysql = _make_stub("mysql", "pymysql")
 deltalake = _make_stub("deltalake", "deltalake")
@@ -93,7 +93,8 @@ dynamodb = _make_stub("dynamodb", "boto3")
 bigquery = _make_stub("bigquery", "google-cloud-bigquery")
 redpanda = kafka
 questdb = _make_stub("questdb", "questdb client")
-airbyte = _make_stub("airbyte", "airbyte-serverless runtime")
+
+from . import airbyte  # noqa: E402  (real: executable/venv/docker protocol runner)
 
 # debezium CDC rides the kafka connector with format="debezium"
 debezium = types.ModuleType("pathway_tpu.io.debezium")

@@ -38,8 +38,9 @@ class SortOperator(DiffOutputOperator):
         self.env = env
         self.key_fn = key_fn
         self.inst_fn = inst_fn
-        # instance -> sorted list of (orderable_sort_key, row_key)
-        self.orders: dict[Any, list] = defaultdict(list)
+        # instance -> sorted list of (orderable_sort_key, row_key); emptied
+        # instances are pruned so state (and snapshots) track live rows
+        self.orders: dict[Any, list] = {}
         # row_key -> (item, instance) where item is the tuple in the list
         self.entry: dict[Any, tuple] = {}
         self._extra_dirty: set = set()
@@ -66,12 +67,16 @@ class SortOperator(DiffOutputOperator):
         if ent is None:
             return
         item, inst = ent
-        lst = self.orders[inst]
+        lst = self.orders.get(inst)
+        if lst is None:
+            return
         pos = bisect.bisect_left(lst, item)
         if pos < len(lst) and lst[pos] == item:
             del lst[pos]
             # the rows now adjacent across the gap get fresh pointers
             self._mark_neighbors(lst, pos)
+        if not lst:
+            del self.orders[inst]
 
     def pre_apply(self, port, key, row, diff):
         # membership follows the POST-update Z-set multiplicity (state still
@@ -82,6 +87,11 @@ class SortOperator(DiffOutputOperator):
         if new_count <= 0:
             self._remove_entry(key)
             return
+        if diff < 0:
+            # partial retraction: the surviving row's entry is already
+            # positioned; the retracted row must NOT re-position it (a
+            # same-time +new/-old pair can arrive in either order)
+            return
         sk, inst = self._sort_entry(key, row)
         item = (_orderable(sk), key)
         old = self.entry.get(key)
@@ -89,7 +99,7 @@ class SortOperator(DiffOutputOperator):
             if old[0] == item and old[1] == inst:
                 return  # multiplicity bump, position unchanged
             self._remove_entry(key)
-        lst = self.orders[inst]
+        lst = self.orders.setdefault(inst, [])
         pos = bisect.bisect_left(lst, item)
         self._mark_neighbors(lst, pos)  # future prev and next of `key`
         lst.insert(pos, item)
@@ -124,13 +134,25 @@ class SortOperator(DiffOutputOperator):
                 sk, inst = self._sort_entry(key, row)
                 self.entry[key] = ((_orderable(sk), key), inst)
                 touched_insts.add(inst)
-        regroup: dict[Any, list] = {inst: [] for inst in touched_insts}
-        for ent in self.entry.values():
-            if ent[1] in regroup:
-                regroup[ent[1]].append(ent[0])
-        for inst, members in regroup.items():
+        # rebuild ONLY from the touched instances' existing sorted lists plus
+        # the touched keys' fresh entries — O(touched instance sizes), not
+        # O(total rows)
+        fresh: dict[Any, list] = defaultdict(list)
+        for key in touched_keys:
+            ent = self.entry.get(key)
+            if ent is not None:
+                fresh[ent[1]].append(ent[0])
+        for inst in touched_insts:
+            base = [
+                it for it in self.orders.get(inst, ())
+                if it[1] not in touched_keys
+            ]
+            members = base + fresh.get(inst, [])
             members.sort()
-            self.orders[inst] = members
+            if members:
+                self.orders[inst] = members
+            else:
+                self.orders.pop(inst, None)
             self._dirty.update(k for _sk, k in members)
         self._dirty.update(touched_keys)
 
@@ -141,7 +163,7 @@ class SortOperator(DiffOutputOperator):
         if ent is None:
             return None
         item, inst = ent
-        lst = self.orders[inst]
+        lst = self.orders.get(inst, ())
         pos = bisect.bisect_left(lst, item)
         if pos >= len(lst) or lst[pos] != item:
             return None

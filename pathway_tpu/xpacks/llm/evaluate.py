@@ -73,6 +73,30 @@ def evaluate_retrieval(
     }
 
 
+def torch_reference_embedder(model, tokenizer, max_len: int = 64):
+    """The reference's embedding path, shared by the bench and the parity
+    test so both gate the SAME implementation: torch BERT forward + masked
+    mean pooling + L2 norm (SentenceTransformer semantics,
+    xpacks/llm/embedders.py:77-802)."""
+    import torch
+
+    def embed_many(texts):
+        toks = [tokenizer.encode(t)[:max_len] for t in texts]
+        T = max(len(t) for t in toks)
+        ids = torch.zeros((len(toks), T), dtype=torch.long)
+        mask = torch.zeros((len(toks), T), dtype=torch.long)
+        for i, t in enumerate(toks):
+            ids[i, : len(t)] = torch.tensor(t)
+            mask[i, : len(t)] = 1
+        with torch.no_grad():
+            h = model(input_ids=ids, attention_mask=mask).last_hidden_state
+        m = mask[:, :, None].float()
+        pooled = (h * m).sum(1) / m.sum(1).clamp(min=1.0)
+        return torch.nn.functional.normalize(pooled, dim=-1).numpy()
+
+    return embed_many
+
+
 def synthetic_beir_corpus(n_topics: int = 40, docs_per_topic: int = 6,
                           n_queries_per_topic: int = 2, seed: int = 0):
     """A scifact-shaped labeled corpus built from topic vocabularies.

@@ -1,0 +1,108 @@
+"""A declarative fake Airbyte source speaking the real stdout protocol.
+
+Used by tests/test_airbyte.py through the ExecutableAirbyteSource seam:
+`python airbyte_fake_connector.py discover --config c.json` etc.  Data comes
+from the JSON file named in config["data_path"]:
+
+    {"users": [{"id": 1, "name": "a"}, ...],   # incremental (cursor: id)
+     "colors": ["red", "green", ...]}          # full refresh
+"""
+
+import argparse
+import json
+import sys
+
+
+def emit(msg):
+    sys.stdout.write(json.dumps(msg) + "\n")
+    sys.stdout.flush()
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("verb", choices=["spec", "check", "discover", "read"])
+    ap.add_argument("--config")
+    ap.add_argument("--catalog")
+    ap.add_argument("--state")
+    args = ap.parse_args()
+
+    config = json.load(open(args.config)) if args.config else {}
+    if args.verb == "spec":
+        emit({"type": "SPEC", "spec": {"connectionSpecification": {}}})
+        return
+    if args.verb == "check":
+        ok = bool(config.get("data_path"))
+        emit({
+            "type": "CONNECTION_STATUS",
+            "connectionStatus": {
+                "status": "SUCCEEDED" if ok else "FAILED",
+                "message": "" if ok else "data_path missing",
+            },
+        })
+        return
+    if args.verb == "discover":
+        emit({
+            "type": "CATALOG",
+            "catalog": {
+                "streams": [
+                    {
+                        "name": "users",
+                        "json_schema": {"type": "object"},
+                        "supported_sync_modes": ["full_refresh", "incremental"],
+                        "source_defined_cursor": True,
+                        "default_cursor_field": ["id"],
+                    },
+                    {
+                        "name": "colors",
+                        "json_schema": {"type": "object"},
+                        "supported_sync_modes": ["full_refresh"],
+                    },
+                ]
+            },
+        })
+        return
+
+    # read
+    data = json.load(open(config["data_path"]))
+    catalog = json.load(open(args.catalog))
+    state_list = json.load(open(args.state)) if args.state else []
+    cursor = 0
+    for s in state_list:
+        if (
+            s.get("type") == "STREAM"
+            and s["stream"]["stream_descriptor"]["name"] == "users"
+        ):
+            cursor = s["stream"]["stream_state"].get("cursor", 0)
+    for stream in catalog["streams"]:
+        name = stream["stream"]["name"]
+        if name == "users":
+            new_cursor = cursor
+            for rec in data.get("users", []):
+                if rec["id"] > cursor:
+                    emit({
+                        "type": "RECORD",
+                        "record": {"stream": "users", "data": rec,
+                                   "emitted_at": 0},
+                    })
+                    new_cursor = max(new_cursor, rec["id"])
+            emit({
+                "type": "STATE",
+                "state": {
+                    "type": "STREAM",
+                    "stream": {
+                        "stream_descriptor": {"name": "users"},
+                        "stream_state": {"cursor": new_cursor},
+                    },
+                },
+            })
+        elif name == "colors":
+            for c in data.get("colors", []):
+                emit({
+                    "type": "RECORD",
+                    "record": {"stream": "colors", "data": {"color": c},
+                               "emitted_at": 0},
+                })
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,122 @@
+"""Airbyte runner e2e (VERDICT r2 item 5): a declarative source fixture runs
+through the real protocol runner (subprocess + JSON-line protocol), with
+incremental state resume and full-refresh snapshot diffing."""
+
+import json
+import os
+import sys
+import time
+
+import pathway_tpu as pw
+from pathway_tpu.internals import parse_graph as pg
+from pathway_tpu.io.airbyte import (
+    AirbyteError, ExecutableAirbyteSource, _AirbyteSubject,
+)
+
+_CONNECTOR = os.path.join(os.path.dirname(__file__), "airbyte_fake_connector.py")
+
+
+def _source(data_path, streams):
+    return ExecutableAirbyteSource(
+        [sys.executable, _CONNECTOR], {"data_path": str(data_path)}, streams
+    )
+
+
+def _write_data(path, users=(), colors=()):
+    with open(path, "w") as f:
+        json.dump({"users": list(users), "colors": list(colors)}, f)
+
+
+def test_check_and_discover(tmp_path):
+    data = tmp_path / "d.json"
+    _write_data(data, users=[{"id": 1}])
+    src = _source(data, ["users"])
+    src.check()
+    catalog = src.configured_catalog
+    assert [s["stream"]["name"] for s in catalog["streams"]] == ["users"]
+    assert catalog["streams"][0]["sync_mode"] == "incremental"
+
+    import pytest
+
+    with pytest.raises(AirbyteError, match="not found"):
+        _source(data, ["nope"]).configured_catalog
+
+
+def test_incremental_extract_with_state_resume(tmp_path):
+    data = tmp_path / "d.json"
+    _write_data(data, users=[{"id": 1, "name": "a"}, {"id": 2, "name": "b"}])
+    src = _source(data, ["users"])
+    msgs = list(src.extract())
+    recs = [m for m in msgs if m["type"] == "RECORD"]
+    states = [m for m in msgs if m["type"] == "STATE"]
+    assert len(recs) == 2 and states
+    state = [states[-1]["state"]]
+    # second sync from the saved state: only the new row appears
+    _write_data(data, users=[{"id": 1, "name": "a"}, {"id": 2, "name": "b"},
+                             {"id": 3, "name": "c"}])
+    msgs2 = list(src.extract(state))
+    recs2 = [m["record"]["data"]["id"] for m in msgs2 if m["type"] == "RECORD"]
+    assert recs2 == [3]
+
+
+def test_airbyte_read_e2e_streaming(tmp_path):
+    """pw.io.airbyte.read over the fixture: incremental users stream picks up
+    appended rows across polls; full-refresh colors stream diffs out a
+    removed value."""
+    pg.G.clear()
+    data = tmp_path / "d.json"
+    out = tmp_path / "out.jsonl"
+    _write_data(data, users=[{"id": 1, "name": "a"}],
+                colors=["red", "green"])
+    cfg = tmp_path / "conn.yaml"
+    cfg.write_text(
+        f"""
+source:
+  exec: "{sys.executable} {_CONNECTOR}"
+  config:
+    data_path: "{data}"
+"""
+    )
+    t = pw.io.airbyte.read(str(cfg), ["users", "colors"],
+                           refresh_interval_ms=150)
+    pw.io.jsonlines.write(t, str(out))
+
+    import threading
+
+    def mutate():
+        time.sleep(0.8)
+        _write_data(data, users=[{"id": 1, "name": "a"},
+                                 {"id": 2, "name": "b"}],
+                    colors=["green"])  # red disappears
+
+    th = threading.Thread(target=mutate)
+    th.start()
+    pw.run(timeout_s=3.0, autocommit_duration_ms=50,
+           monitoring_level=pw.MonitoringLevel.NONE)
+    th.join()
+
+    net: dict[str, int] = {}
+    for ln in out.read_text().strip().splitlines():
+        e = json.loads(ln)
+        k = (e["stream"], json.dumps(e["data"], sort_keys=True))
+        net[k] = net.get(k, 0) + e["diff"]
+    live = {k for k, v in net.items() if v > 0}
+    streams = {s for s, _d in live}
+    assert streams == {"users", "colors"}
+    users = {json.loads(d)["id"] for s, d in live if s == "users"}
+    colors = {json.loads(d)["color"] for s, d in live if s == "colors"}
+    assert users == {1, 2}
+    assert colors == {"green"}  # red was retracted by the snapshot diff
+
+
+def test_subject_offsets_roundtrip(tmp_path):
+    data = tmp_path / "d.json"
+    _write_data(data, users=[{"id": 5}])
+    subj = _AirbyteSubject(_source(data, ["users"]), "static", 1.0)
+    subj.state = [{"type": "STREAM", "stream": {
+        "stream_descriptor": {"name": "users"},
+        "stream_state": {"cursor": 5}}}]
+    offs = subj.get_offsets()
+    subj2 = _AirbyteSubject(_source(data, ["users"]), "static", 1.0)
+    subj2.seek(offs)
+    assert subj2.state == subj.state

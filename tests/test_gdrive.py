@@ -1,0 +1,124 @@
+"""GDrive connector over a fake Drive client (VERDICT r2 item 5)."""
+
+import json
+import time
+import threading
+
+import pathway_tpu as pw
+from pathway_tpu.internals import parse_graph as pg
+from pathway_tpu.io.gdrive import _FOLDER_MIME, _GDriveTree
+
+
+class FakeDrive:
+    """In-memory Drive: {id: {meta..., 'content': bytes, 'children': [ids]}}"""
+
+    def __init__(self):
+        self.objects = {}
+        self.downloads = 0
+
+    def add_folder(self, fid, parent=None):
+        self.objects[fid] = {"id": fid, "name": fid, "mimeType": _FOLDER_MIME,
+                             "children": []}
+        if parent:
+            self.objects[parent]["children"].append(fid)
+
+    def add_file(self, fid, name, content: bytes, parent, version="1",
+                 mime="text/plain"):
+        self.objects[fid] = {
+            "id": fid, "name": name, "mimeType": mime, "version": version,
+            "size": str(len(content)), "content": content,
+        }
+        self.objects[parent]["children"].append(fid)
+
+    def remove(self, fid, parent):
+        self.objects.pop(fid, None)
+        self.objects[parent]["children"].remove(fid)
+
+    # -- the client seam ----------------------------------------------------
+    def list_files(self, folder_id):
+        return [
+            {k: v for k, v in self.objects[c].items()
+             if k not in ("content", "children")}
+            for c in self.objects[folder_id]["children"]
+            if c in self.objects
+        ]
+
+    def get_file(self, object_id):
+        o = self.objects[object_id]
+        return {k: v for k, v in o.items() if k not in ("content", "children")}
+
+    def download(self, meta):
+        self.downloads += 1
+        return self.objects[meta["id"]]["content"]
+
+
+def _drive():
+    d = FakeDrive()
+    d.add_folder("root")
+    d.add_folder("sub", parent="root")
+    d.add_file("f1", "a.txt", b"alpha", parent="root")
+    d.add_file("f2", "b.txt", b"beta", parent="sub")
+    d.add_file("f3", "notes.md", b"gamma", parent="sub")
+    return d
+
+
+def test_tree_snapshot_filters():
+    d = _drive()
+    tree = _GDriveTree(d, object_size_limit=None, file_name_pattern="*.txt")
+    snap = tree.snapshot("root")
+    assert sorted(snap) == ["f1", "f2"]
+    tree2 = _GDriveTree(d, object_size_limit=4, file_name_pattern=None)
+    assert sorted(tree2.snapshot("root")) == ["f2"]  # only len<=4 (beta)
+    # single-file root
+    assert list(_GDriveTree(d, None, None).snapshot("f3")) == ["f3"]
+
+
+def test_gdrive_read_streaming_diffs(tmp_path):
+    pg.G.clear()
+    d = _drive()
+    out = tmp_path / "out.jsonl"
+    t = pw.io.gdrive.read(
+        "root", refresh_interval=0.15, with_metadata=True, _client=d
+    )
+    decoded = t.select(
+        name=pw.apply_with_type(
+            lambda m: m.value["name"] if m else None, str, t._metadata
+        ),
+        text=pw.apply_with_type(lambda b: b.decode(), str, t.data),
+    )
+    pw.io.jsonlines.write(decoded, str(out))
+
+    def mutate():
+        time.sleep(0.7)
+        d.remove("f1", "root")                      # deletion -> retract
+        d.add_file("f4", "d.txt", b"delta", parent="root")  # new file
+        o = d.objects["f2"]                          # changed content
+        o["content"] = b"BETA2"
+        o["version"] = "2"
+        o["size"] = "5"
+
+    th = threading.Thread(target=mutate)
+    th.start()
+    pw.run(timeout_s=2.5, autocommit_duration_ms=50,
+           monitoring_level=pw.MonitoringLevel.NONE)
+    th.join()
+
+    net = {}
+    for ln in out.read_text().strip().splitlines():
+        e = json.loads(ln)
+        k = (e["name"], e["text"])
+        net[k] = net.get(k, 0) + e["diff"]
+    live = {k for k, v in net.items() if v > 0}
+    assert live == {
+        ("b.txt", "BETA2"), ("notes.md", "gamma"), ("d.txt", "delta"),
+    }
+    # unchanged files were downloaded once, not per poll
+    assert d.downloads <= 8
+
+
+def test_gdrive_requires_credentials_or_client():
+    import pytest
+
+    pg.G.clear()
+    with pytest.raises(ValueError, match="credentials"):
+        pw.io.gdrive.read("root")

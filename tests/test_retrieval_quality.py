@@ -47,24 +47,11 @@ def _minilm_checkpoint(tmp_path):
 
 def _torch_reference_search(model, tokenizer, corpus):
     """The reference path: torch forward + masked mean pooling + L2 norm +
-    numpy brute-force cosine."""
+    numpy brute-force cosine (shared implementation in evaluate.py)."""
+    from pathway_tpu.xpacks.llm.evaluate import torch_reference_embedder
+
     doc_ids = list(corpus)
-
-    def embed_many(texts):
-        toks = [tokenizer.encode(t)[:64] for t in texts]
-        T = max(len(t) for t in toks)
-        ids = torch.zeros((len(toks), T), dtype=torch.long)
-        mask = torch.zeros((len(toks), T), dtype=torch.long)
-        for i, t in enumerate(toks):
-            ids[i, : len(t)] = torch.tensor(t)
-            mask[i, : len(t)] = 1
-        with torch.no_grad():
-            h = model(input_ids=ids, attention_mask=mask).last_hidden_state
-        m = mask[:, :, None].float()
-        pooled = (h * m).sum(1) / m.sum(1).clamp(min=1.0)
-        pooled = torch.nn.functional.normalize(pooled, dim=-1)
-        return pooled.numpy()
-
+    embed_many = torch_reference_embedder(model, tokenizer)
     mat = embed_many([corpus[d] for d in doc_ids])
 
     def search(qtext, k):

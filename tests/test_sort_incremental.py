@@ -49,6 +49,28 @@ def test_sort_streaming_updates_maintain_pointers():
     assert [vals[k] for k in order] == [5, 10, 15, 30]
 
 
+def test_sort_update_with_insert_before_retract():
+    """A same-time upsert encoded as +new before -old must leave the entry
+    at the NEW position (the retraction's stale row must not re-position)."""
+    t = table_from_markdown(
+        """
+          | v  | __time__ | __diff__
+        1 | 20 | 0        | 1
+        2 | 10 | 0        | 1
+        3 | 30 | 0        | 1
+        2 | 25 | 2        | 1
+        2 | 10 | 2        | -1
+        """
+    )
+    ptrs = t.sort(key=t.v)
+    res = t.select(v=t.v, prev=ptrs.prev, next=ptrs.next)
+    state = run_and_squash(res)
+    by_key = {k: (r[1], r[2]) for k, r in state.items()}
+    vals = {k: r[0] for k, r in state.items()}
+    order = _chain_from_state(by_key)
+    assert [vals[k] for k in order] == [20, 25, 30]
+
+
 def test_sort_instance_move():
     t = table_from_markdown(
         """
