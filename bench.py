@@ -227,7 +227,8 @@ def bench_reference_baseline(docs: list[str], queries: list[str], k: int,
 
 def bench_parallel_wordcount(tmp: str, n_procs: int) -> float:
     """Cluster wordcount over partitioned files via the real CLI supervisor;
-    returns elapsed seconds."""
+    returns elapsed seconds.  Fabric exchange counters (send/recv/wait — the
+    r2 'where does the 2-proc overhead go' item) land in tmp/fabric_stats."""
     import socket
 
     s = socket.socket()
@@ -250,6 +251,7 @@ pw.run(idle_stop_s=1.0)
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     env["PYTHONPATH"] = os.path.dirname(os.path.abspath(__file__))
+    env["PW_FABRIC_STATS_DIR"] = os.path.join(tmp, f"fabric_stats{n_procs}")
     t0 = time.perf_counter()
     res = subprocess.run(
         [
@@ -283,12 +285,23 @@ def bench_parallel(n_rows_per_file: int = 25_000, n_files: int = 4) -> dict:
         t1 = bench_parallel_wordcount(tmp, 1)
         tn_procs = min(4, max(2, cores))
         tn = bench_parallel_wordcount(tmp, tn_procs)
+        fabric = {}
+        import glob as _glob
+
+        for f in _glob.glob(
+            os.path.join(tmp, f"fabric_stats{tn_procs}", "*.json")
+        ):
+            with open(f) as fh:
+                st = json.load(fh)
+            for k2, v in st.items():
+                fabric[k2] = round(fabric.get(k2, 0) + v, 4)
     return {
         "host_cpus": cores,
         "procs": tn_procs,
         "elapsed_1proc_s": round(t1, 2),
         f"elapsed_{tn_procs}proc_s": round(tn, 2),
         "parallel_speedup": round(t1 / tn, 2),
+        "fabric": fabric,
     }
 
 
@@ -366,9 +379,16 @@ def bench_generation() -> dict:
 
     Model: GPT-2-small-class decoder (124M-class: d=768, 12 layers) with
     random weights — the zero-egress stand-in with the same compute shape as
-    a served checkpoint; cost, not quality, is what is measured.  Reports
-    cached tokens/sec at context 512 and the speedup over the round-2
-    no-cache path (full-context recompute per token)."""
+    a served checkpoint; cost, not quality, is what is measured.
+
+    Three decode strategies at context 512:
+      fused    — prefill + whole greedy loop in ONE device program
+                 (generate_tokens_fused); tokens/sec INCLUDES prefill,
+                 i.e. it is the end-to-end completion rate a server sees
+      stepwise — one decode_step dispatch per token (round-2 design; over
+                 the TPU tunnel each dispatch pays the sync round trip)
+      nocache  — full-context forward per token (round-1 design)
+    """
     import time as _t
 
     import jax
@@ -386,26 +406,32 @@ def bench_generation() -> dict:
     lm = JaxDecoderLM(cfg, seq_buckets=(576, 1024))
     # 512-token prompt (one token per word under the hash tokenizer)
     prompt = " ".join(f"w{i % 977}" for i in range(512))
-
-    lm.generate(prompt, max_new_tokens=2)  # compile prefill + step
-    t0 = _t.perf_counter()
-    lm.generate(prompt, max_new_tokens=1)
-    t_prefill = _t.perf_counter() - t0
     n_new = 32
+
+    lm.generate(prompt, max_new_tokens=n_new, fused=True)  # compile fused
     t0 = _t.perf_counter()
-    lm.generate(prompt, max_new_tokens=n_new + 1)
+    lm.generate(prompt, max_new_tokens=n_new, fused=True)
+    t_fused = _t.perf_counter() - t0
+    fused_tok_s = n_new / t_fused
+
+    lm.generate(prompt, max_new_tokens=2, fused=False)  # compile step path
+    t0 = _t.perf_counter()
+    lm.generate(prompt, max_new_tokens=1, fused=False)
+    t_prefill = _t.perf_counter() - t0
+    t0 = _t.perf_counter()
+    lm.generate(prompt, max_new_tokens=n_new + 1, fused=False)
     t_total = _t.perf_counter() - t0
-    tok_s = n_new / max(t_total - t_prefill, 1e-9)
+    step_tok_s = n_new / max(t_total - t_prefill, 1e-9)
 
     # the no-cache cost: one full-context forward per token (old path)
     full = jax.jit(lambda p, t: forward_logits(p, cfg, t))
     buf = jnp.asarray(
         np.random.default_rng(0).integers(0, 1000, (1, 576)), jnp.int32
     )
-    full(lm.params, buf).block_until_ready()
+    np.asarray(full(lm.params, buf)[0, :1, :1])
     t0 = _t.perf_counter()
     for _ in range(3):
-        full(lm.params, buf).block_until_ready()
+        np.asarray(full(lm.params, buf)[0, :1, :1])
     t_nocache = (_t.perf_counter() - t0) / 3
 
     # adaptive RAG (geometric context growth) end-to-end over retrieved docs
@@ -427,9 +453,11 @@ def bench_generation() -> dict:
         "model": "gpt2-small-class-124M-random",
         "context": 512,
         "prefill_ms": round(t_prefill * 1000, 1),
-        "tokens_per_sec": round(tok_s, 1),
+        "tokens_per_sec": round(fused_tok_s, 1),
+        "stepwise_tokens_per_sec": round(step_tok_s, 1),
         "nocache_tokens_per_sec": round(1.0 / t_nocache, 1),
-        "speedup_vs_nocache": round(tok_s * t_nocache, 1),
+        "speedup_vs_stepwise": round(fused_tok_s / max(step_tok_s, 1e-9), 1),
+        "speedup_vs_nocache": round(fused_tok_s * t_nocache, 1),
         "adaptive_rag_latency_s": round(adaptive_s, 2),
     }
 
@@ -443,6 +471,29 @@ def _encoder_flops_per_batch(cfg, B: int, T: int) -> float:
 
 # bf16 peak FLOPs/s per chip by TPU generation (public spec sheets)
 _TPU_PEAK = {"v5e": 197e12, "v5p": 459e12, "v4": 275e12, "v6e": 918e12}
+
+
+def _tpu_generation() -> str:
+    """Resolve the chip generation for the MFU peak: explicit env override,
+    else parse jax's device_kind (e.g. "TPU v5 lite" -> v5e)."""
+    env = os.environ.get("PALLAS_AXON_TPU_GEN", "")
+    if env:
+        return env
+    try:
+        import jax
+
+        kind = jax.devices()[0].device_kind.lower()
+    except Exception:
+        return ""
+    if "v5 lite" in kind or "v5e" in kind or "v5lite" in kind:
+        return "v5e"
+    if "v5p" in kind or "v5" in kind:
+        return "v5p"
+    if "v6" in kind:
+        return "v6e"
+    if "v4" in kind:
+        return "v4"
+    return ""
 
 
 def main() -> None:
@@ -460,24 +511,27 @@ def main() -> None:
 
     # dtype resolves by backend (bf16 on TPU / f32 on CPU — bf16 is emulated
     # ~2x slower on CPU, the round-2 regression); 48-wide bucket is the
-    # exact fit for this corpus so the no-mask fast path triggers
+    # exact fit for this corpus so the no-mask fast path triggers.  The
+    # 4096 batch bucket puts the whole corpus in ONE dispatch: per-dispatch
+    # tunnel overhead (~100ms) dominates anything smaller.
     enc = JaxEncoder(EncoderConfig(max_len=128), seq_buckets=(48, 64),
-                     batch_buckets=(1, 256))
+                     batch_buckets=(1, 256, n_docs))
     index = BruteForceKnn(enc.dimensions, reserved_space=n_docs)
     docs = make_corpus(n_docs)
 
     # warmup/compile every (batch, seq, mask) shape the run will hit,
-    # including the device KNN scoring kernel at its serving shape
+    # including the device KNN top-k kernel at its serving shape
     import numpy as np
 
-    from pathway_tpu.ops.knn import device_topk_scores, to_device
+    from pathway_tpu.ops.knn import device_topk, to_device
 
     enc.embed_batch(docs[:batch])
     enc.embed_batch(docs[: batch - 1])  # masked variant of the same bucket
     enc.embed_batch([docs[0]])
-    device_topk_scores(
+    enc.embed_batch_device(docs)  # device-resident ingest at the full-corpus bucket
+    device_topk(
         to_device(np.zeros((n_docs, enc.dimensions), np.float32)),
-        np.zeros(enc.dimensions, np.float32), "cos_prenorm",
+        np.zeros(enc.dimensions, np.float32), k, "cos_prenorm",
     )
     # exact-fit sequence width for this corpus (drives the FLOPs model)
     seq_T = enc._bucket(len(enc.tokenizer.encode(docs[0])), enc.seq_buckets)
@@ -498,13 +552,19 @@ def main() -> None:
 
     doc_table = table_from_rows(DocSchema, [(d,) for d in docs])
 
+    device_resident = backend == "tpu"
+
     class _Emb(BaseEmbedder):
-        """The real embedder UDF wiring over the pre-warmed encoder."""
+        """The real embedder UDF wiring over the pre-warmed encoder.  On TPU
+        the batch outputs stay in HBM as DeviceVec handles (no per-batch
+        fetch over the tunnel); the KNN index consolidates them on device."""
 
         def _embed(self, text):
             return enc.embed(text)
 
         def _embed_many(self, texts):
+            if device_resident:
+                return enc.embed_batch_device(texts)
             return list(enc.embed_batch(texts))
 
     embedded = doc_table.select(text=doc_table.text, vec=_Emb()(doc_table.text))
@@ -518,11 +578,34 @@ def main() -> None:
     probe = table_from_rows(QSchema, [(enc.embed(docs[0]),)])
     reply = data_index.query(probe.qv, number_of_matches=1)
 
+    # full-pipeline warmup run: compiles the consolidation gather and the
+    # k=1 probe top-k shapes once (XLA compile measured ~3.6s — serving
+    # systems compile once and run many times, so the timed window below
+    # measures the steady state)
+    run_tables(reply, embedded)
+    pg.G.clear()
+    doc_table = table_from_rows(DocSchema, [(d,) for d in docs])
+    embedded = doc_table.select(text=doc_table.text, vec=_Emb()(doc_table.text))
+    data_index = BruteForceKnnFactory(dimensions=enc.dimensions).build_index(
+        embedded.vec, embedded
+    )
+    probe = table_from_rows(QSchema, [(enc.embed(docs[0]),)])
+    reply = data_index.query(probe.qv, number_of_matches=1)
+
     # reset stage counters here so they cover exactly the t0..t1 window
     enc.stats = {k2: (0.0 if isinstance(v, float) else 0)
                  for k2, v in enc.stats.items()}
     t0 = time.perf_counter()
     caps = run_tables(reply, embedded)
+    if device_resident and getattr(enc, "_store", None) is not None:
+        # honest end-of-ingest sync: fetch a scalar that depends on every
+        # dispatched embedding batch (async dispatches must not leak out of
+        # the timed window)
+        import jax.numpy as jnp
+
+        float(jnp.sum(jnp.stack(
+            [jnp.sum(b) for b in enc._store._batches]
+        )))
     t1 = time.perf_counter()
     assert len(caps[0].squash()) == 1
     docs_per_sec = n_docs / (t1 - t0)
@@ -544,13 +627,21 @@ def main() -> None:
     pg.G.clear()
 
     queries = make_corpus(n_queries, seed=123)
-    index.search(enc.embed(queries[0]), k)  # warm the (n_docs,) device cache
+
+    # serving latency tier: a single query over the tunnel pays a ~75ms
+    # round-trip floor no matter how small the compute, so latency-critical
+    # single queries run on the host CPU mirror (params copied once, index
+    # host-mirrored once per version) while bulk ingest stays on TPU
+    serve_enc = enc.cpu_mirror() if backend == "tpu" else enc
+    index.host_matrix()  # one f16 fetch, cached per index version
+    serve_enc.embed(queries[0])  # compile CPU single-query bucket
+    index.search(serve_enc.embed(queries[0]), k, tier="cpu")
     lat, lat_embed, lat_search = [], [], []
     for q in queries:
         tq = time.perf_counter()
-        v = enc.embed(q)
+        v = serve_enc.embed(q)
         te = time.perf_counter()
-        index.search(v, k)
+        index.search(v, k, tier="cpu")
         ts = time.perf_counter()
         lat.append((ts - tq) * 1000)
         lat_embed.append((te - tq) * 1000)
@@ -560,16 +651,57 @@ def main() -> None:
     stages["query_embed_ms_p50"] = round(statistics.median(lat_embed), 2)
     stages["query_search_ms_p50"] = round(statistics.median(lat_search), 2)
 
-    # device-only embed throughput + MFU (the MXU-bound inner loop,
-    # separated from the pipeline overhead measured above)
+    # the device path for the record: embed + fused top-k on TPU (2 round
+    # trips); right answer for batched queries, higher floor for single ones
+    index.search(enc.embed(queries[0]), k)  # warm
+    lat_dev = []
+    for q in queries[:16]:
+        tq = time.perf_counter()
+        index.search(enc.embed(q), k)
+        lat_dev.append((time.perf_counter() - tq) * 1000)
+    stages["query_device_path_ms_p50"] = round(statistics.median(lat_dev), 2)
+
+    # end-to-end embed throughput (tokenize + h2d + forward, full-corpus
+    # dispatch, scalar-checksum sync — the steady-state ingest pattern)
+    from pathway_tpu.ops.device_store import DeviceVecStore
+
+    import jax
+    import jax.numpy as jnp
+
+    e2e_store = DeviceVecStore(enc.dimensions)
     t2 = time.perf_counter()
-    n_embed_batches = 8
-    for _ in range(n_embed_batches):
-        enc.embed_batch(docs[:batch])
+    enc.embed_batch_device(docs, store=e2e_store)
+    float(jnp.sum(jnp.stack([jnp.sum(b) for b in e2e_store._batches])))
     t3 = time.perf_counter()
-    flops = _encoder_flops_per_batch(enc.cfg, batch, seq_T) * n_embed_batches
-    achieved = flops / (t3 - t2)
-    gen = os.environ.get("PALLAS_AXON_TPU_GEN", "")
+    embed_tokens_per_sec = n_docs * seq_T / (t3 - t2)
+
+    # device-compute MFU: a lax.scan of forwards whose tokens depend on the
+    # carry (so XLA cannot hoist the body), timed as one program.  This
+    # isolates MXU efficiency from the tunnel's per-dispatch/transfer costs,
+    # which the end-to-end number above includes.
+    from pathway_tpu.models.encoder import encode as _encode
+
+    B_mfu, N_scan = 1024, 32
+    dids = jnp.asarray(
+        np.random.default_rng(0).integers(0, 32000, (B_mfu, seq_T)), jnp.int32
+    )
+
+    def _mfu_probe(p, tok):
+        def body(c, _):
+            tok2 = (tok + (c.astype(jnp.int32) & 1)) % enc.cfg.vocab_size
+            return jnp.sum(_encode(p, enc.cfg, tok2, None)), None
+
+        acc, _ = jax.lax.scan(body, jnp.float32(0), None, length=N_scan)
+        return acc
+
+    probe = jax.jit(_mfu_probe)
+    float(probe(enc.params, dids))  # compile
+    t4 = time.perf_counter()
+    float(probe(enc.params, dids))
+    t5 = time.perf_counter()
+    flops = _encoder_flops_per_batch(enc.cfg, B_mfu, seq_T) * N_scan
+    achieved = flops / (t5 - t4)
+    gen = _tpu_generation()
     peak = _TPU_PEAK.get(gen) if backend == "tpu" else None
     mfu = round(achieved / peak, 4) if peak else None
 
@@ -599,10 +731,10 @@ def main() -> None:
                 "query_p50_ms": round(p50, 2),
                 "query_p95_ms": round(p95, 2),
                 "wordcount_rows_per_sec": round(wordcount_rps),
-                "embed_tokens_per_sec": round(
-                    batch * seq_T * n_embed_batches / (t3 - t2)
-                ),
+                "embed_tokens_per_sec": round(embed_tokens_per_sec),
                 "embed_mfu": mfu,
+                "embed_mfu_note": "device-compute (scan probe); "
+                                  "embed_tokens_per_sec is end-to-end",
                 "embed_gflops_per_sec": round(achieved / 1e9, 1),
                 "stages": stages,
                 "generation": generation,

@@ -887,12 +887,17 @@ class IxOperator(DiffOutputOperator):
         if ptr is None:
             if self.optional:
                 return (None,) * self.target_ncols
-            return None
+            # non-optional lookup of a null pointer: poisoned row
+            # (reference: ix errors on missing keys rather than dropping)
+            return (ERROR,) * self.target_ncols
         trow = self.state[1].get_row(ptr)
         if trow is None:
             if self.optional:
                 return (None,) * self.target_ncols
-            return None
+            # missing target key: Error row, not a silent drop — this is
+            # what makes with_universe_of misuse visible (universe algebra
+            # says the universes are equal; the data disagrees)
+            return (ERROR,) * self.target_ncols
         return trow
 
 
@@ -1036,5 +1041,8 @@ class OutputOperator(Operator):
                 self._on_time(time, batch)
 
     def on_end(self):
+        # idempotent: the streaming loop may close a sink early (all of its
+        # upstream sources finished) and the final drain calls again
         if self._on_end is not None:
-            self._on_end()
+            cb, self._on_end = self._on_end, None
+            cb()

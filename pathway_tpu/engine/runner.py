@@ -117,6 +117,21 @@ def lower(sinks: list[pg.OpNode]) -> LoweredGraph:
 
     for sink in sinks:
         build(sink)
+    # companion sinks: a feedback-loop source (AsyncTransformer) is fed by a
+    # subscribe node on its INPUT table — a side-effect sink the tree-shake
+    # from the requested outputs cannot see.  Pull such sinks in whenever
+    # their source landed in the lowered graph (fixpoint: a companion may
+    # itself reference further sources with companions).
+    while True:
+        extra = []
+        for _op, source in list(lg.input_ops):
+            for node in getattr(source, "companion_sinks", ()):
+                if node.id not in lg.by_node:
+                    extra.append(node)
+        if not extra:
+            break
+        for node in extra:
+            build(node)
     return lg
 
 
@@ -364,6 +379,58 @@ class GraphRunner:
         logical = sched.frontier + 2 if sched.frontier >= 0 else 0
         if logical % 2:
             logical += 1
+        # close the initial static time so its output flushes to the sinks
+        # even if no live source ever produces an event (an AsyncTransformer
+        # feeding only off static input needs its on_change/on_time_end NOW,
+        # not at the first live commit)
+        sched.pending[logical]  # touch: creates the bucket
+        sched._note_time(logical)
+        sched.run_until_idle()
+        logical += 2
+
+        # per-sink upstream live sources: a sink whose upstream inputs have
+        # ALL finished gets its on_end early (reference: subscribe's
+        # on_subscribe_end fires when the input frontier closes, not when
+        # the whole run stops — the AsyncTransformer feedback loop relies on
+        # this to know no more invocations are coming)
+        from . import operators as _ops
+
+        live_ids = {op.id for op, _s in live}
+        upstream_live: dict[int, set[int]] = {}
+        for op in sched.operators:
+            if isinstance(op, _ops.OutputOperator):
+                seen_up: set[int] = set()
+                stack = list(op.inputs)
+                ups: set[int] = set()
+                while stack:
+                    u = stack.pop()
+                    if u.id in seen_up:
+                        continue
+                    seen_up.add(u.id)
+                    if u.id in live_ids:
+                        ups.add(u.id)
+                    stack.extend(u.inputs)
+                upstream_live[op.id] = ups
+        closed_sinks: set[int] = set()
+
+        def _close_finished_sinks() -> None:
+            # in-flight fully-async UDF completions still deliver rows after
+            # their (static) inputs finished — no sink may close before they
+            # drain, or subscribers would see on_end before those on_changes
+            if any(
+                getattr(op, "_completions", None) for op in sched.operators
+            ):
+                return
+            for op in sched.operators:
+                if (
+                    isinstance(op, _ops.OutputOperator)
+                    and op.id not in closed_sinks
+                    and upstream_live.get(op.id, set()) <= finished
+                ):
+                    closed_sinks.add(op.id)
+                    op.on_end()
+
+        _close_finished_sinks()
         import os as _os
 
         tracker = None
@@ -381,6 +448,7 @@ class GraphRunner:
                 events = source.poll()
                 if events is None:
                     finished.add(op.id)
+                    got_any = True  # a flush tick delivers buffered output
                     continue
                 if events:
                     got_any = True
@@ -402,6 +470,7 @@ class GraphRunner:
             else:
                 slept = autocommit_ms / 1000.0
                 _time.sleep(slept)
+            _close_finished_sinks()
             mgr = getattr(self, "_snapshot_mgr", None)
             if mgr is not None:
                 mgr.maybe_snapshot()
@@ -447,10 +516,17 @@ class GraphRunner:
 
 def run_tables(*tables: Table) -> list[CapturedStream]:
     """Capture the final update streams of the given tables (test harness —
-    mirrors GraphRunner.run_tables, reference tests/utils.py:314)."""
+    mirrors GraphRunner.run_tables, reference tests/utils.py:314).
+
+    Graphs with live sources (AsyncTransformer feedback loops, connector
+    subjects that close when done) run the streaming loop until those
+    sources finish; pure-static graphs take the batch path."""
     sinks = [t._materialize_capture() for t in tables]
     runner = GraphRunner(sinks)
-    caps = runner.run_batch()
+    if has_live_sources(sinks):
+        caps = runner.run_streaming(autocommit_ms=20)
+    else:
+        caps = runner.run_batch()
     return [caps[s.id] for s in sinks]
 
 

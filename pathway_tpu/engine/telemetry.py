@@ -42,6 +42,14 @@ class MetricsServer:
                 lines.append(
                     f"pathway_operator_state_entries{{{labels}}} {size}"
                 )
+        fabric = getattr(self, "fabric", None)
+        if fabric is not None:
+            # exchange-fabric attribution: where cluster wall-time and
+            # bytes go (send serialization+write, barrier waits, volumes)
+            lines.append("# TYPE pathway_fabric counter")
+            for k, v in fabric.stats.items():
+                val = f"{v:.6f}" if isinstance(v, float) else str(v)
+                lines.append(f'pathway_fabric{{stat="{k}"}} {val}')
         return "\n".join(lines) + "\n"
 
     def render_dashboard(self) -> str:

@@ -81,6 +81,7 @@ def run(
         from ..engine.telemetry import MetricsServer
 
         metrics = MetricsServer(scheduler)
+        metrics.fabric = getattr(runner, "fabric", None)
         metrics.start()
     from ..internals.monitoring import MonitoringDashboard, MonitoringLevel
 

@@ -81,9 +81,9 @@ sys.modules["pathway_tpu.io.minio"] = minio
 
 # long-tail connectors behind the same seam (reference: src/connectors/data_storage/)
 from . import gdrive  # noqa: E402  (real: Drive tree poller behind a client seam)
+from . import mysql  # noqa: E402  (real: CDC polling + dialect writers)
+from . import deltalake  # noqa: E402  (real: native Delta log + parquet parts)
 sharepoint = _make_stub("sharepoint", "Office365-REST client")
-mysql = _make_stub("mysql", "pymysql")
-deltalake = _make_stub("deltalake", "deltalake")
 iceberg = _make_stub("iceberg", "pyiceberg")
 nats = _make_stub("nats", "nats-py")
 mqtt = _make_stub("mqtt", "paho-mqtt")

@@ -16,8 +16,8 @@ def subscribe(
     *,
     skip_persisted_batch: bool = True,
     name: str | None = None,
-) -> None:
-    pg.new_output_node(
+):
+    return pg.new_output_node(
         "subscribe",
         [table],
         colnames=table.column_names(),

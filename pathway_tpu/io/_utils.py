@@ -130,6 +130,10 @@ class FilePollingSource(DataSource):
     """
 
     append_only = True
+    # set by persistence wiring: raw objects cache (CachedObjectStorage) so
+    # parsing survives source disappearance (cached_object_storage.rs)
+    object_cache = None
+    supports_object_cache = True
 
     def __init__(self, path: str, parse_file: Callable[[str], list[dict]],
                  schema: SchemaMetaclass, poll_interval_s: float = 0.5,
@@ -142,6 +146,18 @@ class FilePollingSource(DataSource):
         self._progress: dict[str, int] = {}  # file -> rows already emitted
         self._fails: dict[str, tuple[float, int]] = {}  # file -> (mtime, count)
         self._last_poll = 0.0
+        import inspect
+
+        try:
+            params = inspect.signature(parse_file).parameters
+        except (TypeError, ValueError):
+            params = {}
+        self._parse_takes_data = "data" in params
+        self._parse_takes_meta = "cached_metadata" in params
+        # optional hook (set by the format layer, e.g. fs.read with
+        # with_metadata): metadata captured alongside each cached object so
+        # cache-served rows carry the same _metadata as live ones
+        self.cache_metadata_fn = None
 
     def is_live(self) -> bool:
         return True
@@ -184,12 +200,59 @@ class FilePollingSource(DataSource):
             ]
         return out
 
+    def _cache_put(self, f: str, mtime: float) -> None:
+        if self.object_cache is None or not self._parse_takes_data:
+            return
+        try:
+            with open(f, "rb") as fh:
+                payload = fh.read()
+            meta = (
+                self.cache_metadata_fn(f)
+                if self.cache_metadata_fn is not None else {"mtime": mtime}
+            )
+            self.object_cache.put(f, payload, version=mtime, metadata=meta)
+        except OSError:
+            pass
+
+    def _cached_events(self) -> list:
+        """Serve rows from cached objects whose origin vanished before all
+        their rows were emitted (crash between download and ingest)."""
+        if self.object_cache is None or not self._parse_takes_data:
+            return []
+        events = []
+        for uri in self.object_cache.list_uris():
+            if os.path.exists(uri) or uri in self._seen:
+                continue
+            payload = self.object_cache.get(uri)
+            if payload is None:
+                continue
+            try:
+                if self._parse_takes_meta:
+                    dicts = self.parse_file(
+                        uri, data=payload,
+                        cached_metadata=self.object_cache.metadata(uri),
+                    )
+                else:
+                    dicts = self.parse_file(uri, data=payload)
+            except Exception:
+                continue
+            self._seen[uri] = -1.0  # cache-served; origin gone
+            start = self._progress.get(uri, 0)
+            if len(dicts) <= start:
+                continue
+            events.extend(
+                events_from_dicts(dicts, self.schema, seed=uri,
+                                  start_index=start)
+            )
+            self._progress[uri] = len(dicts)
+        return events
+
     def poll(self):
         now = time.monotonic()
         if now - self._last_poll < self.poll_interval_s:
             return []
         self._last_poll = now
-        events = []
+        events = self._cached_events()
         for f in self._files():
             try:
                 mtime = os.path.getmtime(f)
@@ -199,6 +262,7 @@ class FilePollingSource(DataSource):
                 continue
             try:
                 dicts = self.parse_file(f)
+                self._cache_put(f, mtime)
             except Exception:
                 # mid-write or unreadable: retry on later polls rather than
                 # silently skipping the file's rows — but a file that keeps
@@ -342,6 +406,12 @@ def _jsonable(v):
         return base64.b64encode(v).decode()
     import numpy as np
 
+    from ..ops.device_store import DeviceVec
+
+    if isinstance(v, DeviceVec):
+        # writers materialize device-resident vectors (the one consumer
+        # class that genuinely needs the numbers on host)
+        return v.to_numpy().tolist()
     if isinstance(v, np.ndarray):
         return v.tolist()
     return v

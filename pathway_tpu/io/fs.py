@@ -67,17 +67,32 @@ def read(
         by_file = format in ("binary", "plaintext_by_file")
         sch = schema or (_binary_schema(with_metadata) if binary else _plaintext_schema(with_metadata))
 
-        def parse_file(p: str) -> list[dict]:
-            meta = _metadata_for(p) if with_metadata else None
+        def parse_file(p: str, data: bytes | None = None,
+                       cached_metadata: dict | None = None) -> list[dict]:
+            # data: raw payload from CachedObjectStorage when the origin
+            # file is gone (persistence/cached_objects.py); cached_metadata
+            # is the file metadata captured when the object was cached
+            meta = None
+            if with_metadata:
+                meta = cached_metadata if data is not None else _metadata_for(p)
             if binary:
-                with open(p, "rb") as f:
-                    rows = [{"data": f.read()}]
+                if data is None:
+                    with open(p, "rb") as f:
+                        data = f.read()
+                rows = [{"data": data}]
             elif by_file:
-                with open(p, encoding="utf-8", errors="replace") as f:
-                    rows = [{"data": f.read()}]
+                text = (
+                    data.decode("utf-8", errors="replace") if data is not None
+                    else open(p, encoding="utf-8", errors="replace").read()
+                )
+                rows = [{"data": text}]
             else:
-                with open(p, encoding="utf-8", errors="replace") as f:
-                    rows = [{"data": line.rstrip("\n")} for line in f]
+                text = (
+                    data.decode("utf-8", errors="replace") if data is not None
+                    else open(p, encoding="utf-8", errors="replace").read()
+                )
+                rows = [{"data": line.rstrip("\n")}
+                        for line in text.splitlines()]
             if with_metadata:
                 for r in rows:
                     r["_metadata"] = meta
@@ -97,6 +112,8 @@ def read(
                 events.extend(events_from_dicts(parse_file(f), sch, seed=f))
             return make_input_table(sch, StaticDataSource(events), name="fs")
         source = FilePollingSource(path, parse_file, sch)
+        if with_metadata:
+            source.cache_metadata_fn = _metadata_for
         return make_input_table(sch, source, name="fs")
     raise ValueError(f"unknown format {format!r}")
 

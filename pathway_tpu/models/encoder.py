@@ -273,8 +273,80 @@ class JaxEncoder:
         self.stats["calls"] += 1
         return out[: len(texts)]
 
+    def _prepare(self, texts: list[str]):
+        """tokenize + pad one chunk; returns (ids, mask, n_valid)."""
+        import time as _time
+
+        t0 = _time.perf_counter()
+        toks = [self.tokenizer.encode(t)[: self.cfg.max_len] for t in texts]
+        t1 = _time.perf_counter()
+        max_t = max(1, max(len(t) for t in toks))
+        T = self._bucket(max_t, self.seq_buckets)
+        B = self._bucket(len(texts), self.batch_buckets)
+        ids = np.zeros((B, T), np.int32)
+        if len(texts) == B and all(len(t) == T for t in toks):
+            for i, t in enumerate(toks):
+                ids[i] = t
+            mask = None
+        else:
+            mask = np.zeros((B, T), bool)
+            for i, t in enumerate(toks):
+                t = t[:T]
+                ids[i, : len(t)] = t
+                mask[i, : len(t)] = True
+        self.stats["tokenize_s"] += t1 - t0
+        self.stats["pad_s"] += _time.perf_counter() - t1
+        return ids, mask, len(texts)
+
+    def embed_batch_device(self, texts: list[str], store=None) -> list:
+        """Device-resident embed: dispatches the forward pass WITHOUT
+        synchronizing or fetching, and returns per-row DeviceVec handles
+        into `store` (created on first use).  Chunks at the largest batch
+        bucket pipeline back-to-back on the device — measured <1 ms/batch
+        amortized vs ~50-90 ms per synchronizing call over the TPU tunnel.
+
+        This is the ingest path: the KNN index consumes the handles and
+        consolidates rows on device (ops/device_store.py)."""
+        if store is None:
+            if getattr(self, "_store", None) is None:
+                from ..ops.device_store import DeviceVecStore
+
+                self._store = DeviceVecStore(self.cfg.d_model)
+            store = self._store
+        if not texts:
+            return []
+        max_b = self.batch_buckets[-1]
+        out = []
+        for i in range(0, len(texts), max_b):
+            chunk = texts[i : i + max_b]
+            ids, mask, n = self._prepare(chunk)
+            dev = self._fwd(
+                self.params, token_ids=jnp.asarray(ids),
+                mask=None if mask is None else jnp.asarray(mask),
+            )
+            out.extend(store.append_batch(dev, n_valid=n))
+            self.stats["texts"] += n
+            self.stats["calls"] += 1
+        return out
+
     def embed(self, text: str) -> np.ndarray:
         return self.embed_batch([text])[0]
+
+    def cpu_mirror(self):
+        """Host-side mirror — the serving latency tier (single queries).
+
+        Over the axon tunnel a single-query device round trip has a
+        ~50-100 ms floor regardless of compute, so latency-critical single
+        queries are served on the host while bulk ingest stays on TPU.  The
+        mirror runs the same math in numpy/BLAS, which measures ~3.5x
+        faster than XLA-CPU at B=1 (models/host_encoder.py)."""
+        if getattr(self, "_cpu_mirror", None) is None:
+            from .host_encoder import make_host_mirror
+
+            self._cpu_mirror = make_host_mirror(
+                self.cfg, self.params, self.tokenizer
+            )
+        return self._cpu_mirror
 
     def __call__(self, text: str) -> np.ndarray:
         return self.embed(text)

@@ -1,0 +1,112 @@
+"""Device-resident embedding store: vectors live in HBM from the encoder's
+forward pass to the index matmul, and never round-trip through the host.
+
+Why this exists (measured on the axon TPU tunnel, round 3): device->host
+fetch runs at ~1.5-7 MB/s and each synchronizing dispatch costs ~50-90 ms,
+while back-to-back async dispatches pipeline at <1 ms/batch.  The reference
+architecture (embedder service returns vectors to the host, host pushes them
+into the index — xpacks/llm/embedders.py + brute_force_knn_integration.rs)
+is therefore exactly wrong for this hardware: ingest must keep embeddings on
+device and the host should only ever see token ids and top-k results.
+
+`DeviceVecStore` accumulates the encoder's output batches (each a (B, d)
+jax array) without synchronizing.  `DeviceVec` is the per-row handle that
+flows through the engine as an ordinary column value — tiny on host, with
+lazy `__array__` materialization for any consumer that truly needs numbers.
+The KNN index consolidates referenced rows into one (N, d) device matrix
+with a single gather dispatch (ops/knn.py searches it in-place).
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Any
+
+import numpy as np
+
+_store_ids = itertools.count()
+
+
+class DeviceVecStore:
+    """Append-only pool of device-resident embedding batches."""
+
+    def __init__(self, dimensions: int | None = None):
+        self.id = next(_store_ids)
+        self.dim = dimensions
+        self._batches: list[Any] = []  # jax arrays, (B_i, d)
+
+    def append_batch(self, dev_arr, n_valid: int | None = None) -> list["DeviceVec"]:
+        """Register one encoder output batch (no sync, no fetch); returns a
+        handle per valid row."""
+        if self.dim is None:
+            self.dim = int(dev_arr.shape[1])
+        bid = len(self._batches)
+        self._batches.append(dev_arr)
+        n = int(dev_arr.shape[0]) if n_valid is None else n_valid
+        return [DeviceVec(self, bid, r) for r in range(n)]
+
+    def n_batches(self) -> int:
+        return len(self._batches)
+
+    def gather(self, refs: list[tuple[int, int]]):
+        """One (N, d) device array holding the given (batch, row) refs, built
+        with a single concatenate + take dispatch."""
+        import jax.numpy as jnp
+
+        if not refs:
+            return jnp.zeros((0, self.dim or 0), jnp.float32)
+        full = jnp.concatenate(
+            [b.astype(jnp.float32) for b in self._batches], axis=0
+        )
+        offsets = np.cumsum([0] + [int(b.shape[0]) for b in self._batches])
+        flat = np.asarray(
+            [offsets[bid] + row for bid, row in refs], dtype=np.int32
+        )
+        return jnp.take(full, jnp.asarray(flat), axis=0)
+
+    def row(self, batch: int, r: int) -> np.ndarray:
+        """Host materialization of one row (the slow path — serving and
+        ingest never call this; debug/pickle/compat consumers may)."""
+        return np.asarray(self._batches[batch][r], dtype=np.float32)
+
+
+class DeviceVec:
+    """Handle to one device-resident embedding row.
+
+    Behaves as a value in the engine: equality/hash follow the (store,
+    batch, row) identity, which is stable for the lifetime of the run;
+    pickling materializes to numpy so snapshots stay self-contained.
+    """
+
+    __slots__ = ("store", "batch", "row_idx")
+
+    def __init__(self, store: DeviceVecStore, batch: int, row_idx: int):
+        self.store = store
+        self.batch = batch
+        self.row_idx = row_idx
+
+    # -- engine value semantics -------------------------------------------
+    def __eq__(self, other):
+        if isinstance(other, DeviceVec):
+            return (self.store.id, self.batch, self.row_idx) == (
+                other.store.id, other.batch, other.row_idx
+            )
+        return NotImplemented
+
+    def __hash__(self):
+        return hash(("DeviceVec", self.store.id, self.batch, self.row_idx))
+
+    def __repr__(self):
+        return f"DeviceVec(store={self.store.id}, batch={self.batch}, row={self.row_idx})"
+
+    # -- lazy host materialization ----------------------------------------
+    def __array__(self, dtype=None, copy=None):
+        arr = self.store.row(self.batch, self.row_idx)
+        return arr.astype(dtype) if dtype is not None else arr
+
+    def to_numpy(self) -> np.ndarray:
+        return self.store.row(self.batch, self.row_idx)
+
+    def __reduce__(self):
+        # snapshots/pickles carry the numbers, not the handle
+        return (np.asarray, (self.to_numpy(),))

@@ -97,3 +97,36 @@ def batched_topk(matrix: np.ndarray, queries: np.ndarray, k: int, metric: str = 
     k = min(k, matrix.shape[0])
     vals, idx = _batched_topk_fn(metric, k)(jnp.asarray(matrix), jnp.asarray(queries))
     return np.asarray(vals), np.asarray(idx)
+
+
+@functools.lru_cache(maxsize=16)
+def _single_topk_fn(metric: str, k: int):
+    jax, jnp = _jax()
+
+    @jax.jit
+    def run(m, q):
+        if metric == "cos_prenorm":
+            scores = m @ (q / (jnp.linalg.norm(q) + 1e-12))
+        elif metric == "cos":
+            qn = q / (jnp.linalg.norm(q) + 1e-12)
+            mn = m / (jnp.linalg.norm(m, axis=1, keepdims=True) + 1e-12)
+            scores = mn @ qn
+        elif metric == "dot":
+            scores = m @ q
+        else:  # l2sq
+            scores = 2.0 * (m @ q) - jnp.sum(m * m, axis=1) - jnp.sum(q * q)
+        return jax.lax.top_k(scores, k)
+
+    return run
+
+
+def device_topk(matrix, query: np.ndarray, k: int, metric: str = "cos"):
+    """Single-query top-k computed ENTIRELY on device; only the (k,) values
+    and indices cross back to the host.  Fetching the full score vector (the
+    old device_topk_scores path) costs O(N) device->host bytes — measured
+    ~1.5-7 MB/s over the axon tunnel, this dominates serving latency for any
+    index past ~100k rows."""
+    jax, jnp = _jax()
+    k = min(k, int(matrix.shape[0]))
+    vals, idx = _single_topk_fn(metric, k)(matrix, jnp.asarray(query))
+    return np.asarray(vals), np.asarray(idx)

@@ -394,6 +394,7 @@ class ClusterRunner:
         self._end_phase()
         if self.fabric is not None:
             self.fabric.shutdown_barrier()
+            _dump_fabric_stats(self.fabric, self.pid)
             self.fabric.close()
         return self.captures
 
@@ -528,6 +529,7 @@ class ClusterRunner:
         self._end_phase()
         if self.fabric is not None:
             self.fabric.shutdown_barrier()
+            _dump_fabric_stats(self.fabric, self.pid)
             self.fabric.close()
         if rescale_code is not None:
             print(
@@ -565,3 +567,22 @@ def run_tables_sharded(*tables, n_shards: int = 4) -> list[CapturedStream]:
     runner = ClusterRunner(sinks, n_local_shards=n_shards)
     caps = runner.run_batch()
     return [caps[s.id] for s in sinks]
+
+
+def _dump_fabric_stats(fabric, pid: int) -> None:
+    """Write exchange counters where the supervisor/bench can read them
+    (PW_FABRIC_STATS_DIR); always logged at debug level."""
+    import json as _json
+    import logging as _logging
+    import os as _os
+
+    _logging.getLogger(__name__).debug("fabric stats pid=%s: %s", pid,
+                                       fabric.stats)
+    d = _os.environ.get("PW_FABRIC_STATS_DIR")
+    if d:
+        try:
+            _os.makedirs(d, exist_ok=True)
+            with open(_os.path.join(d, f"fabric_{pid}.json"), "w") as f:
+                _json.dump(fabric.stats, f)
+        except OSError:
+            pass

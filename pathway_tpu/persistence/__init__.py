@@ -308,10 +308,14 @@ class Config:
         return cls(backend, snapshot_interval_ms, persistence_mode)
 
     def __init__(self, backend: Backend | None = None, *, snapshot_interval_ms: int = 0,
-                 persistence_mode: str = "persisting", **kwargs):
+                 persistence_mode: str = "persisting", cache_objects: bool = True,
+                 **kwargs):
         self.backend = backend
         self.snapshot_interval_ms = snapshot_interval_ms
         self.persistence_mode = persistence_mode
+        # raw-object caching (CachedObjectStorage); on by default like the
+        # reference's scanner-backed connectors
+        self.cache_objects = cache_objects
 
 
 # Journal format history: v1 (round 1) keyed primary-key rows off raw
@@ -520,6 +524,14 @@ def attach_persistence(runner, config: Config) -> None:
             folded_counts=fold_counts,
             min_time=snap["frontier"] if snap is not None else None,
         )
+        if getattr(source, "supports_object_cache", False) and getattr(
+            config, "cache_objects", True
+        ):
+            # raw-object cache: downloads survive source disappearance
+            # (reference: src/persistence/cached_object_storage.rs)
+            from .cached_objects import CachedObjectStorage
+
+            source.object_cache = CachedObjectStorage(backend)
     if snapshots_on:
         from .snapshots import SnapshotManager
 

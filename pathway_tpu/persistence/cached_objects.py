@@ -1,0 +1,94 @@
+"""CachedObjectStorage: persist raw source objects so (re-)parsing survives
+source disappearance (reference: src/persistence/cached_object_storage.rs,
+1,164 LoC).
+
+The reference caches every object a scanner downloads (S3 key, Drive file,
+local file) in the persistence backend, keyed by URI with a version stamp
+and metadata; when the origin disappears — expired S3 key, deleted file —
+the pipeline keeps serving the object's rows and restarts re-parse from the
+cache instead of failing.
+
+Here the store rides the same `Backend` journal/metadata API as the rest of
+persistence (one `obj:` stream per URI, metadata index under `objcache:`),
+and `FilePollingSource` consults it: every successfully read file is cached
+(payload + mtime version), and a file that vanishes or turns unreadable is
+served from the cache instead of being dropped.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+from typing import Any
+
+from . import Backend
+
+
+def _uri_key(uri: str) -> str:
+    return hashlib.sha256(uri.encode()).hexdigest()[:32]
+
+
+class CachedObjectStorage:
+    def __init__(self, backend: Backend, prefix: str = "objcache"):
+        self.backend = backend
+        self.prefix = prefix
+        self._index: dict[str, dict] = {}
+        raw = backend.get_metadata(f"{self.prefix}:index")
+        if raw:
+            try:
+                self._index = json.loads(raw.decode())
+            except ValueError:
+                self._index = {}
+
+    # -- index -------------------------------------------------------------
+    def _save_index(self) -> None:
+        self.backend.put_metadata(
+            f"{self.prefix}:index", json.dumps(self._index).encode()
+        )
+
+    def contains(self, uri: str) -> bool:
+        return uri in self._index
+
+    def version(self, uri: str) -> Any:
+        entry = self._index.get(uri)
+        return entry["version"] if entry else None
+
+    def metadata(self, uri: str) -> dict | None:
+        entry = self._index.get(uri)
+        return entry["metadata"] if entry else None
+
+    def list_uris(self) -> list[str]:
+        return sorted(self._index)
+
+    # -- payloads ----------------------------------------------------------
+    def put(self, uri: str, payload: bytes, *, version: Any = None,
+            metadata: dict | None = None) -> None:
+        """Store/refresh one object.  Same version => no rewrite."""
+        entry = self._index.get(uri)
+        if entry is not None and version is not None and entry["version"] == version:
+            return
+        stream = f"{self.prefix}:obj:{_uri_key(uri)}"
+        if hasattr(self.backend, "replace_all"):
+            self.backend.replace_all(stream, [payload])
+        else:  # append-only backend: last record wins
+            self.backend.append(stream, payload)
+        self._index[uri] = {
+            "version": version, "metadata": metadata or {},
+            "size": len(payload),
+        }
+        self._save_index()
+
+    def get(self, uri: str) -> bytes | None:
+        if uri not in self._index:
+            return None
+        stream = f"{self.prefix}:obj:{_uri_key(uri)}"
+        records = self.backend.read_all(stream)
+        return records[-1] if records else None
+
+    def remove(self, uri: str) -> None:
+        if uri in self._index:
+            del self._index[uri]
+            stream = f"{self.prefix}:obj:{_uri_key(uri)}"
+            if hasattr(self.backend, "replace_all"):
+                self.backend.replace_all(stream, [])
+            self._save_index()

@@ -68,6 +68,13 @@ class BruteForceKnn(InnerIndex):
         self.mesh = mesh
         self.mesh_axis = mesh_axis
         self._device_cache = None
+        # device-resident mode: slots whose vector lives in a DeviceVecStore
+        # (ops/device_store.py) and never crossed to the host.  slot ->
+        # (store, batch, row); consolidation gathers them on device.
+        self._dev_refs: dict[int, tuple] = {}
+        self._version = 0
+        self._dev_matrix = None  # (token, device (n,d) matrix, prenormed?)
+        self._host_mirror = None  # (token, np matrix) for the CPU latency tier
 
     def _ensure(self, dim: int) -> None:
         if self.matrix is None:
@@ -75,24 +82,54 @@ class BruteForceKnn(InnerIndex):
             self.matrix = np.zeros((self.capacity, dim), dtype=np.float32)
 
     def add(self, key: int, item: Any, metadata: Any = None) -> None:
+        from ...ops.device_store import DeviceVec
+
+        if isinstance(item, DeviceVec):
+            # device-resident ingest: record the HBM ref, no host transfer
+            self._ensure(item.store.dim)
+            if key in self.slot_of:
+                slot = self.slot_of[key]
+                self._dev_refs[slot] = (item.store, item.batch, item.row_idx)
+                self.metadata[key] = metadata
+                self._invalidate()
+                return
+            self._grow_if_full()
+            self._dev_refs[self.n] = (item.store, item.batch, item.row_idx)
+            self.slot_of[key] = self.n
+            self.keys.append(key)
+            self.metadata[key] = metadata
+            self.n += 1
+            self._invalidate()
+            return
         vec = np.asarray(item, dtype=np.float32).reshape(-1)
         self._ensure(vec.shape[0])
         if key in self.slot_of:
-            self.matrix[self.slot_of[key]] = vec
+            slot = self.slot_of[key]
+            self.matrix[slot] = vec
+            self._dev_refs.pop(slot, None)
             self.metadata[key] = metadata
-            self._device_cache = None
+            self._invalidate()
             return
-        if self.n == self.capacity:
-            self.capacity *= 2
-            new = np.zeros((self.capacity, self.dim), dtype=np.float32)
-            new[: self.n] = self.matrix[: self.n]
-            self.matrix = new
+        self._grow_if_full()
         self.matrix[self.n] = vec
         self.slot_of[key] = self.n
         self.keys.append(key)
         self.metadata[key] = metadata
         self.n += 1
+        self._invalidate()
+
+    def _grow_if_full(self) -> None:
+        if self.n == self.capacity:
+            self.capacity *= 2
+            new = np.zeros((self.capacity, self.dim), dtype=np.float32)
+            new[: self.n] = self.matrix[: self.n]
+            self.matrix = new
+
+    def _invalidate(self) -> None:
         self._device_cache = None
+        self._dev_matrix = None
+        self._host_mirror = None
+        self._version += 1
 
     def remove(self, key: int) -> None:
         slot = self.slot_of.pop(key, None)
@@ -102,15 +139,78 @@ class BruteForceKnn(InnerIndex):
         last_key = self.keys[last]
         if slot != last:
             self.matrix[slot] = self.matrix[last]
+            last_ref = self._dev_refs.pop(last, None)
+            if last_ref is not None:
+                self._dev_refs[slot] = last_ref
+            else:
+                self._dev_refs.pop(slot, None)
             self.keys[slot] = last_key
             self.slot_of[last_key] = slot
+        else:
+            self._dev_refs.pop(slot, None)
         self.keys.pop()
         self.metadata.pop(key, None)
         self.n = last
-        self._device_cache = None
+        self._invalidate()
+
+    # -- device-resident consolidation ------------------------------------
+    def _device_matrix(self, prenorm: bool):
+        """One (n, d) device array over all live slots, gathered with a
+        single dispatch; host rows (if any) are uploaded alongside.  Cached
+        until the next mutation."""
+        token = (self._version, prenorm)
+        if self._dev_matrix is not None and self._dev_matrix[0] == token:
+            return self._dev_matrix[1]
+        import jax.numpy as jnp
+
+        stores = {ref[0].id for ref in self._dev_refs.values()}
+        single_store = len(stores) == 1
+        if single_store and len(self._dev_refs) == self.n and self.n > 0:
+            store = next(iter(self._dev_refs.values()))[0]
+            refs = [
+                (self._dev_refs[s][1], self._dev_refs[s][2])
+                for s in range(self.n)
+            ]
+            m = store.gather(refs)
+        else:
+            # mixed, host-only, or multi-store: upload host rows, then one
+            # gather-and-scatter per distinct DeviceVecStore
+            m = jnp.asarray(self.matrix[: self.n])
+            if self._dev_refs:
+                by_store: dict[int, tuple] = {}
+                for s, (store, b, r) in self._dev_refs.items():
+                    by_store.setdefault(store.id, (store, []))[1].append(
+                        (s, b, r)
+                    )
+                for store, entries in by_store.values():
+                    slots = [s for s, _b, _r in entries]
+                    gathered = store.gather(
+                        [(b, r) for _s, b, r in entries]
+                    )
+                    m = m.at[jnp.asarray(slots, jnp.int32)].set(gathered)
+        if prenorm:
+            m = m / (jnp.linalg.norm(m, axis=1, keepdims=True) + 1e-12)
+        self._dev_matrix = (token, m)
+        return m
+
+    def host_matrix(self) -> np.ndarray:
+        """Host copy of all live vectors for the CPU serving tier — fetched
+        once per index version as float16 (the tunnel's d2h bandwidth is the
+        cost, so bytes are halved) and cached."""
+        if self._host_mirror is not None and self._host_mirror[0] == self._version:
+            return self._host_mirror[1]
+        if not self._dev_refs:
+            m = self.matrix[: self.n].copy()
+        else:
+            import jax.numpy as jnp
+
+            dev = self._device_matrix(prenorm=False)
+            m = np.asarray(dev.astype(jnp.float16)).astype(np.float32)
+        self._host_mirror = (self._version, m)
+        return m
 
     def _scores(self, q: np.ndarray) -> np.ndarray:
-        m = self.matrix[: self.n]
+        m = self.host_matrix() if self._dev_refs else self.matrix[: self.n]
         if self.metric == "cos":
             qn = q / (np.linalg.norm(q) + 1e-12)
             mn = m / (np.linalg.norm(m, axis=1, keepdims=True) + 1e-12)
@@ -126,6 +226,21 @@ class BruteForceKnn(InnerIndex):
         are identical (both f32)."""
         if self.n == 0:
             return [[] for _ in queries]
+        if self._dev_refs:
+            # device-resident rows: one batched matmul+top-k dispatch against
+            # the consolidated HBM matrix; only (Q, k) results come back
+            from ...ops.knn import batched_topk
+
+            qs = np.asarray(
+                [np.asarray(q, np.float32).reshape(-1) for q in queries]
+            )
+            vals, idx = batched_topk(
+                self._device_matrix(prenorm=False), qs, k, self.metric
+            )
+            return [
+                [(self.keys[int(i)], float(v)) for v, i in zip(vi, ii)]
+                for vi, ii in zip(vals, idx)
+            ]
         if self.n < self.device_threshold:
             return [self.search(q, k) for q in queries]
         qs = np.asarray([np.asarray(q, np.float32).reshape(-1) for q in queries])
@@ -137,10 +252,42 @@ class BruteForceKnn(InnerIndex):
             out.append([(self.keys[int(i)], float(v)) for v, i in zip(vi, ii)])
         return out
 
-    def search(self, query: Any, k: int, metadata_filter: str | None = None) -> list[tuple[int, float]]:
+    def search(self, query: Any, k: int, metadata_filter: str | None = None,
+               tier: str = "auto") -> list[tuple[int, float]]:
+        """tier: "auto" (device for device-resident/large indexes), "cpu"
+        (serving latency tier: host-mirror numpy scan — one small matmul,
+        no device round trip), "device" (force the accelerator path)."""
         if self.n == 0:
             return []
         q = np.asarray(query, dtype=np.float32).reshape(-1)
+        if tier == "cpu" and metadata_filter is None:
+            m = self.host_matrix()
+            if self.metric == "cos":
+                qn = q / (np.linalg.norm(q) + 1e-12)
+                mn = m / (np.linalg.norm(m, axis=1, keepdims=True) + 1e-12)
+                scores = mn @ qn
+            elif self.metric == "l2sq":
+                scores = -np.sum((m - q) ** 2, axis=1)
+            else:
+                scores = m @ q
+            kk = min(k, self.n)
+            idx = (
+                np.argpartition(-scores, kk - 1)[:kk]
+                if kk < self.n else np.arange(self.n)
+            )
+            order = idx[np.argsort(-scores[idx])]
+            return [(self.keys[i], float(scores[i])) for i in order]
+        if self._dev_refs and metadata_filter is None:
+            # device-resident rows: matmul + top-k in one dispatch, only
+            # the (k,) results cross the tunnel
+            from ...ops.knn import device_topk
+
+            prenorm = self.metric == "cos"
+            metric = "cos_prenorm" if prenorm else self.metric
+            vals, idx = device_topk(
+                self._device_matrix(prenorm=prenorm), q, k, metric
+            )
+            return [(self.keys[int(i)], float(v)) for v, i in zip(vals, idx)]
         if self.mesh is not None and metadata_filter is None and self.n >= k:
             from ...ops import knn_sharded as ks
 
@@ -167,7 +314,7 @@ class BruteForceKnn(InnerIndex):
             ]
         if self.n >= self.device_threshold:
             try:
-                from ...ops.knn import device_topk_scores, to_device
+                from ...ops.knn import device_topk, to_device
 
                 cache = (self._device_cache or {}).get("single")
                 token = ("single", self.n)
@@ -183,6 +330,15 @@ class BruteForceKnn(InnerIndex):
                     self._device_cache = {**(self._device_cache or {}),
                                           "single": cache}
                 metric = "cos_prenorm" if self.metric == "cos" else self.metric
+                if metadata_filter is None:
+                    # top-k on device; only (k,) values/indices fetched
+                    vals, idx = device_topk(cache[1], q, k, metric)
+                    return [
+                        (self.keys[int(i)], float(v))
+                        for v, i in zip(vals, idx)
+                    ]
+                from ...ops.knn import device_topk_scores
+
                 scores = device_topk_scores(cache[1], q, metric)
             except Exception:
                 scores = self._scores(q)

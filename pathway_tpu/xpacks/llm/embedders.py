@@ -49,7 +49,8 @@ class SentenceTransformerEmbedder(BaseEmbedder):
 
     def __init__(self, model: str | None = None, *, config=None, seed: int = 0,
                  call_kwargs: dict | None = None, device: str = "tpu",
-                 cache_strategy: CacheStrategy | None = None):
+                 cache_strategy: CacheStrategy | None = None,
+                 device_resident: bool | None = None):
         from ...models.encoder import EncoderConfig, JaxEncoder
 
         import os
@@ -62,6 +63,14 @@ class SentenceTransformerEmbedder(BaseEmbedder):
             self._enc = JaxEncoder.from_hf(model)
         else:
             self._enc = JaxEncoder(config or EncoderConfig(), seed=seed)
+        if device_resident is None:
+            # over the TPU tunnel, fetching embeddings to the host costs
+            # orders of magnitude more than computing them; keep batch
+            # outputs in HBM as DeviceVec handles (ops/device_store.py)
+            import jax
+
+            device_resident = jax.default_backend() == "tpu"
+        self.device_resident = device_resident
         if cache_strategy is not None:
             self._embed = with_cache_strategy(  # type: ignore[method-assign]
                 self._embed_uncached, cache_strategy, f"emb:{self.model_name}"
@@ -73,8 +82,13 @@ class SentenceTransformerEmbedder(BaseEmbedder):
     def _embed(self, text: str) -> np.ndarray:
         return self._embed_uncached(text)
 
-    def _embed_many(self, texts: list[str]) -> list[np.ndarray]:
-        return list(self._enc.embed_batch([t or "" for t in texts]))
+    def _embed_many(self, texts: list[str]) -> list:
+        texts = [t or "" for t in texts]
+        if self.device_resident:
+            # no sync, no fetch: handles flow through the engine and the
+            # KNN index consolidates rows on device
+            return self._enc.embed_batch_device(texts)
+        return list(self._enc.embed_batch(texts))
 
     def get_embedding_dimension(self, **kwargs) -> int:
         return self._enc.dimensions

@@ -17,8 +17,11 @@ _CONNECTOR = os.path.join(os.path.dirname(__file__), "airbyte_fake_connector.py"
 
 
 def _source(data_path, streams):
+    # -S skips site hooks: interpreter startup drops ~2s -> ~10ms, which
+    # matters on a 1-core host where the connector subprocess contends with
+    # the engine's streaming loop for the only core
     return ExecutableAirbyteSource(
-        [sys.executable, _CONNECTOR], {"data_path": str(data_path)}, streams
+        [sys.executable, "-S", _CONNECTOR], {"data_path": str(data_path)}, streams
     )
 
 
@@ -72,7 +75,7 @@ def test_airbyte_read_e2e_streaming(tmp_path):
     cfg.write_text(
         f"""
 source:
-  exec: "{sys.executable} {_CONNECTOR}"
+  exec: "{sys.executable} -S {_CONNECTOR}"
   config:
     data_path: "{data}"
 """
@@ -84,14 +87,14 @@ source:
     import threading
 
     def mutate():
-        time.sleep(0.8)
+        time.sleep(1.2)
         _write_data(data, users=[{"id": 1, "name": "a"},
                                  {"id": 2, "name": "b"}],
                     colors=["green"])  # red disappears
 
     th = threading.Thread(target=mutate)
     th.start()
-    pw.run(timeout_s=3.0, autocommit_duration_ms=50,
+    pw.run(timeout_s=6.0, autocommit_duration_ms=50,
            monitoring_level=pw.MonitoringLevel.NONE)
     th.join()
 

@@ -1,0 +1,237 @@
+"""AsyncTransformer full semantics (VERDICT r2 item 7): feedback loop,
+status lifecycle, per-instance consistency with failure poisoning, options,
+cache-backed re-invocation."""
+
+import asyncio
+import threading
+import time
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.internals import parse_graph as pg
+
+
+class OutSchema(pw.Schema):
+    ret: int
+
+
+def _input(markdown="""
+    | value
+1   | 42
+2   | 44
+"""):
+    return pw.debug.table_from_markdown(markdown)
+
+
+def _run_collect(table):
+    from pathway_tpu.engine.runner import run_tables
+
+    [cap] = run_tables(table)
+    return cap.squash()
+
+
+def test_successful_basic():
+    pg.G.clear()
+
+    class Inc(pw.AsyncTransformer, ):
+        output_schema = OutSchema
+
+        async def invoke(self, value):
+            await asyncio.sleep(0.01)
+            return {"ret": value + 1}
+
+    res = Inc(input_table=_input()).successful
+    state = _run_collect(res)
+    assert sorted(r[0] for r in state.values()) == [43, 45]
+
+
+def test_failure_rows_and_status():
+    pg.G.clear()
+
+    class Flaky(pw.AsyncTransformer):
+        output_schema = OutSchema
+
+        async def invoke(self, value):
+            if value == 44:
+                raise RuntimeError("boom")
+            return {"ret": value + 1}
+
+    tr = Flaky(input_table=_input())
+    ok = _run_collect(tr.successful)
+    pg_state = [r[0] for r in ok.values()]
+    assert pg_state == [43]
+    pg.G.clear()
+    tr2 = Flaky(input_table=_input())
+    failed = _run_collect(tr2.failed)
+    assert len(failed) == 1  # the 44 row, with None payload
+    assert list(failed.values())[0][0] is None
+
+
+def test_instance_failure_poisons_group():
+    """With a shared instance, one failure marks the whole group FAILURE
+    (reference _Instance.correct)."""
+    pg.G.clear()
+
+    class Flaky(pw.AsyncTransformer):
+        output_schema = OutSchema
+
+        async def invoke(self, value):
+            if value == 44:
+                raise RuntimeError("boom")
+            return {"ret": value + 1}
+
+    t = _input()
+    tr = Flaky(input_table=t, instance=0)  # every row in one instance
+    failed = _run_collect(tr.failed)
+    assert len(failed) == 2  # both rows report failure
+
+
+def test_instance_results_apply_in_time_order():
+    """Completion order is scrambled (later row finishes first); results for
+    one instance must still apply grouped and ordered by input time."""
+    pg.G.clear()
+    order = []
+
+    class Slow(pw.AsyncTransformer):
+        output_schema = OutSchema
+
+        async def invoke(self, value):
+            # first value sleeps longest: completions arrive reversed
+            await asyncio.sleep(0.2 if value == 42 else 0.01)
+            order.append(value)
+            return {"ret": value + 1}
+
+    tr = Slow(input_table=_input(), instance=0)
+    ok = _run_collect(tr.successful)
+    assert sorted(r[0] for r in ok.values()) == [43, 45]
+    assert order == [44, 42]  # completion really was out of order
+
+
+def test_with_options_retry_and_capacity():
+    pg.G.clear()
+    attempts = {"n": 0}
+
+    class Retry(pw.AsyncTransformer):
+        output_schema = OutSchema
+
+        async def invoke(self, value):
+            attempts["n"] += 1
+            if attempts["n"] < 3 and value == 42:
+                raise RuntimeError("transient")
+            return {"ret": value + 1}
+
+    tr = Retry(input_table=_input("""
+    | value
+1   | 42
+""")).with_options(
+        capacity=2,
+        retry_strategy=pw.udfs.ExponentialBackoffRetryStrategy(
+            max_retries=5, initial_delay=1, backoff_factor=1
+        ),
+    )
+    ok = _run_collect(tr.successful)
+    assert [r[0] for r in ok.values()] == [43]
+    assert attempts["n"] == 3
+
+
+def test_cache_strategy_serves_reinvocation(tmp_path):
+    """The cache makes re-running (= recovery replay) deterministic and
+    cheap: the second graph run answers from the cache."""
+    calls = {"n": 0}
+
+    class Cached(pw.AsyncTransformer):
+        output_schema = OutSchema
+
+        async def invoke(self, value):
+            calls["n"] += 1
+            return {"ret": value + 1}
+
+    for _ in range(2):
+        pg.G.clear()
+        tr = Cached(input_table=_input()).with_options(
+            cache_strategy=pw.udfs.InMemoryCache()
+        )
+        # InMemoryCache is per-instance; share one through the class to
+        # model the persisted cache backend surviving a restart
+        if not hasattr(Cached, "_shared_cache"):
+            Cached._shared_cache = tr._cache_strategy
+        tr._cache_strategy = Cached._shared_cache
+        ok = _run_collect(tr.successful)
+        assert sorted(r[0] for r in ok.values()) == [43, 45]
+    assert calls["n"] == 2  # second run fully cache-served
+
+
+def test_output_table_shows_pending_then_resolves():
+    """Streaming view: rows appear with Pending placeholders, then upsert
+    to their results — observed through the raw output_table stream."""
+    pg.G.clear()
+    seen = []
+
+    class Slow(pw.AsyncTransformer):
+        output_schema = OutSchema
+
+        async def invoke(self, value):
+            await asyncio.sleep(0.2)
+            return {"ret": value + 1}
+
+    tr = Slow(input_table=_input("""
+    | value
+1   | 7
+"""))
+    out = tr.output_table
+    pw.io.subscribe(
+        out,
+        on_change=lambda key, row, time, is_addition: seen.append(
+            (row["_async_status"], row["ret"], is_addition)
+        ),
+    )
+    pw.run(timeout_s=3.0, autocommit_duration_ms=50,
+           monitoring_level=pw.MonitoringLevel.NONE)
+    from pathway_tpu.internals.value import Pending
+
+    assert any(
+        isinstance(s, Pending) and add for s, _r, add in seen
+    ), seen  # pending state was visible
+    assert ("-SUCCESS-", 8, True) in [
+        (s, r, a) for s, r, a in seen
+    ]  # and resolved
+
+
+def test_deletion_removes_output_row():
+    """A retraction in the input removes the corresponding output row."""
+    pg.G.clear()
+
+    class Id(pw.AsyncTransformer):
+        output_schema = OutSchema
+
+        async def invoke(self, value):
+            return {"ret": value}
+
+    class InSchema(pw.Schema):
+        value: int
+
+    from pathway_tpu.internals.datasource import SubjectDataSource
+
+    class _Subj:
+        def _run(self, handle):
+            handle.push((5,), 1, 100)
+            time.sleep(0.4)
+            handle.push((5,), -1, 100)
+            time.sleep(0.3)
+            handle.close()
+
+    src = SubjectDataSource(_Subj(), ["value"], None, append_only=False)
+    from pathway_tpu.io._utils import make_input_table
+
+    t = make_input_table(InSchema, src)
+    tr = Id(input_table=t)
+    net = {}
+
+    def on_change(key, row, time, is_addition):
+        net[row["ret"]] = net.get(row["ret"], 0) + (1 if is_addition else -1)
+
+    pw.io.subscribe(tr.finished, on_change=on_change)
+    pw.run(timeout_s=3.0, autocommit_duration_ms=50,
+           monitoring_level=pw.MonitoringLevel.NONE)
+    assert net.get(5, 0) == 0  # inserted then removed

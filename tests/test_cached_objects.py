@@ -1,0 +1,120 @@
+"""CachedObjectStorage (VERDICT r2 item 8): raw source objects persist in
+the backend so parsing survives source disappearance
+(reference: src/persistence/cached_object_storage.rs)."""
+
+import json
+import os
+import time
+
+import pathway_tpu as pw
+from pathway_tpu.internals import parse_graph as pg
+from pathway_tpu.persistence import Backend
+from pathway_tpu.persistence.cached_objects import CachedObjectStorage
+
+
+def test_store_roundtrip_and_versioning(tmp_path):
+    backend = Backend.filesystem(str(tmp_path / "pstore"))
+    cache = CachedObjectStorage(backend)
+    cache.put("s3://bucket/a.txt", b"hello", version=1,
+              metadata={"etag": "x"})
+    assert cache.contains("s3://bucket/a.txt")
+    assert cache.get("s3://bucket/a.txt") == b"hello"
+    assert cache.version("s3://bucket/a.txt") == 1
+    assert cache.metadata("s3://bucket/a.txt") == {"etag": "x"}
+    # same version: no rewrite; new version: replaced
+    cache.put("s3://bucket/a.txt", b"ignored", version=1)
+    assert cache.get("s3://bucket/a.txt") == b"hello"
+    cache.put("s3://bucket/a.txt", b"world", version=2)
+    assert cache.get("s3://bucket/a.txt") == b"world"
+
+    # the index persists across instances (restart)
+    cache2 = CachedObjectStorage(backend)
+    assert cache2.list_uris() == ["s3://bucket/a.txt"]
+    assert cache2.get("s3://bucket/a.txt") == b"world"
+    cache2.remove("s3://bucket/a.txt")
+    assert CachedObjectStorage(backend).list_uris() == []
+
+
+def test_vanished_file_served_from_cache(tmp_path):
+    """Crash-between-download-and-ingest: the object was cached with more
+    rows than the resume offset says were emitted; the origin file is gone;
+    the remaining rows must still flow (from the cache)."""
+    from pathway_tpu.io.fs import read as fs_read
+    from pathway_tpu.io._utils import FilePollingSource
+
+    data_dir = tmp_path / "data"
+    data_dir.mkdir()
+    f = data_dir / "a.txt"
+    f.write_text("r1\nr2\nr3\n")
+
+    backend = Backend.filesystem(str(tmp_path / "pstore"))
+    cache = CachedObjectStorage(backend)
+
+    pg.G.clear()
+    t = pw.io.plaintext.read(str(data_dir / "*.txt"), mode="streaming")
+    node = t._node
+    source: FilePollingSource = node.params["source"]
+    source.object_cache = cache
+    source.poll_interval_s = 0.0
+    events = source.poll()
+    assert len(events) == 3
+    assert cache.contains(str(f))
+
+    # simulate: crash recorded progress=1, origin deleted before restart
+    offsets = {str(f): 1}
+    os.remove(f)
+
+    pg.G.clear()
+    t2 = pw.io.plaintext.read(str(data_dir / "*.txt"), mode="streaming")
+    source2: FilePollingSource = t2._node.params["source"]
+    source2.object_cache = CachedObjectStorage(backend)
+    source2.poll_interval_s = 0.0
+    source2.seek(offsets)
+    events2 = source2.poll()
+    rows = sorted(e[2][0] for e in events2)
+    assert rows == ["r2", "r3"]  # rows past the resume offset, file gone
+    # no duplicates on further polls
+    source2._last_poll = 0.0
+    assert source2.poll() == []
+
+
+def test_e2e_restart_after_source_deletion(tmp_path):
+    """The VERDICT gate: ingest with persistence, delete the source file,
+    restart — output unchanged (journal + object cache together)."""
+    data_dir = tmp_path / "data"
+    data_dir.mkdir()
+    (data_dir / "a.txt").write_text("alpha\nbeta\n")
+    out = tmp_path / "out.jsonl"
+    pdir = str(tmp_path / "pstore")
+
+    def run_once():
+        pg.G.clear()
+        t = pw.io.plaintext.read(str(data_dir / "*.txt"), mode="streaming")
+        counts = t.groupby(t.data).reduce(word=t.data, c=pw.reducers.count())
+        pw.io.jsonlines.write(counts, str(out))
+        pw.run(
+            timeout_s=1.5, autocommit_duration_ms=50,
+            monitoring_level=pw.MonitoringLevel.NONE,
+            persistence_config=pw.persistence.Config(
+                pw.persistence.Backend.filesystem(pdir)
+            ),
+        )
+
+    run_once()
+    net1 = {}
+    for ln in out.read_text().splitlines():
+        e = json.loads(ln)
+        net1[e["word"]] = net1.get(e["word"], 0) + e["diff"]
+    assert net1 == {"alpha": 1, "beta": 1}
+
+    os.remove(data_dir / "a.txt")
+    out.unlink()
+    run_once()
+    net2 = {}
+    for ln in out.read_text().splitlines() if out.exists() else []:
+        e = json.loads(ln)
+        net2[e["word"]] = net2.get(e["word"], 0) + e["diff"]
+    # restart output: nothing retracted, nothing duplicated (exactly-once
+    # trimming means no NEW output rows; the maintained state is unchanged)
+    for w, c in net2.items():
+        assert c == 0 or net1.get(w) == c

@@ -119,3 +119,39 @@ def test_decode_step_is_o_t_not_o_t2():
         f"cached step {t_step*1e3:.2f}ms vs full {t_full*1e3:.2f}ms — "
         f"only {t_full/t_step:.1f}x"
     )
+
+
+def test_fused_generation_matches_stepwise():
+    """generate(fused=True) — prefill + whole decode loop in one XLA
+    program — must produce exactly the greedy completion of the host-driven
+    per-step loop."""
+    from pathway_tpu.models.decoder import DecoderConfig, JaxDecoderLM
+
+    cfg = DecoderConfig(vocab_size=512, d_model=64, n_layers=2, n_heads=4,
+                        d_ff=128, max_len=128)
+    lm = JaxDecoderLM(cfg, seq_buckets=(64, 128))
+    prompt = "w1 w2 w3 w4 w5 w6 w7"
+    a = lm.generate(prompt, max_new_tokens=12, fused=True)
+    b = lm.generate(prompt, max_new_tokens=12, fused=False)
+    assert a == b
+
+
+def test_fused_generation_stop_token():
+    from pathway_tpu.models.decoder import DecoderConfig, JaxDecoderLM
+
+    cfg = DecoderConfig(vocab_size=512, d_model=64, n_layers=2, n_heads=4,
+                        d_ff=128, max_len=128)
+    lm = JaxDecoderLM(cfg, seq_buckets=(64,))
+    # find the second greedy token, then use it as the stop token: the
+    # fused loop must cut the output at (and including) it, same as stepwise
+    import numpy as np
+
+    base = lm.generate("w1 w2 w3", max_new_tokens=8, fused=False)
+    toks = [t for t in lm.tokenizer.encode(base)]
+    if len(toks) >= 2:
+        stop = toks[1]
+        a = lm.generate("w1 w2 w3", max_new_tokens=8, stop_token=stop,
+                        fused=True)
+        b = lm.generate("w1 w2 w3", max_new_tokens=8, stop_token=stop,
+                        fused=False)
+        assert a == b

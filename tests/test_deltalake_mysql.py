@@ -1,0 +1,292 @@
+"""Delta Lake (native log protocol) and MySQL (CDC polling + dialect
+writers) connectors — VERDICT r2 item 6, to the client-seam-with-fakes
+standard of io/postgres.py."""
+
+import glob
+import json
+import os
+import sqlite3
+import threading
+import time
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.internals import parse_graph as pg
+
+
+class S(pw.Schema):
+    name: str
+    age: int
+
+
+class SPk(pw.Schema):
+    name: str = pw.column_definition(primary_key=True)
+    age: int
+
+
+def _md(table):
+    return pw.debug.table_from_markdown(table)
+
+
+# ---------------------------------------------------------------------------
+# deltalake
+
+
+def test_delta_write_log_structure(tmp_path):
+    pg.G.clear()
+    t = _md(
+        """
+        name | age
+        alice | 30
+        bob | 41
+        """
+    )
+    out = str(tmp_path / "lake")
+    pw.io.deltalake.write(t, out)
+    pw.run(monitoring_level=pw.MonitoringLevel.NONE)
+
+    logs = sorted(glob.glob(os.path.join(out, "_delta_log", "*.json")))
+    assert len(logs) >= 2  # protocol/metaData commit + >=1 data commit
+    actions0 = [json.loads(x) for x in open(logs[0])]
+    assert actions0[0]["protocol"]["minReaderVersion"] == 1
+    schema = json.loads(actions0[1]["metaData"]["schemaString"])
+    fields = {f["name"]: f["type"] for f in schema["fields"]}
+    assert fields == {
+        "name": "string", "age": "long", "time": "long", "diff": "long",
+    }
+    adds = [
+        a for p in logs[1:] for a in map(json.loads, open(p)) if "add" in a
+    ]
+    assert adds and all(
+        os.path.exists(os.path.join(out, a["add"]["path"])) for a in adds
+    )
+    # the parquet parts hold the rows
+    import pyarrow.parquet as pq
+
+    rows = []
+    for a in adds:
+        rows += pq.read_table(os.path.join(out, a["add"]["path"])).to_pylist()
+    assert {(r["name"], r["age"], r["diff"]) for r in rows} == {
+        ("alice", 30, 1), ("bob", 41, 1),
+    }
+
+
+def test_delta_roundtrip_static(tmp_path):
+    pg.G.clear()
+    t = _md(
+        """
+        name | age
+        alice | 30
+        bob | 41
+        """
+    )
+    out = str(tmp_path / "lake")
+    pw.io.deltalake.write(t, out)
+    pw.run(monitoring_level=pw.MonitoringLevel.NONE)
+
+    pg.G.clear()
+    back = pw.io.deltalake.read(out, SPk, mode="static")
+    keys, cols = pw.debug.table_to_dicts(back)
+    got = {(cols["name"][k], cols["age"][k]) for k in keys}
+    assert got == {("alice", 30), ("bob", 41)}
+
+
+def test_delta_streaming_tail_and_remove(tmp_path):
+    """Reader follows new commits; a `remove` action retracts the file's
+    rows."""
+    pg.G.clear()
+    lake = str(tmp_path / "lake")
+    from pathway_tpu.io.deltalake import DeltaWriter, _list_versions, _log_path
+    from pathway_tpu.internals import dtype as dt
+
+    w = DeltaWriter(lake, ["name", "age"], {"name": dt.STR, "age": dt.INT})
+    w.write_batch(2, ["name", "age"], [(None, ("alice", 30), 1)])
+
+    out = str(tmp_path / "out.jsonl")
+    t = pw.io.deltalake.read(lake, SPk, mode="streaming",
+                             poll_interval_s=0.05)
+    pw.io.jsonlines.write(t, out)
+
+    def mutate():
+        time.sleep(0.6)
+        w.write_batch(4, ["name", "age"], [(None, ("bob", 41), 1)])
+        time.sleep(0.5)
+        # remove the first data file -> alice retracts
+        first_add = None
+        for ver in _list_versions(lake):
+            for a in map(json.loads, open(_log_path(lake, ver))):
+                if "add" in a and first_add is None:
+                    first_add = a["add"]["path"]
+        w._append_commit([
+            {"remove": {"path": first_add, "dataChange": True,
+                        "deletionTimestamp": int(time.time() * 1000)}}
+        ])
+
+    th = threading.Thread(target=mutate)
+    th.start()
+    pw.run(timeout_s=3.0, autocommit_duration_ms=50,
+           monitoring_level=pw.MonitoringLevel.NONE)
+    th.join()
+
+    net = {}
+    for ln in open(out):
+        e = json.loads(ln)
+        net[e["name"]] = net.get(e["name"], 0) + e["diff"]
+    assert net == {"alice": 0, "bob": 1}
+
+
+def test_delta_resume_offsets(tmp_path):
+    from pathway_tpu.internals import dtype as dt
+    from pathway_tpu.io.deltalake import DeltaSource, DeltaWriter
+
+    lake = str(tmp_path / "lake")
+    w = DeltaWriter(lake, ["name", "age"], {"name": dt.STR, "age": dt.INT})
+    w.write_batch(2, ["name", "age"], [(None, ("alice", 30), 1)])
+    src = DeltaSource(lake, SPk, "streaming", poll_interval_s=0.0)
+    evs = src.poll()
+    assert len(evs) == 1
+    offs = src.get_offsets()
+
+    w.write_batch(4, ["name", "age"], [(None, ("bob", 41), 1)])
+    src2 = DeltaSource(lake, SPk, "streaming", poll_interval_s=0.0)
+    src2.seek(offs)
+    evs2 = src2.poll()
+    # only the new commit's rows appear after resume
+    assert [e[2][0] for e in evs2] == ["bob"]
+
+
+# ---------------------------------------------------------------------------
+# mysql (fake DB-API connection over in-memory sqlite)
+
+
+class _FakeMysqlConnection:
+    """DB-API double: pymysql surface (%s paramstyle) over sqlite3."""
+
+    def __init__(self):
+        self._con = sqlite3.connect(":memory:", check_same_thread=False)
+        self._lock = threading.Lock()
+        self.executed: list[str] = []
+
+    def cursor(self):
+        outer = self
+
+        class _Cur:
+            def execute(self, sql, params=()):
+                outer.executed.append(sql)
+                sql = sql.replace("%s", "?").replace("`", '"')
+                # sqlite has no ON DUPLICATE KEY UPDATE; translate the
+                # MySQL upsert the fake understands
+                if "ON DUPLICATE KEY UPDATE" in sql:
+                    head, _tail = sql.split("ON DUPLICATE KEY UPDATE")
+                    sql = head.replace("INSERT INTO", "INSERT OR REPLACE INTO")
+                with outer._lock:
+                    self._rows = outer._con.execute(sql, params).fetchall()
+                return self
+
+            def fetchall(self):
+                return self._rows
+
+        return _Cur()
+
+    def commit(self):
+        with self._lock:
+            self._con.commit()
+
+    def close(self):
+        pass
+
+
+def test_mysql_cdc_polling():
+    pg.G.clear()
+    fake = _FakeMysqlConnection()
+    fake._con.execute("CREATE TABLE users (name TEXT PRIMARY KEY, age INTEGER)")
+    fake._con.execute("INSERT INTO users VALUES ('alice', 30)")
+    fake._con.commit()
+
+    rows = []
+    t = pw.io.mysql.read(
+        {"_connection": fake}, "users", SPk, poll_interval_s=0.05
+    )
+    pw.io.subscribe(
+        t,
+        on_change=lambda key, row, time, is_addition: rows.append(
+            (row["name"], row["age"], is_addition)
+        ),
+    )
+
+    def mutate():
+        time.sleep(0.5)
+        with fake._lock:
+            fake._con.execute("INSERT INTO users VALUES ('bob', 41)")
+            fake._con.execute("UPDATE users SET age = 31 WHERE name = 'alice'")
+            fake._con.commit()
+
+    th = threading.Thread(target=mutate)
+    th.start()
+    pw.run(timeout_s=2.5, autocommit_duration_ms=50,
+           monitoring_level=pw.MonitoringLevel.NONE)
+    th.join()
+
+    assert ("alice", 30, True) in rows
+    assert ("bob", 41, True) in rows
+    assert ("alice", 30, False) in rows  # the update retracts the old row
+    assert ("alice", 31, True) in rows
+
+
+def test_mysql_write_stream_and_snapshot():
+    pg.G.clear()
+    fake = _FakeMysqlConnection()
+    t = _md(
+        """
+        name | age
+        alice | 30
+        bob | 41
+        """
+    )
+    pw.io.mysql.write(
+        t, {"_connection": fake}, "changes", init_mode="create_if_not_exists"
+    )
+    pw.io.mysql.write_snapshot(
+        t, {"_connection": fake}, "snap", primary_key=["name"],
+        init_mode="create_if_not_exists",
+    )
+    pw.run(monitoring_level=pw.MonitoringLevel.NONE)
+
+    got = fake._con.execute('SELECT name, age, diff FROM "changes"').fetchall()
+    assert {(n, int(a), d) for n, a, d in got} == {
+        ("alice", 30, 1), ("bob", 41, 1),
+    }
+    snap = fake._con.execute('SELECT name, age FROM "snap"').fetchall()
+    assert {(n, int(a)) for n, a in snap} == {("alice", 30), ("bob", 41)}
+    # dialect check: the real SQL used MySQL upsert syntax
+    assert any("ON DUPLICATE KEY UPDATE" in s for s in fake.executed)
+
+
+def test_mysql_no_pk_duplicate_rows_keep_multiplicity():
+    """Without a primary key, two identical rows are two rows; deleting one
+    retracts exactly one (occurrence-indexed keys)."""
+    from pathway_tpu.internals import dtype as dt
+    from pathway_tpu.internals.compat import schema_builder
+    from pathway_tpu.internals.schema import ColumnDefinition
+    from pathway_tpu.io.mysql import MysqlSnapshotSource
+
+    fake = _FakeMysqlConnection()
+    fake._con.execute("CREATE TABLE p (name TEXT, age INTEGER)")
+    fake._con.execute("INSERT INTO p VALUES ('dup', 1), ('dup', 1)")
+    fake._con.commit()
+
+    NoPk = schema_builder(
+        {"name": ColumnDefinition(dtype=dt.STR),
+         "age": ColumnDefinition(dtype=dt.INT)},
+        name="NoPk",
+    )
+    src = MysqlSnapshotSource({"_connection": fake}, "p", NoPk, 0.0,
+                              "streaming")
+    evs = src.poll()
+    assert sum(d for _t, _k, _r, d in evs) == 2  # both duplicates inserted
+    fake._con.execute("DELETE FROM p WHERE rowid = 1")
+    fake._con.commit()
+    src._first = True
+    evs2 = src.poll()
+    assert sum(d for _t, _k, _r, d in evs2) == -1  # exactly one retracted

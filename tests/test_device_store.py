@@ -1,0 +1,160 @@
+"""Device-resident embedding path (round 3): encoder batches stay on the
+accelerator as DeviceVec handles, the KNN index consolidates them with one
+gather dispatch, and search fetches only (k,) results.  On the CPU test
+backend the same code runs with host "devices", so results must be exactly
+comparable with the host-vector path."""
+
+import numpy as np
+import pytest
+
+from pathway_tpu.models.encoder import EncoderConfig, JaxEncoder
+from pathway_tpu.ops.device_store import DeviceVec, DeviceVecStore
+from pathway_tpu.stdlib.indexing.inner_index import BruteForceKnn
+
+
+@pytest.fixture(scope="module")
+def enc():
+    return JaxEncoder(
+        EncoderConfig(max_len=64, vocab_size=4096),
+        seq_buckets=(16, 32), batch_buckets=(1, 8),
+    )
+
+
+def _texts(n, seed=0):
+    rng = np.random.default_rng(seed)
+    return [
+        " ".join(f"w{rng.integers(0, 500)}" for _ in range(10)) for _ in range(n)
+    ]
+
+
+def test_embed_batch_device_matches_host(enc):
+    texts = _texts(13)
+    host = enc.embed_batch(texts)
+    refs = enc.embed_batch_device(texts)
+    assert len(refs) == 13
+    assert all(isinstance(r, DeviceVec) for r in refs)
+    dev = np.stack([r.to_numpy() for r in refs])
+    np.testing.assert_allclose(host, dev, rtol=2e-5, atol=2e-5)
+
+
+def test_device_vec_value_semantics(enc):
+    [r1] = enc.embed_batch_device(["hello world"])
+    [r2] = enc.embed_batch_device(["hello world"])
+    assert r1 != r2  # distinct rows, even with identical content
+    assert r1 == DeviceVec(r1.store, r1.batch, r1.row_idx)
+    assert hash(r1) == hash(DeviceVec(r1.store, r1.batch, r1.row_idx))
+    # pickling materializes the numbers
+    import pickle
+
+    arr = pickle.loads(pickle.dumps(r1))
+    np.testing.assert_allclose(arr, r1.to_numpy())
+    # __array__ compat for consumers that need numbers
+    assert np.asarray(r1).shape == (enc.dimensions,)
+
+
+def test_index_device_ingest_and_search(enc):
+    texts = _texts(20, seed=1)
+    refs = enc.embed_batch_device(texts)
+    vecs = [r.to_numpy() for r in refs]
+
+    dev_index = BruteForceKnn(enc.dimensions)
+    host_index = BruteForceKnn(enc.dimensions, device_threshold=1 << 30)
+    for i, (r, v) in enumerate(zip(refs, vecs)):
+        dev_index.add(i, r)
+        host_index.add(i, v)
+
+    q = enc.embed(texts[3])
+    got = dev_index.search(q, 5)
+    want = host_index.search(q, 5)
+    assert [k for k, _ in got] == [k for k, _ in want]
+    for (_, s1), (_, s2) in zip(got, want):
+        assert abs(s1 - s2) < 1e-4
+    # batched search agrees too
+    qs = [enc.embed(texts[i]) for i in (0, 7)]
+    got_b = dev_index.search_batch(qs, 3)
+    want_b = [host_index.search(q, 3) for q in qs]
+    assert [[k for k, _ in row] for row in got_b] == [
+        [k for k, _ in row] for row in want_b
+    ]
+
+
+def test_index_device_remove_and_update(enc):
+    texts = _texts(10, seed=2)
+    refs = enc.embed_batch_device(texts)
+    index = BruteForceKnn(enc.dimensions)
+    for i, r in enumerate(refs):
+        index.add(i, r)
+    index.remove(3)
+    assert index.n == 9
+    q = refs[3].to_numpy()
+    assert 3 not in [k for k, _ in index.search(q, 9)]
+    # update key 5 with a host vector (mixed mode)
+    newv = refs[7].to_numpy()
+    index.add(5, newv)
+    top = index.search(newv, 2)
+    assert {k for k, _ in top} == {5, 7}
+
+
+def test_cpu_serving_tier_matches(enc):
+    texts = _texts(12, seed=3)
+    refs = enc.embed_batch_device(texts)
+    index = BruteForceKnn(enc.dimensions)
+    for i, r in enumerate(refs):
+        index.add(i, r)
+    q = enc.embed(texts[5])
+    dev = index.search(q, 4)
+    cpu = index.search(q, 4, tier="cpu")
+    assert [k for k, _ in dev] == [k for k, _ in cpu]
+    # f16 host mirror: scores agree to ~1e-3
+    for (_, s1), (_, s2) in zip(dev, cpu):
+        assert abs(s1 - s2) < 5e-3
+
+
+def test_cpu_mirror_embeds_identically(enc):
+    mirror = enc.cpu_mirror()
+    texts = _texts(3, seed=4)
+    a = enc.embed_batch(texts)
+    b = mirror.embed_batch(texts)
+    np.testing.assert_allclose(a, b, rtol=2e-5, atol=2e-5)
+    assert enc.cpu_mirror() is mirror  # cached
+
+
+def test_store_gather_order():
+    import jax.numpy as jnp
+
+    store = DeviceVecStore(4)
+    b1 = store.append_batch(jnp.arange(8.0).reshape(2, 4))
+    b2 = store.append_batch(jnp.arange(100.0, 112.0).reshape(3, 4))
+    m = np.asarray(store.gather(
+        [(b2[1].batch, b2[1].row_idx), (b1[0].batch, b1[0].row_idx)]
+    ))
+    np.testing.assert_allclose(m[0], [104, 105, 106, 107])
+    np.testing.assert_allclose(m[1], [0, 1, 2, 3])
+
+
+def test_numpy_mirror_post_ln_bert_parity():
+    """The host mirror must match the device path for imported BERT-family
+    weights too (post-LN, biases, exact gelu)."""
+    import torch
+    from transformers import BertConfig, BertModel
+
+    from pathway_tpu.models.hf_import import (
+        config_from_hf, params_from_bert_state_dict,
+    )
+
+    torch.manual_seed(0)
+    hf_cfg = BertConfig(
+        vocab_size=512, hidden_size=64, num_hidden_layers=2,
+        num_attention_heads=4, intermediate_size=128,
+        max_position_embeddings=64, hidden_act="gelu",
+    )
+    model = BertModel(hf_cfg).eval()
+    cfg = config_from_hf(hf_cfg)
+    params = params_from_bert_state_dict(model.state_dict(), cfg)
+    enc2 = JaxEncoder(cfg, params=params, seq_buckets=(16,),
+                      batch_buckets=(1,))
+    mirror = enc2.cpu_mirror()
+    for text in ["hello world", "a b c d e"]:
+        np.testing.assert_allclose(
+            enc2.embed(text), mirror.embed(text), rtol=2e-3, atol=2e-3
+        )
