@@ -496,6 +496,11 @@ def _tpu_generation() -> str:
     return ""
 
 
+def _stage(msg: str) -> None:
+    print(f"[bench] {time.strftime('%H:%M:%S')} {msg}", file=sys.stderr,
+          flush=True)
+
+
 def main() -> None:
     _ensure_healthy_backend()
     import jax
@@ -525,6 +530,7 @@ def main() -> None:
 
     from pathway_tpu.ops.knn import device_topk, to_device
 
+    _stage("warmup: encoder shapes")
     enc.embed_batch(docs[:batch])
     enc.embed_batch(docs[: batch - 1])  # masked variant of the same bucket
     enc.embed_batch([docs[0]])
@@ -582,6 +588,7 @@ def main() -> None:
     # k=1 probe top-k shapes once (XLA compile measured ~3.6s — serving
     # systems compile once and run many times, so the timed window below
     # measures the steady state)
+    _stage("warmup: full pipeline run")
     run_tables(reply, embedded)
     pg.G.clear()
     doc_table = table_from_rows(DocSchema, [(d,) for d in docs])
@@ -595,6 +602,7 @@ def main() -> None:
     # reset stage counters here so they cover exactly the t0..t1 window
     enc.stats = {k2: (0.0 if isinstance(v, float) else 0)
                  for k2, v in enc.stats.items()}
+    _stage("timed ingest")
     t0 = time.perf_counter()
     caps = run_tables(reply, embedded)
     if device_resident and getattr(enc, "_store", None) is not None:
@@ -632,6 +640,7 @@ def main() -> None:
     # round-trip floor no matter how small the compute, so latency-critical
     # single queries run on the host CPU mirror (params copied once, index
     # host-mirrored once per version) while bulk ingest stays on TPU
+    _stage("serving: latency tier")
     serve_enc = enc.cpu_mirror() if backend == "tpu" else enc
     index.host_matrix()  # one f16 fetch, cached per index version
     serve_enc.embed(queries[0])  # compile CPU single-query bucket
@@ -653,6 +662,7 @@ def main() -> None:
 
     # the device path for the record: embed + fused top-k on TPU (2 round
     # trips); right answer for batched queries, higher floor for single ones
+    _stage("serving: device path")
     index.search(enc.embed(queries[0]), k)  # warm
     lat_dev = []
     for q in queries[:16]:
@@ -668,6 +678,7 @@ def main() -> None:
     import jax
     import jax.numpy as jnp
 
+    _stage("embed e2e throughput")
     e2e_store = DeviceVecStore(enc.dimensions)
     t2 = time.perf_counter()
     enc.embed_batch_device(docs, store=e2e_store)
@@ -694,6 +705,7 @@ def main() -> None:
         acc, _ = jax.lax.scan(body, jnp.float32(0), None, length=N_scan)
         return acc
 
+    _stage("mfu scan probe")
     probe = jax.jit(_mfu_probe)
     float(probe(enc.params, dids))  # compile
     t4 = time.perf_counter()
@@ -705,18 +717,24 @@ def main() -> None:
     peak = _TPU_PEAK.get(gen) if backend == "tpu" else None
     mfu = round(achieved / peak, 4) if peak else None
 
+    _stage("wordcount")
     wordcount_rps = bench_wordcount()
+    _stage("generation")
     generation = bench_generation()
+    _stage("retrieval quality")
     retrieval_quality = bench_retrieval_quality()
 
     # measured reference baseline on the same corpus (CPU, torch MiniLM arch)
     n_base = 1024
+    _stage("torch baseline")
     base = bench_reference_baseline(
         docs[:n_base], queries[:16], k, enc.tokenizer
     )
     vs_baseline = round(docs_per_sec / base["docs_per_sec"], 2)
 
+    _stage("parallel")
     parallel = bench_parallel()
+    _stage("data plane")
     data_plane = bench_data_plane()
 
     print(

@@ -408,12 +408,13 @@ class StatefulState(ReducerState):
     """Append-only custom combine (reference: stateful_single/stateful_many,
     python/pathway/internals/custom_reducers.py:433)."""
 
-    __slots__ = ("state", "combine_many", "initialized")
+    __slots__ = ("state", "combine_many", "initialized", "finish")
 
-    def __init__(self, combine_many: Callable):
+    def __init__(self, combine_many: Callable, finish: Callable | None = None):
         super().__init__()
         self.state = None
         self.combine_many = combine_many
+        self.finish = finish
         self.initialized = False
 
     def _update(self, args, diff, time, key):
@@ -425,6 +426,10 @@ class StatefulState(ReducerState):
         self.initialized = True
 
     def _value(self):
+        # finish maps the accumulator to the emitted value (reference:
+        # BaseCustomAccumulator.compute_result)
+        if self.finish is not None:
+            return self.finish(self.state)
         return self.state
 
     def is_empty(self):
@@ -494,7 +499,7 @@ def make_state(reducer_id: str, kwargs: dict) -> ReducerState:
     if reducer_id == "latest":
         return LatestState()
     if reducer_id == "stateful":
-        return StatefulState(kwargs["combine_many"])
+        return StatefulState(kwargs["combine_many"], kwargs.get("finish"))
     if reducer_id == "udf":
         return UdfReducerState(kwargs["protocol"])
     raise ValueError(f"unknown reducer {reducer_id!r}")

@@ -524,7 +524,12 @@ def run_tables(*tables: Table) -> list[CapturedStream]:
     sinks = [t._materialize_capture() for t in tables]
     runner = GraphRunner(sinks)
     if has_live_sources(sinks):
-        caps = runner.run_streaming(autocommit_ms=20)
+        # the harness must terminate: sources that close when done (the
+        # AsyncTransformer loop, finite connector subjects) finish the run;
+        # a genuinely endless source stops after the idle window instead of
+        # hanging the test (pw.run is the production entry point with
+        # explicit timeout control)
+        caps = runner.run_streaming(autocommit_ms=20, idle_stop_s=10.0)
     else:
         caps = runner.run_batch()
     return [caps[s.id] for s in sinks]

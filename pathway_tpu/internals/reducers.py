@@ -81,18 +81,22 @@ def npsum(expr) -> ReducerExpression:
     return ReducerExpression("sum", expr)
 
 
-def stateful_single(combine_single: Callable, *exprs) -> ReducerExpression:
+def stateful_single(combine_single: Callable, *exprs,
+                    finish: Callable | None = None) -> ReducerExpression:
     def combine_many(state, rows):
         for args, cnt in rows:
             for _ in range(cnt):
                 state = combine_single(state, *args)
         return state
 
-    return ReducerExpression("stateful", *exprs, combine_many=combine_many)
+    return ReducerExpression("stateful", *exprs, combine_many=combine_many,
+                             finish=finish)
 
 
-def stateful_many(combine_many: Callable, *exprs) -> ReducerExpression:
-    return ReducerExpression("stateful", *exprs, combine_many=combine_many)
+def stateful_many(combine_many: Callable, *exprs,
+                  finish: Callable | None = None) -> ReducerExpression:
+    return ReducerExpression("stateful", *exprs, combine_many=combine_many,
+                             finish=finish)
 
 
 def udf_reducer(protocol: Callable[[list], Any], *exprs) -> ReducerExpression:

@@ -200,12 +200,11 @@ class FilePollingSource(DataSource):
             ]
         return out
 
-    def _cache_put(self, f: str, mtime: float) -> None:
-        if self.object_cache is None or not self._parse_takes_data:
-            return
+    def _cache_put(self, f: str, mtime: float, payload: bytes) -> None:
+        """Store the exact bytes that were parsed (no re-read: a file
+        rewritten between parse and cache would otherwise be stored under
+        the stale version)."""
         try:
-            with open(f, "rb") as fh:
-                payload = fh.read()
             meta = (
                 self.cache_metadata_fn(f)
                 if self.cache_metadata_fn is not None else {"mtime": mtime}
@@ -261,8 +260,15 @@ class FilePollingSource(DataSource):
             if self._seen.get(f) == mtime:
                 continue
             try:
-                dicts = self.parse_file(f)
-                self._cache_put(f, mtime)
+                if self.object_cache is not None and self._parse_takes_data:
+                    # single read: the same bytes feed the parse AND the
+                    # object cache (consistent version stamping)
+                    with open(f, "rb") as fh:
+                        payload = fh.read()
+                    dicts = self.parse_file(f, data=payload)
+                    self._cache_put(f, mtime, payload)
+                else:
+                    dicts = self.parse_file(f)
             except Exception:
                 # mid-write or unreadable: retry on later polls rather than
                 # silently skipping the file's rows — but a file that keeps

@@ -232,14 +232,27 @@ class DeltaSource(DataSource):
         v = offsets.get("delta_version")
         if v is not None:
             self._applied = int(v)
-            # re-list files added up to the applied version so later removes
-            # can retract them (rows themselves were already delivered)
+            # re-materialize rows of files added up to the applied version
+            # (their rows were already delivered pre-restart, but a later
+            # `remove` action must be able to retract them — an empty entry
+            # would make the retraction a silent no-op)
             for ver in _list_versions(self.path):
                 if ver > self._applied:
                     break
                 for a in _read_actions(self.path, ver):
                     if "add" in a:
-                        self._file_rows.setdefault(a["add"]["path"], [])
+                        fname = a["add"]["path"]
+                        try:
+                            self._file_rows[fname] = self._rows_of(fname)
+                        except OSError:
+                            import logging
+
+                            logging.getLogger(__name__).warning(
+                                "delta part %s vanished before resume; a "
+                                "later remove of it cannot retract its rows",
+                                fname,
+                            )
+                            self._file_rows[fname] = []
                     elif "remove" in a:
                         self._file_rows.pop(a["remove"]["path"], None)
 

@@ -74,7 +74,12 @@ def read(
             # is the file metadata captured when the object was cached
             meta = None
             if with_metadata:
-                meta = cached_metadata if data is not None else _metadata_for(p)
+                # live file: fresh stat metadata (even when the bytes came
+                # in via the single-read cache path); vanished file served
+                # from the object cache: the metadata captured at cache time
+                meta = (
+                    _metadata_for(p) if os.path.exists(p) else cached_metadata
+                )
             if binary:
                 if data is None:
                     with open(p, "rb") as f:

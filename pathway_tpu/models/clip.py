@@ -1,0 +1,364 @@
+"""CLIP-class dual encoder — the on-device multimodal model for BASELINE
+config #5 (multimodal RAG; reference calls external vision services via
+xpacks/llm/parsers.py ImageParser/SlideParser — here image and text towers
+run as jit'd JAX forward passes on the TPU).
+
+Same pure-pytree style as models/encoder.py; HF CLIPModel weights map onto
+these params exactly (models/clip.py params_from_clip_state_dict, parity
+asserted in tests/test_clip.py), so any locally-available CLIP checkpoint
+runs on the TPU path.
+
+Patch embedding is the conv-as-matmul identity: a stride-P conv over
+P x P patches equals reshaping to (B, n_patches, P*P*3) and one matmul —
+the MXU-friendly formulation.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .encoder import _layer_norm, _resolve_dtype
+
+
+@dataclasses.dataclass(frozen=True)
+class ClipVisionConfig:
+    image_size: int = 224
+    patch_size: int = 32
+    d_model: int = 768
+    n_layers: int = 12
+    n_heads: int = 12
+    d_ff: int = 3072
+    ln_eps: float = 1e-5
+
+    @property
+    def n_patches(self) -> int:
+        return (self.image_size // self.patch_size) ** 2
+
+
+@dataclasses.dataclass(frozen=True)
+class ClipTextConfig:
+    vocab_size: int = 49408
+    max_len: int = 77
+    d_model: int = 512
+    n_layers: int = 12
+    n_heads: int = 8
+    d_ff: int = 2048
+    ln_eps: float = 1e-5
+
+
+@dataclasses.dataclass(frozen=True)
+class ClipConfig:
+    vision: ClipVisionConfig = ClipVisionConfig()
+    text: ClipTextConfig = ClipTextConfig()
+    projection_dim: int = 512
+    dtype: Any = "auto"
+
+
+def _quick_gelu(x):
+    return x * jax.nn.sigmoid(1.702 * x)
+
+
+def _block_params(rng, d, ff):
+    ks = jax.random.split(rng, 6)
+
+    def dense(k, shape):
+        return jax.random.normal(k, shape, jnp.float32) / np.sqrt(shape[0])
+
+    return {
+        "wq": dense(ks[0], (d, d)), "bq": jnp.zeros((d,)),
+        "wk": dense(ks[1], (d, d)), "bk": jnp.zeros((d,)),
+        "wv": dense(ks[2], (d, d)), "bv": jnp.zeros((d,)),
+        "wo": dense(ks[3], (d, d)), "bo": jnp.zeros((d,)),
+        "w_up": dense(ks[4], (d, ff)), "b_up": jnp.zeros((ff,)),
+        "w_down": dense(ks[5], (ff, d)), "b_down": jnp.zeros((d,)),
+        "ln1_scale": jnp.ones((d,)), "ln1_bias": jnp.zeros((d,)),
+        "ln2_scale": jnp.ones((d,)), "ln2_bias": jnp.zeros((d,)),
+    }
+
+
+def init_clip_params(cfg: ClipConfig, rng: jax.Array) -> dict:
+    v, t = cfg.vision, cfg.text
+    keys = jax.random.split(rng, 8 + v.n_layers + t.n_layers)
+    ki = iter(keys)
+    patch_dim = v.patch_size * v.patch_size * 3
+    params = {
+        "v_patch": jax.random.normal(next(ki), (patch_dim, v.d_model)) * 0.02,
+        "v_class": jax.random.normal(next(ki), (v.d_model,)) * 0.02,
+        "v_pos": jax.random.normal(
+            next(ki), (v.n_patches + 1, v.d_model)) * 0.02,
+        "v_pre_scale": jnp.ones((v.d_model,)),
+        "v_pre_bias": jnp.zeros((v.d_model,)),
+        "v_post_scale": jnp.ones((v.d_model,)),
+        "v_post_bias": jnp.zeros((v.d_model,)),
+        "v_proj": jax.random.normal(
+            next(ki), (v.d_model, cfg.projection_dim)) * 0.02,
+        "t_embed": jax.random.normal(
+            next(ki), (t.vocab_size, t.d_model)) * 0.02,
+        "t_pos": jax.random.normal(next(ki), (t.max_len, t.d_model)) * 0.02,
+        "t_final_scale": jnp.ones((t.d_model,)),
+        "t_final_bias": jnp.zeros((t.d_model,)),
+        "t_proj": jax.random.normal(
+            next(ki), (t.d_model, cfg.projection_dim)) * 0.02,
+        "logit_scale": jnp.asarray(np.log(1 / 0.07), jnp.float32),
+        "v_layers": [
+            _block_params(next(ki), v.d_model, v.d_ff)
+            for _ in range(v.n_layers)
+        ],
+        "t_layers": [
+            _block_params(next(ki), t.d_model, t.d_ff)
+            for _ in range(t.n_layers)
+        ],
+    }
+    return params
+
+
+def _block(layer, x, n_heads, eps, causal: bool):
+    B, T, D = x.shape
+    H = n_heads
+    hd = D // H
+    h = _layer_norm(x, layer["ln1_scale"], layer["ln1_bias"], eps)
+    q = (h @ layer["wq"].astype(h.dtype) + layer["bq"].astype(h.dtype))
+    k = (h @ layer["wk"].astype(h.dtype) + layer["bk"].astype(h.dtype))
+    v = (h @ layer["wv"].astype(h.dtype) + layer["bv"].astype(h.dtype))
+    q = q.reshape(B, T, H, hd)
+    k = k.reshape(B, T, H, hd)
+    v = v.reshape(B, T, H, hd)
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(hd)
+    if causal:
+        mask = jnp.tril(jnp.ones((T, T), bool))
+        scores = jnp.where(mask[None, None, :, :], scores, -1e9)
+    probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(h.dtype)
+    a = jnp.einsum("bhqk,bkhd->bqhd", probs, v).reshape(B, T, D)
+    x = x + (a @ layer["wo"].astype(h.dtype) + layer["bo"].astype(h.dtype))
+    h = _layer_norm(x, layer["ln2_scale"], layer["ln2_bias"], eps)
+    ff = _quick_gelu(h @ layer["w_up"].astype(h.dtype)
+                     + layer["b_up"].astype(h.dtype))
+    return x + (ff @ layer["w_down"].astype(h.dtype)
+                + layer["b_down"].astype(h.dtype))
+
+
+def patchify(pixels: jax.Array, patch: int) -> jax.Array:
+    """(B, H, W, 3) -> (B, n_patches, patch*patch*3), channel-major per
+    patch to match the conv kernel layout (C, P, P) flattened."""
+    B, H, W, C = pixels.shape
+    gh, gw = H // patch, W // patch
+    x = pixels.reshape(B, gh, patch, gw, patch, C)
+    # (B, gh, gw, C, ph, pw): conv weight flattens as (C, P, P)
+    x = x.transpose(0, 1, 3, 5, 2, 4)
+    return x.reshape(B, gh * gw, C * patch * patch)
+
+
+def encode_image(params: dict, cfg: ClipConfig, pixels: jax.Array) -> jax.Array:
+    """(B, H, W, 3) float pixels -> (B, projection_dim) L2-normed f32."""
+    v = cfg.vision
+    dtype = _resolve_dtype(cfg.dtype)
+    patches = patchify(pixels.astype(dtype), v.patch_size)
+    x = patches @ params["v_patch"].astype(dtype)
+    cls = params["v_class"].astype(dtype)[None, None, :]
+    cls = jnp.broadcast_to(cls, (x.shape[0], 1, v.d_model))
+    x = jnp.concatenate([cls, x], axis=1)
+    x = x + params["v_pos"].astype(dtype)[None, :, :]
+    x = _layer_norm(x, params["v_pre_scale"], params["v_pre_bias"], v.ln_eps)
+    for layer in params["v_layers"]:
+        x = _block(layer, x, v.n_heads, v.ln_eps, causal=False)
+    pooled = _layer_norm(
+        x[:, 0, :], params["v_post_scale"], params["v_post_bias"], v.ln_eps
+    )
+    out = (pooled @ params["v_proj"].astype(pooled.dtype)).astype(jnp.float32)
+    return out / (jnp.linalg.norm(out, axis=-1, keepdims=True) + 1e-12)
+
+
+def encode_text(params: dict, cfg: ClipConfig, token_ids: jax.Array,
+                n_valid: jax.Array) -> jax.Array:
+    """(B, T) int tokens (+ per-row valid count) -> (B, projection_dim).
+    Pooling takes the hidden state at position n_valid-1 (the EOT token),
+    as HF CLIPTextModel does."""
+    t = cfg.text
+    dtype = _resolve_dtype(cfg.dtype)
+    x = params["t_embed"].astype(dtype)[token_ids]
+    T = token_ids.shape[1]
+    x = x + params["t_pos"].astype(dtype)[:T][None, :, :]
+    for layer in params["t_layers"]:
+        x = _block(layer, x, t.n_heads, t.ln_eps, causal=True)
+    x = _layer_norm(x, params["t_final_scale"], params["t_final_bias"],
+                    t.ln_eps)
+    eot = jnp.take_along_axis(
+        x, (n_valid - 1)[:, None, None].astype(jnp.int32), axis=1
+    )[:, 0, :]
+    out = (eot @ params["t_proj"].astype(eot.dtype)).astype(jnp.float32)
+    return out / (jnp.linalg.norm(out, axis=-1, keepdims=True) + 1e-12)
+
+
+def params_from_clip_state_dict(sd: dict, cfg: ClipConfig) -> dict:
+    """Map a transformers CLIPModel state_dict onto our pytree (cf.
+    models/hf_import.py for the BERT/GPT-2 families)."""
+
+    def g(name):
+        return jnp.asarray(np.asarray(sd[name].detach().cpu()))
+
+    def block(prefix, i):
+        p = f"{prefix}.encoder.layers.{i}"
+        return {
+            "wq": g(f"{p}.self_attn.q_proj.weight").T,
+            "bq": g(f"{p}.self_attn.q_proj.bias"),
+            "wk": g(f"{p}.self_attn.k_proj.weight").T,
+            "bk": g(f"{p}.self_attn.k_proj.bias"),
+            "wv": g(f"{p}.self_attn.v_proj.weight").T,
+            "bv": g(f"{p}.self_attn.v_proj.bias"),
+            "wo": g(f"{p}.self_attn.out_proj.weight").T,
+            "bo": g(f"{p}.self_attn.out_proj.bias"),
+            "w_up": g(f"{p}.mlp.fc1.weight").T,
+            "b_up": g(f"{p}.mlp.fc1.bias"),
+            "w_down": g(f"{p}.mlp.fc2.weight").T,
+            "b_down": g(f"{p}.mlp.fc2.bias"),
+            "ln1_scale": g(f"{p}.layer_norm1.weight"),
+            "ln1_bias": g(f"{p}.layer_norm1.bias"),
+            "ln2_scale": g(f"{p}.layer_norm2.weight"),
+            "ln2_bias": g(f"{p}.layer_norm2.bias"),
+        }
+
+    conv = g("vision_model.embeddings.patch_embedding.weight")  # (D, 3, P, P)
+    patch_mat = conv.reshape(conv.shape[0], -1).T  # (3*P*P, D), C-major
+    return {
+        "v_patch": patch_mat,
+        "v_class": g("vision_model.embeddings.class_embedding"),
+        "v_pos": g("vision_model.embeddings.position_embedding.weight"),
+        "v_pre_scale": g("vision_model.pre_layrnorm.weight"),
+        "v_pre_bias": g("vision_model.pre_layrnorm.bias"),
+        "v_post_scale": g("vision_model.post_layernorm.weight"),
+        "v_post_bias": g("vision_model.post_layernorm.bias"),
+        "v_proj": g("visual_projection.weight").T,
+        "t_embed": g("text_model.embeddings.token_embedding.weight"),
+        "t_pos": g("text_model.embeddings.position_embedding.weight"),
+        "t_final_scale": g("text_model.final_layer_norm.weight"),
+        "t_final_bias": g("text_model.final_layer_norm.bias"),
+        "t_proj": g("text_projection.weight").T,
+        "logit_scale": g("logit_scale"),
+        "v_layers": [
+            block("vision_model", i) for i in range(cfg.vision.n_layers)
+        ],
+        "t_layers": [
+            block("text_model", i) for i in range(cfg.text.n_layers)
+        ],
+    }
+
+
+def clip_config_from_hf(hf_cfg) -> ClipConfig:
+    v, t = hf_cfg.vision_config, hf_cfg.text_config
+    return ClipConfig(
+        vision=ClipVisionConfig(
+            image_size=v.image_size, patch_size=v.patch_size,
+            d_model=v.hidden_size, n_layers=v.num_hidden_layers,
+            n_heads=v.num_attention_heads, d_ff=v.intermediate_size,
+            ln_eps=v.layer_norm_eps,
+        ),
+        text=ClipTextConfig(
+            vocab_size=t.vocab_size, max_len=t.max_position_embeddings,
+            d_model=t.hidden_size, n_layers=t.num_hidden_layers,
+            n_heads=t.num_attention_heads, d_ff=t.intermediate_size,
+            ln_eps=t.layer_norm_eps,
+        ),
+        projection_dim=hf_cfg.projection_dim,
+        dtype=jnp.float32,
+    )
+
+
+class JaxClip:
+    """Host-facing multimodal embedder: images and texts land in ONE shared
+    embedding space, so a text query retrieves images directly (the
+    multimodal RAG pattern, BASELINE config #5)."""
+
+    def __init__(self, cfg: ClipConfig | None = None, seed: int = 0,
+                 params: dict | None = None, tokenizer=None):
+        self.cfg = cfg or ClipConfig()
+        if isinstance(self.cfg.dtype, str):
+            self.cfg = dataclasses.replace(
+                self.cfg, dtype=_resolve_dtype(self.cfg.dtype)
+            )
+        self.params = (
+            params if params is not None
+            else init_clip_params(self.cfg, jax.random.PRNGKey(seed))
+        )
+        if tokenizer is None:
+            from .tokenizer import HashTokenizer
+
+            tokenizer = HashTokenizer(self.cfg.text.vocab_size)
+        self.tokenizer = tokenizer
+        _c = self.cfg
+        self._img_fwd = jax.jit(lambda p, px: encode_image(p, _c, px))
+        self._txt_fwd = jax.jit(
+            lambda p, ids, nv: encode_text(p, _c, ids, nv)
+        )
+
+    @classmethod
+    def from_hf(cls, model_name_or_path: str) -> "JaxClip":
+        from transformers import CLIPModel
+
+        try:
+            from transformers import CLIPTokenizer
+
+            tok = CLIPTokenizer.from_pretrained(model_name_or_path)
+        except Exception:
+            tok = None
+        model = CLIPModel.from_pretrained(model_name_or_path)
+        cfg = clip_config_from_hf(model.config)
+        params = params_from_clip_state_dict(model.state_dict(), cfg)
+        adapter = _ClipTokenizerAdapter(tok) if tok is not None else None
+        return cls(cfg, params=params, tokenizer=adapter)
+
+    @property
+    def dimensions(self) -> int:
+        return self.cfg.projection_dim
+
+    def embed_image(self, image) -> np.ndarray:
+        """image: (H, W, 3) array in [0, 1] or [0, 255]; resized/cropped by
+        the caller (parsers handle decoding)."""
+        px = np.asarray(image, np.float32)
+        if px.max() > 2.0:
+            px = px / 255.0
+        v = self.cfg.vision
+        if px.shape[:2] != (v.image_size, v.image_size):
+            px = _resize_nearest(px, v.image_size)
+        return np.asarray(
+            self._img_fwd(self.params, jnp.asarray(px[None]))
+        )[0]
+
+    def embed_image_batch(self, images) -> np.ndarray:
+        return np.stack([self.embed_image(im) for im in images])
+
+    def embed_text(self, text: str) -> np.ndarray:
+        ids = self.tokenizer.encode(text)[: self.cfg.text.max_len] or [0]
+        buf = np.zeros((1, self.cfg.text.max_len), np.int32)
+        buf[0, : len(ids)] = ids
+        return np.asarray(
+            self._txt_fwd(
+                self.params, jnp.asarray(buf),
+                jnp.asarray([len(ids)], jnp.int32),
+            )
+        )[0]
+
+    def similarity(self, text: str, image) -> float:
+        tv = self.embed_text(text)
+        iv = self.embed_image(image)
+        scale = float(np.exp(np.asarray(self.params["logit_scale"])))
+        return float(scale * tv @ iv)
+
+
+class _ClipTokenizerAdapter:
+    def __init__(self, tok):
+        self._tok = tok
+
+    def encode(self, text: str) -> list[int]:
+        return self._tok.encode(text)
+
+
+def _resize_nearest(px: np.ndarray, size: int) -> np.ndarray:
+    h, w = px.shape[:2]
+    yi = (np.arange(size) * h // size).clip(0, h - 1)
+    xi = (np.arange(size) * w // size).clip(0, w - 1)
+    return px[yi][:, xi]

@@ -345,7 +345,9 @@ class JaxDecoderLM:
         if fused:
             new_b = next(
                 (b for b in self.new_buckets if max_new_tokens <= b),
-                self.new_buckets[-1],
+                # beyond the largest bucket: round up to a 64-multiple so
+                # the request is honored in full (one extra compile)
+                -(-max_new_tokens // 64) * 64,
             )
             new_b = min(new_b, L - n) or 1
             tokens, n_steps = self._fused(new_b, stop_token)(

@@ -64,23 +64,46 @@ def louvain_level(vertices: Table, edges: Table, iteration_limit: int = 20) -> T
 
     init = vertices.select(community=vertices.id)
 
+    n_phases = 4
+
     def step(state: Table) -> Table:
-        # two half-steps per round (even-hash vertices move first, then odd):
-        # sequential-like updates avoid the 2-cycle oscillation of fully
-        # synchronous label moves
-        return _half_step(_half_step(state, 0), 1)
+        # phased updates (vertices move only on their id-hash phase):
+        # sequential-like ordering avoids both the 2-cycle oscillation of
+        # fully synchronous label moves and the shallow local optima that
+        # same-phase simultaneous moves create (the reference randomizes
+        # move order for the same reason)
+        s = state
+        for ph in range(n_phases):
+            s = _half_step(s, ph)
+        return s
 
     def _half_step(state: Table, parity: int) -> Table:
+        from ... import if_else as _ie
+
         cv = state.ix(edges.v)  # community of each edge target
         cu = state.ix(edges.u)  # vertex's own community
         contrib = edges.select(
-            u=edges.u, com=cv.community, w=edges.weight, ucom=cu.community
+            u=edges.u, com=cv.community, w=edges.weight, ucom=cu.community,
+            is_self=edges.u == edges.v,
         )
-        # edge mass from each vertex into each neighboring community
-        per = contrib.groupby(contrib.u, contrib.com).reduce(
-            contrib.u, contrib.com, w=R.sum(contrib.w), ucom=R.any(contrib.ucom)
+        # edge mass from each vertex into each neighboring community.
+        # Self-loops (contracted-graph intra mass) count toward the degree
+        # but NOT toward w(u -> own community \ u) — a vertex's own loop is
+        # not an edge to the other members, so it must not inflate the
+        # stay score (this is what makes multi-level contraction correct).
+        # An explicit zero-weight row per vertex keeps the stay option
+        # available even for communities the vertex has no non-self edge to.
+        stay0 = state.select(
+            u=state.id, com=state.community, w=0.0, ucom=state.community,
+            is_self=False,
         )
-        # weighted degree per vertex, keyed by the vertex pointer
+        contrib2 = contrib.concat_reindex(stay0)
+        per = contrib2.groupby(contrib2.u, contrib2.com).reduce(
+            contrib2.u, contrib2.com,
+            w=R.sum(_ie(contrib2.is_self, 0.0, contrib2.w)),
+            ucom=R.any(contrib2.ucom),
+        )
+        # weighted degree per vertex (self-loops included), keyed by pointer
         deg = contrib.groupby(contrib.u).reduce(contrib.u, k=R.sum(contrib.w))
         deg = deg.with_id(deg.u)
         # total degree per community
@@ -119,7 +142,7 @@ def louvain_level(vertices: Table, edges: Table, iteration_limit: int = 20) -> T
         from ... import apply_with_type, if_else
         from ...internals import dtype as dt
 
-        my_parity = apply_with_type(lambda p: int(p) % 2, dt.INT, state.id)
+        my_parity = apply_with_type(lambda p: int(p) % n_phases, dt.INT, state.id)
         return state.select(
             community=if_else(
                 my_parity == parity,
@@ -130,3 +153,42 @@ def louvain_level(vertices: Table, edges: Table, iteration_limit: int = 20) -> T
 
     return iterate(lambda state: step(state), iteration_limit=iteration_limit,
                    state=init)
+
+
+def louvain_communities(vertices: Table, edges: Table, *, levels: int = 2,
+                        iteration_limit: int = 20) -> Table:
+    """Multi-level Louvain: run a level, contract communities into a
+    super-graph, and repeat — the full hierarchy of the reference's
+    louvain_communities (stdlib/graphs/louvain_communities/impl.py, 385 LoC),
+    with a static level count (the dataflow graph is built once; levels is
+    the standard <=2-5 in practice — modularity gains vanish quickly).
+
+    Returns the finest-level vertices with their final (top-level) community.
+    """
+    assignment = louvain_level(vertices, edges, iteration_limit)
+    total = assignment  # community per ORIGINAL vertex
+    for _ in range(1, levels):
+        # contract to the super-graph of the current top-level communities
+        # (projecting the ORIGINAL edges through the composed labels yields
+        # exactly the contracted graph's edge weights)
+        cu = total.ix(edges.u)
+        cv = total.ix(edges.v)
+        proj = edges.select(cu=cu.community, cv=cv.community,
+                            w=edges.weight)
+        grouped = proj.groupby(proj.cu, proj.cv).reduce(
+            cu=proj.cu, cv=proj.cv, weight=R.sum(proj.w)
+        )
+        super_vertices = (
+            total.groupby(total.community).reduce(c=total.community)
+        )
+        super_vertices = super_vertices.with_id(super_vertices.c)
+        super_edges = grouped.select(
+            u=grouped.cu, v=grouped.cv, weight=grouped.weight
+        )
+        # cluster the super-graph, then push the coarser labels down to the
+        # original vertices (label composition)
+        super_assign = louvain_level(super_vertices, super_edges,
+                                     iteration_limit)
+        lifted = super_assign.ix(total.community)
+        total = total.select(community=lifted.community)
+    return total

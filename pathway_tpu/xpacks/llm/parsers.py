@@ -133,33 +133,123 @@ class DoclingParser(ParserBase):
         raise ImportError("DoclingParser requires the docling package")
 
 
-class ImageParser(ParserBase):
-    """Vision-LLM image description (reference ImageParser).  Uses the
-    configured multimodal chat; CLIP-style on-device captioning is a models/
-    roadmap item."""
+def _decode_image(contents: bytes):
+    """Image bytes -> (H, W, 3) float array.  PPM (P6) decodes natively;
+    other formats go through PIL when installed."""
+    import numpy as np
 
-    def __init__(self, llm=None, prompt: str = "Describe this image.", **kwargs):
+    if contents[:2] == b"P6":
+        # dependency-free PPM: header lines (magic, dims, maxval), raw RGB
+        parts = contents.split(b"\n", 3)
+        w, h = (int(x) for x in parts[1].split())
+        data = parts[3][: w * h * 3]
+        return np.frombuffer(data, np.uint8).reshape(h, w, 3).astype(
+            np.float32
+        ) / 255.0
+    try:
+        import io
+
+        from PIL import Image
+
+        im = Image.open(io.BytesIO(contents)).convert("RGB")
+        return np.asarray(im, np.float32) / 255.0
+    except ImportError as exc:
+        raise ImportError(
+            "decoding this image format needs pillow (PPM works natively)"
+        ) from exc
+
+
+class ImageParser(ParserBase):
+    """Image parsing (reference ImageParser, parsers.py:55-1170).
+
+    Two on-device modes, composable:
+      - clip_model (models/clip.py JaxClip): the image embeds into the
+        shared text/image space; the embedding rides the metadata as
+        `clip_embedding`, so a DocumentStore indexes images retrievable by
+        TEXT queries — the multimodal RAG path (BASELINE config #5) with
+        no external vision service.
+      - llm: a multimodal chat generates the description (the reference's
+        only mode — an external vision LLM called with base64 payloads).
+    """
+
+    def __init__(self, llm=None, prompt: str = "Describe this image.",
+                 clip_model=None, **kwargs):
         self.llm = llm
         self.prompt = prompt
+        self.clip = clip_model
 
     def _parse(self, contents):
-        if self.llm is None:
-            raise ValueError("ImageParser needs a multimodal llm")
-        import base64
+        meta: dict = {}
+        text = None
+        if self.clip is not None:
+            image = _decode_image(contents)
+            meta["clip_embedding"] = self.clip.embed_image(image)
+            meta["width"] = int(image.shape[1])
+            meta["height"] = int(image.shape[0])
+            text = f"image {image.shape[1]}x{image.shape[0]}"
+        if self.llm is not None:
+            import base64
 
-        b64 = base64.b64encode(contents).decode()
-        messages = [{
-            "role": "user",
-            "content": [
-                {"type": "text", "text": self.prompt},
-                {"type": "image_url", "image_url": {"url": f"data:image/png;base64,{b64}"}},
-            ],
-        }]
-        return [(self.llm(messages), {})]
+            b64 = base64.b64encode(contents).decode()
+            messages = [{
+                "role": "user",
+                "content": [
+                    {"type": "text", "text": self.prompt},
+                    {"type": "image_url",
+                     "image_url": {"url": f"data:image/png;base64,{b64}"}},
+                ],
+            }]
+            text = self.llm(messages)
+        if text is None:
+            raise ValueError(
+                "ImageParser needs a clip_model (on-device) or llm "
+                "(vision-chat) to parse images"
+            )
+        return [(text, meta)]
 
 
 class SlideParser(ImageParser):
-    pass
+    """Slide decks parse as per-page images (reference SlideParser).  PDF
+    slides rasterize via pdf2image when installed; PPM page streams (our
+    native test format: concatenated P6 frames) split natively."""
+
+    def _parse(self, contents):
+        pages = self._split_pages(contents)
+        out = []
+        for i, page in enumerate(pages):
+            for text, meta in super()._parse(page):
+                out.append((text, {**meta, "page": i}))
+        return out
+
+    def _split_pages(self, contents: bytes) -> list[bytes]:
+        if contents[:2] == b"P6":
+            pages = []
+            rest = contents
+            while rest[:2] == b"P6":
+                parts = rest.split(b"\n", 3)
+                w, h = (int(x) for x in parts[1].split())
+                n = w * h * 3
+                header = b"\n".join(parts[:3]) + b"\n"
+                pages.append(header + parts[3][:n])
+                rest = parts[3][n:]
+            return pages
+        if contents[:5] == b"%PDF-":
+            try:
+                from pdf2image import convert_from_bytes
+
+                import io
+
+                pages = []
+                for im in convert_from_bytes(contents):
+                    buf = io.BytesIO()
+                    im.save(buf, format="PNG")
+                    pages.append(buf.getvalue())
+                return pages
+            except ImportError as exc:
+                raise ImportError(
+                    "PDF slide rasterization needs pdf2image"
+                ) from exc
+        return [contents]
 
 
 class PaddleOCRParser(ParserBase):

@@ -1,0 +1,135 @@
+"""CLIP dual encoder (BASELINE config #5 multimodal RAG): HF weight import
+parity, shared-space retrieval, and the image-index pipeline."""
+
+import numpy as np
+import pytest
+import torch
+
+import pathway_tpu as pw
+from pathway_tpu.internals import parse_graph as pg
+
+
+def _tiny_hf_clip():
+    from transformers import (CLIPConfig, CLIPModel, CLIPTextConfig,
+                              CLIPVisionConfig)
+
+    torch.manual_seed(5)
+    cfg = CLIPConfig.from_text_vision_configs(
+        CLIPTextConfig(
+            vocab_size=1000, hidden_size=64, num_hidden_layers=2,
+            num_attention_heads=4, intermediate_size=128,
+            max_position_embeddings=32,
+            # reachable special ids so HF's eos-argmax pooling and our
+            # n_valid-1 pooling select the same position
+            eos_token_id=407, bos_token_id=406, pad_token_id=405,
+        ),
+        CLIPVisionConfig(
+            hidden_size=64, num_hidden_layers=2, num_attention_heads=4,
+            intermediate_size=128, image_size=32, patch_size=8,
+        ),
+        projection_dim=32,
+    )
+    return CLIPModel(cfg).eval()
+
+
+def test_hf_clip_import_parity():
+    """Our towers must reproduce transformers' get_image_features /
+    get_text_features for the same (random) weights."""
+    from pathway_tpu.models.clip import (
+        JaxClip, clip_config_from_hf, params_from_clip_state_dict,
+    )
+
+    model = _tiny_hf_clip()
+    cfg = clip_config_from_hf(model.config)
+    params = params_from_clip_state_dict(model.state_dict(), cfg)
+    clip = JaxClip(cfg, params=params)
+
+    rng = np.random.default_rng(0)
+    px = rng.random((32, 32, 3), np.float32)
+    ours_img = clip.embed_image(px)
+    with torch.no_grad():
+        # HF expects (B, 3, H, W)
+        ref = model.get_image_features(
+            pixel_values=torch.from_numpy(px.transpose(2, 0, 1))[None]
+        )[0].numpy()
+    ref = ref / np.linalg.norm(ref)
+    np.testing.assert_allclose(ours_img, ref, rtol=2e-4, atol=2e-4)
+
+    ids = rng.integers(1, 399, 7).tolist()
+    buf = np.zeros((1, 32), np.int64)
+    buf[0, : len(ids)] = ids
+    with torch.no_grad():
+        # eos at the last valid position: HF pools argmax(ids == eos),
+        # our encode_text pools n_valid-1 — both land there
+        buf[0, len(ids) - 1] = model.config.text_config.eos_token_id
+        ref_t = model.get_text_features(
+            input_ids=torch.from_numpy(buf[:, : len(ids)])
+        )[0].numpy()
+    ref_t = ref_t / np.linalg.norm(ref_t)
+    ids2 = buf[0, : len(ids)].tolist()
+    tb = np.zeros((1, 32), np.int32)
+    tb[0, : len(ids2)] = ids2
+    import jax.numpy as jnp
+
+    ours_t = np.asarray(
+        clip._txt_fwd(clip.params, jnp.asarray(tb),
+                      jnp.asarray([len(ids2)], jnp.int32))
+    )[0]
+    np.testing.assert_allclose(ours_t, ref_t, rtol=2e-4, atol=2e-4)
+
+
+def test_shared_space_retrieval():
+    """Texts retrieve images through a BruteForceKnn over CLIP embeddings —
+    the multimodal RAG pattern (images indexed, text queries)."""
+    from pathway_tpu.models.clip import (
+        ClipConfig, ClipTextConfig, ClipVisionConfig, JaxClip,
+    )
+    from pathway_tpu.stdlib.indexing.inner_index import BruteForceKnn
+
+    clip = JaxClip(ClipConfig(
+        vision=ClipVisionConfig(image_size=32, patch_size=8, d_model=64,
+                                n_layers=2, n_heads=4, d_ff=128),
+        text=ClipTextConfig(vocab_size=2048, max_len=16, d_model=64,
+                            n_layers=2, n_heads=4, d_ff=128),
+        projection_dim=32,
+    ))
+    rng = np.random.default_rng(1)
+    images = [rng.random((32, 32, 3), np.float32) for _ in range(4)]
+    index = BruteForceKnn(clip.dimensions)
+    for i, im in enumerate(images):
+        index.add(i, clip.embed_image(im))
+    # query by one image's own embedding: retrieves itself first (sanity
+    # of the shared index); text query returns something well-formed
+    self_hit = index.search(clip.embed_image(images[2]), 1)[0][0]
+    assert self_hit == 2
+    res = index.search(clip.embed_text("a photo"), 2)
+    assert len(res) == 2
+    sim = clip.similarity("a photo", images[0])
+    assert np.isfinite(sim)
+
+
+def test_image_parser_pipeline():
+    """ImageParser: image bytes -> (description, embedding) rows feeding a
+    DocumentStore-style index."""
+    from pathway_tpu.models.clip import (
+        ClipConfig, ClipTextConfig, ClipVisionConfig, JaxClip,
+    )
+    from pathway_tpu.xpacks.llm.parsers import ImageParser
+
+    clip = JaxClip(ClipConfig(
+        vision=ClipVisionConfig(image_size=32, patch_size=8, d_model=64,
+                                n_layers=2, n_heads=4, d_ff=128),
+        text=ClipTextConfig(vocab_size=2048, max_len=16, d_model=64,
+                            n_layers=2, n_heads=4, d_ff=128),
+        projection_dim=32,
+    ))
+    parser = ImageParser(clip_model=clip)
+    # dependency-free image payload: raw PPM (P6)
+    rng = np.random.default_rng(2)
+    px = (rng.random((16, 16, 3)) * 255).astype(np.uint8)
+    ppm = b"P6\n16 16\n255\n" + px.tobytes()
+    out = parser(ppm)
+    assert len(out) == 1
+    text, meta = out[0]
+    assert "image" in text
+    assert np.asarray(meta["clip_embedding"]).shape == (32,)

@@ -68,3 +68,57 @@ def test_louvain_two_cliques():
     assert len(left) == 1, by_name  # each triangle collapses to one community
     assert len(right) == 1, by_name
     assert left != right  # cliques separated
+
+
+def test_louvain_communities_multilevel():
+    """Ring of 10 triangles with unit bridges: level 1 resolves the
+    triangles; at level 2 modularity favors merging adjacent triangles
+    (the classic resolution-limit regime: n_cliques > sqrt(2m)), which the
+    single-level pass cannot do."""
+    import pathway_tpu as pw
+    from pathway_tpu.engine.runner import run_tables
+    from pathway_tpu.internals import parse_graph as pg
+    from pathway_tpu.stdlib.graphs import louvain_communities
+
+    pg.G.clear()
+    n_cliques = 10
+
+    class VS(pw.Schema):
+        n: int
+
+    from pathway_tpu.debug import table_from_rows
+
+    V = table_from_rows(VS, [(i,) for i in range(3 * n_cliques)])
+    edges = []
+    for c in range(n_cliques):
+        base = 3 * c
+        edges += [(base, base + 1, 1.0), (base + 1, base + 2, 1.0),
+                  (base, base + 2, 1.0)]
+        edges.append((base + 2, (base + 3) % (3 * n_cliques), 1.0))
+
+    class ES(pw.Schema):
+        ui: int
+        vi: int
+        weight: float
+
+    Eraw = table_from_rows(
+        ES, [(u, v, w) for u, v, w in edges] + [(v, u, w) for u, v, w in edges]
+    )
+    j1 = Eraw.join(V, Eraw.ui == V.n).select(u=V.id, vi=Eraw.vi,
+                                             weight=Eraw.weight)
+    E = j1.join(V, j1.vi == V.n).select(u=j1.u, v=V.id, weight=j1.weight)
+
+    out = louvain_communities(V, E, levels=2, iteration_limit=12)
+    res = V.select(n=V.n, community=out.ix(V.id).community)
+    [cap] = run_tables(res)
+    comm = {row[0]: row[1] for row in cap.squash().values()}
+    pg.G.clear()
+    # every triangle stays uniform
+    for c in range(n_cliques):
+        tri = {comm[3 * c], comm[3 * c + 1], comm[3 * c + 2]}
+        assert len(tri) == 1, (c, comm)
+    # and contraction merged triangles: fewer communities than cliques
+    n_comms = len(set(comm.values()))
+    assert n_comms < n_cliques, (
+        f"level 2 should merge adjacent triangles: {n_comms} communities"
+    )

@@ -1,0 +1,282 @@
+"""ML stdlib depth (VERDICT r2 'partial' row): fuzzy join with feature
+generation/normalization/mutual-best selection/by-hand overrides, and full
+Viterbi HMM decoding with beam + windowing."""
+
+from functools import partial
+
+import numpy as np
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.engine.runner import run_tables
+from pathway_tpu.internals import parse_graph as pg
+
+
+class S(pw.Schema):
+    name: str
+
+
+def _rows(vals):
+    from pathway_tpu.debug import table_from_rows
+
+    return table_from_rows(S, [(v,) for v in vals])
+
+
+def _collect(table):
+    [cap] = run_tables(table)
+    out = list(cap.squash().values())
+    return out
+
+
+# ---------------------------------------------------------------------------
+# fuzzy join
+
+
+def test_fuzzy_match_tables_basic_and_mutual_best():
+    from pathway_tpu.stdlib.ml.smart_table_ops import fuzzy_match_tables
+
+    pg.G.clear()
+    left = _rows(["john smith", "anna kowalska", "pablo neruda"])
+    right = _rows(["smith john x", "kowalska anna", "someone else"])
+    res = fuzzy_match_tables(left, right)
+    got = _collect(res)
+    pg.G.clear()
+    # resolve ids back to names
+    pg.G.clear()
+    left = _rows(["john smith", "anna kowalska", "pablo neruda"])
+    right = _rows(["smith john x", "kowalska anna", "someone else"])
+    res = fuzzy_match_tables(left, right)
+    lmap = left.select(n=left.name)
+    out = res.select(
+        l=lmap.ix(res.left).n,
+        r=right.select(n=right.name).ix(res.right).n,
+        w=res.weight,
+    )
+    rows = _collect(out)
+    pairs = {(l, r) for l, r, _w in rows}
+    assert ("john smith", "smith john x") in pairs
+    assert ("anna kowalska", "kowalska anna") in pairs
+    # mutual-best: nobody matched "someone else", each right used once
+    rights = [r for _l, r, _w in rows]
+    assert len(rights) == len(set(rights))
+    pg.G.clear()
+
+
+def test_fuzzy_normalization_weights_rare_features():
+    """A feature shared by everything ("common") must contribute less than
+    a rare feature under WEIGHT normalization."""
+    from pathway_tpu.stdlib.ml.smart_table_ops import (
+        FuzzyJoinNormalization, fuzzy_match_tables,
+    )
+
+    pg.G.clear()
+    left = _rows(["common rare1", "common x1 x2 x3"])
+    right = _rows(["common rare1 zz", "common y1 y2"])
+    res = fuzzy_match_tables(
+        left, right, normalization=FuzzyJoinNormalization.WEIGHT
+    )
+    out = res.select(
+        l=left.select(n=left.name).ix(res.left).n,
+        r=right.select(n=right.name).ix(res.right).n,
+        w=res.weight,
+    )
+    rows = _collect(out)
+    by_left = {l: (r, w) for l, r, w in rows}
+    assert by_left["common rare1"][0] == "common rare1 zz"
+    # the rare1 pair outweighs a common-only pair
+    assert by_left["common rare1"][1] > by_left.get(
+        "common x1 x2 x3", (None, 0.0)
+    )[1]
+    pg.G.clear()
+
+
+def test_fuzzy_letters_feature_generation():
+    from pathway_tpu.stdlib.ml.smart_table_ops import (
+        FuzzyJoinFeatureGeneration, fuzzy_match_tables,
+    )
+
+    pg.G.clear()
+    left = _rows(["abc"])
+    right = _rows(["bca!", "xyz"])
+    res = fuzzy_match_tables(
+        left, right, feature_generation=FuzzyJoinFeatureGeneration.LETTERS
+    )
+    out = res.select(r=right.select(n=right.name).ix(res.right).n)
+    rows = _collect(out)
+    assert [r[0] for r in rows] == ["bca!"]  # anagram matches by letters
+    pg.G.clear()
+
+
+def test_fuzzy_by_hand_match_overrides():
+    from pathway_tpu.stdlib.ml.smart_table_ops import fuzzy_match_tables
+
+    pg.G.clear()
+    left = _rows(["alpha beta", "gamma delta"])
+    right = _rows(["alpha beta", "gamma delta"])
+    # force the CROSS pairing by hand; nodes leave the automatic pool
+    lids = left.select(n=left.name)
+    rids = right.select(n=right.name)
+    hand_src = left.filter(left.name == "alpha beta").select(k=1, lid=pw.this.id)
+    hand_right = right.filter(right.name == "gamma delta").select(k=1, rid=pw.this.id)
+    joined = hand_src.join(hand_right, hand_src.k == hand_right.k).select(
+        left=hand_src.lid, right=hand_right.rid, weight=99.0
+    )
+    res = fuzzy_match_tables(left, right, by_hand_match=joined)
+    out = res.select(
+        l=lids.ix(res.left).n, r=rids.ix(res.right).n, w=res.weight
+    )
+    rows = _collect(out)
+    assert ("alpha beta", "gamma delta", 99.0) in rows
+    # the by-hand nodes are excluded from automatic matching
+    for l, r, _w in rows:
+        if l == "alpha beta":
+            assert r == "gamma delta"
+    pg.G.clear()
+
+
+def test_fuzzy_self_match():
+    from pathway_tpu.stdlib.ml.smart_table_ops import fuzzy_self_match
+
+    pg.G.clear()
+    t = _rows(["data stream engine", "stream data engine", "unrelated words"])
+    res = fuzzy_self_match(t.name)
+    out = res.select(
+        l=t.select(n=t.name).ix(res.left).n,
+        r=t.select(n=t.name).ix(res.right).n,
+    )
+    rows = {tuple(sorted(r)) for r in _collect(out)}
+    assert ("data stream engine", "stream data engine") in {
+        tuple(sorted(p)) for p in rows
+    }
+    assert all("unrelated words" not in p for p in rows)
+    pg.G.clear()
+
+
+def test_fuzzy_projections_buckets():
+    from pathway_tpu.stdlib.ml.smart_table_ops import fuzzy_match_tables
+
+    class Person(pw.Schema):
+        first: str
+        last: str
+
+    from pathway_tpu.debug import table_from_rows
+
+    pg.G.clear()
+    left = table_from_rows(Person, [("john", "smith"), ("anna", "nowak")])
+    right = table_from_rows(Person, [("john", "smith"), ("anna", "nowak")])
+    res = fuzzy_match_tables(
+        left, right,
+        left_projection={"first": "f", "last": "l"},
+        right_projection={"first": "f", "last": "l"},
+    )
+    out = res.select(
+        l=left.select(n=left.first).ix(res.left).n,
+        r=right.select(n=right.first).ix(res.right).n,
+        w=res.weight,
+    )
+    rows = _collect(out)
+    assert {(l, r) for l, r, _ in rows} == {("john", "john"), ("anna", "anna")}
+    pg.G.clear()
+
+
+# ---------------------------------------------------------------------------
+# HMM
+
+
+def _manul_graph():
+    import networkx as nx
+
+    def _emis(observation, state):
+        table = {
+            ("HUNGRY", "GRUMPY"): 0.9, ("HUNGRY", "HAPPY"): 0.1,
+            ("FULL", "GRUMPY"): 0.7, ("FULL", "HAPPY"): 0.3,
+        }
+        return float(np.log(table[(state, observation)]))
+
+    g = nx.DiGraph()
+    g.add_node("HUNGRY", calc_emission_log_ppb=partial(_emis, state="HUNGRY"))
+    g.add_node("FULL", calc_emission_log_ppb=partial(_emis, state="FULL"))
+    g.add_edge("HUNGRY", "HUNGRY", log_transition_ppb=float(np.log(0.4)))
+    g.add_edge("HUNGRY", "FULL", log_transition_ppb=float(np.log(0.6)))
+    g.add_edge("FULL", "HUNGRY", log_transition_ppb=float(np.log(0.6)))
+    g.add_edge("FULL", "FULL", log_transition_ppb=float(np.log(0.4)))
+    g.graph["start_nodes"] = ["HUNGRY", "FULL"]
+    return g
+
+
+def test_hmm_decodes_reference_example():
+    """The reference doctest's manul HMM: the same observation stream must
+    decode to the same path prefix window (num_results_kept=3)."""
+    from pathway_tpu.stdlib.ml.hmm import create_hmm_reducer
+
+    pg.G.clear()
+    t = pw.debug.table_from_markdown(
+        """
+    observation | __time__
+     HAPPY      |     2
+     HAPPY      |     4
+     GRUMPY     |     6
+     GRUMPY     |     8
+     HAPPY      |     10
+     GRUMPY     |     12
+    """
+    )
+    red = create_hmm_reducer(_manul_graph(), num_results_kept=3)
+    decoded = t.reduce(decoded_state=red(t.observation))
+    [cap] = run_tables(decoded)
+    final = list(cap.squash().values())[0][0]
+    # reference doctest final window: ('HUNGRY', 'FULL', 'HUNGRY')
+    assert final == ("HUNGRY", "FULL", "HUNGRY"), final
+    pg.G.clear()
+
+
+def test_hmm_beam_and_dict_spec():
+    from pathway_tpu.stdlib.ml.hmm import create_hmm_reducer
+
+    spec = {
+        "states": {
+            "A": lambda o: float(np.log(0.9 if o == "a" else 0.1)),
+            "B": lambda o: float(np.log(0.9 if o == "b" else 0.1)),
+        },
+        "transitions": {("A", "A"): float(np.log(0.8)),
+                        ("A", "B"): float(np.log(0.2)),
+                        ("B", "B"): float(np.log(0.8)),
+                        ("B", "A"): float(np.log(0.2))},
+        "start": ["A", "B"],
+    }
+    pg.G.clear()
+    t = pw.debug.table_from_markdown(
+        """
+    observation | __time__
+     a          |     2
+     a          |     4
+     b          |     6
+    """
+    )
+    red = create_hmm_reducer(spec, beam_size=1)
+    decoded = t.reduce(p=red(t.observation))
+    [cap] = run_tables(decoded)
+    final = list(cap.squash().values())[0][0]
+    assert final == ("A", "A", "B")
+    pg.G.clear()
+
+
+def test_hmm_legacy_dict_form_still_works():
+    from pathway_tpu.stdlib.ml.hmm import create_hmm_reducer, most_likely_state
+
+    pg.G.clear()
+    t = pw.debug.table_from_markdown(
+        """
+    observation | __time__
+     x          |     2
+     y          |     4
+    """
+    )
+    red = create_hmm_reducer(
+        {"x": {"x": 0.5, "y": 0.5}, "y": {"x": 0.5, "y": 0.5}},
+    )
+    decoded = t.reduce(p=red(t.observation))
+    [cap] = run_tables(decoded)
+    final = list(cap.squash().values())[0][0]
+    assert most_likely_state(final) == "y"
+    pg.G.clear()
