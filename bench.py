@@ -496,13 +496,52 @@ def _tpu_generation() -> str:
     return ""
 
 
+_PARTIAL: dict = {}
+_DONE = False
+
+
 def _stage(msg: str) -> None:
+    _PARTIAL["last_stage"] = msg
     print(f"[bench] {time.strftime('%H:%M:%S')} {msg}", file=sys.stderr,
           flush=True)
 
 
+def _start_watchdog() -> None:
+    """The axon tunnel can wedge a device call indefinitely (observed twice
+    in round 3).  A blocked main thread cannot run signal handlers, so a
+    watchdog THREAD emits whatever results completed before the external
+    timeout would kill the process with no output at all."""
+    import threading
+
+    deadline = float(os.environ.get("PW_BENCH_DEADLINE_S", "1800"))
+
+    def guard():
+        time.sleep(deadline)
+        if _DONE:
+            return
+        out = {
+            "metric": "rag_index_throughput",
+            "value": _PARTIAL.get("docs_per_sec"),
+            "unit": "docs/sec",
+            "vs_baseline": _PARTIAL.get("vs_baseline"),
+            "partial": True,
+            "wedged_at_stage": _PARTIAL.get("last_stage"),
+            **{k: v for k, v in _PARTIAL.items() if k != "last_stage"},
+        }
+        print(json.dumps(out), flush=True)
+        print(
+            f"[bench] watchdog: device call wedged at stage "
+            f"{_PARTIAL.get('last_stage')!r}; emitted partial results",
+            file=sys.stderr, flush=True,
+        )
+        os._exit(3)
+
+    threading.Thread(target=guard, daemon=True, name="bench-watchdog").start()
+
+
 def main() -> None:
     _ensure_healthy_backend()
+    _start_watchdog()
     import jax
 
     from pathway_tpu.models.encoder import EncoderConfig, JaxEncoder
@@ -617,6 +656,8 @@ def main() -> None:
     t1 = time.perf_counter()
     assert len(caps[0].squash()) == 1
     docs_per_sec = n_docs / (t1 - t0)
+    _PARTIAL["docs_per_sec"] = round(docs_per_sec, 1)
+    _PARTIAL["backend"] = backend
     # per-stage attribution of the ingest wall time (VERDICT r2 weak #1)
     stages = {
         "total_s": round(t1 - t0, 3),
@@ -670,6 +711,8 @@ def main() -> None:
         index.search(enc.embed(q), k)
         lat_dev.append((time.perf_counter() - tq) * 1000)
     stages["query_device_path_ms_p50"] = round(statistics.median(lat_dev), 2)
+    _PARTIAL["query_p50_ms"] = round(p50, 2)
+    _PARTIAL["stages"] = stages
 
     # end-to-end embed throughput (tokenize + h2d + forward, full-corpus
     # dispatch, scalar-checksum sync — the steady-state ingest pattern)
@@ -716,13 +759,18 @@ def main() -> None:
     gen = _tpu_generation()
     peak = _TPU_PEAK.get(gen) if backend == "tpu" else None
     mfu = round(achieved / peak, 4) if peak else None
+    _PARTIAL["embed_mfu"] = mfu
+    _PARTIAL["embed_tokens_per_sec"] = round(embed_tokens_per_sec)
 
     _stage("wordcount")
     wordcount_rps = bench_wordcount()
+    _PARTIAL["wordcount_rows_per_sec"] = round(wordcount_rps)
     _stage("generation")
     generation = bench_generation()
+    _PARTIAL["generation"] = generation
     _stage("retrieval quality")
     retrieval_quality = bench_retrieval_quality()
+    _PARTIAL["retrieval_quality"] = retrieval_quality
 
     # measured reference baseline on the same corpus (CPU, torch MiniLM arch)
     n_base = 1024
@@ -731,12 +779,17 @@ def main() -> None:
         docs[:n_base], queries[:16], k, enc.tokenizer
     )
     vs_baseline = round(docs_per_sec / base["docs_per_sec"], 2)
+    _PARTIAL["vs_baseline"] = vs_baseline
+    _PARTIAL["baseline_docs_per_sec"] = round(base["docs_per_sec"], 1)
+    _PARTIAL["baseline_query_p50_ms"] = round(base["p50_ms"], 2)
 
     _stage("parallel")
     parallel = bench_parallel()
     _stage("data plane")
     data_plane = bench_data_plane()
 
+    global _DONE
+    _DONE = True
     print(
         json.dumps(
             {

@@ -252,23 +252,34 @@ class Tracer:
         return _SpanCtx(self, name, attributes)
 
     def export(self) -> None:
-        """Drain accumulated spans: write to PATHWAY_TRACE_FILE (if set) and
-        move them to `last_spans`, so repeated pw.run() calls in one process
-        neither re-export nor grow memory without bound."""
+        """Drain accumulated spans: write to PATHWAY_TRACE_FILE (if set),
+        push OTLP/HTTP JSON to PATHWAY_MONITORING_SERVER (if set — the
+        reference's telemetry.rs:296-601 OTLP exporter), and move them to
+        `last_spans`, so repeated pw.run() calls in one process neither
+        re-export nor grow memory without bound."""
         import json as _json
         import os as _os
 
         spans, self.spans = self.spans, []
         self.last_spans = spans
         path = _os.environ.get("PATHWAY_TRACE_FILE")
-        if not path:
-            return
-        try:
-            with open(path, "a", encoding="utf-8") as f:
-                for s in spans:
-                    f.write(_json.dumps(s.as_dict()) + "\n")
-        except Exception:
-            pass
+        if path:
+            try:
+                with open(path, "a", encoding="utf-8") as f:
+                    for s in spans:
+                        f.write(_json.dumps(s.as_dict()) + "\n")
+            except Exception:
+                pass
+        endpoint = _os.environ.get("PATHWAY_MONITORING_SERVER")
+        if endpoint and spans:
+            try:
+                otlp_export_spans(endpoint, spans)
+            except Exception:
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "OTLP span export to %s failed", endpoint, exc_info=True
+                )
 
 
 class _SpanCtx:
@@ -305,3 +316,96 @@ class _SpanCtx:
 
 
 global_tracer = Tracer()
+
+
+# ---------------------------------------------------------------------------
+# OTLP/HTTP export (reference: src/engine/telemetry.rs:296,601 — OTel OTLP
+# push of spans + metrics).  The OTLP JSON encoding needs no SDK: spans POST
+# to {endpoint}/v1/traces, metrics to {endpoint}/v1/metrics.
+# ---------------------------------------------------------------------------
+
+_RESOURCE = {
+    "attributes": [
+        {"key": "service.name", "value": {"stringValue": "pathway-tpu"}},
+    ]
+}
+
+
+def _post_json(url: str, payload: dict) -> None:
+    import json as _json
+    import urllib.request
+
+    req = urllib.request.Request(
+        url, data=_json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"}, method="POST",
+    )
+    urllib.request.urlopen(req, timeout=10).read()
+
+
+def otlp_export_spans(endpoint: str, spans: list["Span"]) -> None:
+    import os as _os
+
+    trace_id = _os.urandom(16).hex()
+    span_ids = {id(s): _os.urandom(8).hex() for s in spans}
+    otlp = []
+    for s in spans:
+        otlp.append({
+            "traceId": trace_id,
+            "spanId": span_ids[id(s)],
+            "parentSpanId": (
+                span_ids.get(id(s.parent), "") if s.parent else ""
+            ),
+            "name": s.name,
+            "kind": 1,  # SPAN_KIND_INTERNAL
+            "startTimeUnixNano": str(int(s.start * 1e9)),
+            "endTimeUnixNano": str(int((s.end or time.time()) * 1e9)),
+            "attributes": [
+                {"key": k, "value": {"stringValue": str(v)}}
+                for k, v in s.attributes.items()
+            ],
+        })
+    _post_json(
+        endpoint.rstrip("/") + "/v1/traces",
+        {"resourceSpans": [{
+            "resource": _RESOURCE,
+            "scopeSpans": [{
+                "scope": {"name": "pathway_tpu"},
+                "spans": otlp,
+            }],
+        }]},
+    )
+
+
+def otlp_export_metrics(endpoint: str, scheduler) -> None:
+    """Push per-operator row counters as OTLP sums (the /metrics content in
+    push form)."""
+    now = str(int(time.time() * 1e9))
+    points = []
+    for op in scheduler.operators:
+        for direction, val in (("in", op.rows_in), ("out", op.rows_out)):
+            points.append({
+                "asInt": str(val),
+                "timeUnixNano": now,
+                "attributes": [
+                    {"key": "operator", "value": {"stringValue": op.name}},
+                    {"key": "id", "value": {"stringValue": str(op.id)}},
+                    {"key": "direction", "value": {"stringValue": direction}},
+                ],
+            })
+    _post_json(
+        endpoint.rstrip("/") + "/v1/metrics",
+        {"resourceMetrics": [{
+            "resource": _RESOURCE,
+            "scopeMetrics": [{
+                "scope": {"name": "pathway_tpu"},
+                "metrics": [{
+                    "name": "pathway.operator.rows",
+                    "sum": {
+                        "aggregationTemporality": 2,  # CUMULATIVE
+                        "isMonotonic": True,
+                        "dataPoints": points,
+                    },
+                }],
+            }],
+        }]},
+    )

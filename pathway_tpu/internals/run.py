@@ -32,6 +32,9 @@ def run(
     sinks = list(pg.G.outputs)
     if not sinks:
         return
+    from ..io._synchronization import apply_synchronization_groups
+
+    apply_synchronization_groups()
     from ..engine.telemetry import global_error_log
 
     global_error_log.clear()
@@ -117,6 +120,20 @@ def run(
                 runner.run_batch()
     finally:
         global_tracer.export()
+        import os as _os
+
+        _mon = _os.environ.get("PATHWAY_MONITORING_SERVER")
+        if _mon:
+            from ..engine.telemetry import otlp_export_metrics
+
+            try:
+                otlp_export_metrics(_mon, scheduler)
+            except Exception:
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "OTLP metrics export to %s failed", _mon, exc_info=True
+                )
         if dashboard is not None:
             dashboard.stop()
         if reporter is not None:

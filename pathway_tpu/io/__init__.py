@@ -83,16 +83,31 @@ sys.modules["pathway_tpu.io.minio"] = minio
 from . import gdrive  # noqa: E402  (real: Drive tree poller behind a client seam)
 from . import mysql  # noqa: E402  (real: CDC polling + dialect writers)
 from . import deltalake  # noqa: E402  (real: native Delta log + parquet parts)
+from . import clickhouse  # noqa: E402  (real: HTTP interface, JSONEachRow)
+from . import nats  # noqa: E402  (real: native wire protocol)
+from . import mqtt  # noqa: E402  (real: native MQTT 3.1.1 packets)
+from . import questdb  # noqa: E402  (real: ILP write + /exec read)
+from . import vector_writers  # noqa: E402
+
+# vector-store sinks as pw.io.<name>.write (reference: pinecone.rs 746,
+# qdrant.rs 538, chroma.rs 494 — REST APIs, implemented natively)
+pinecone = types.ModuleType("pathway_tpu.io.pinecone")
+pinecone.write = vector_writers.write_pinecone
+sys.modules["pathway_tpu.io.pinecone"] = pinecone
+qdrant = types.ModuleType("pathway_tpu.io.qdrant")
+qdrant.write = vector_writers.write_qdrant
+sys.modules["pathway_tpu.io.qdrant"] = qdrant
+chroma = types.ModuleType("pathway_tpu.io.chroma")
+chroma.write = vector_writers.write_chroma
+sys.modules["pathway_tpu.io.chroma"] = chroma
+
 sharepoint = _make_stub("sharepoint", "Office365-REST client")
 iceberg = _make_stub("iceberg", "pyiceberg")
-nats = _make_stub("nats", "nats-py")
-mqtt = _make_stub("mqtt", "paho-mqtt")
 rabbitmq = _make_stub("rabbitmq", "pika")
 kinesis = _make_stub("kinesis", "boto3")
 dynamodb = _make_stub("dynamodb", "boto3")
 bigquery = _make_stub("bigquery", "google-cloud-bigquery")
 redpanda = kafka
-questdb = _make_stub("questdb", "questdb client")
 
 from . import airbyte  # noqa: E402  (real: executable/venv/docker protocol runner)
 
@@ -125,4 +140,5 @@ __all__ = [
     "gdrive", "postgres", "mysql", "mongodb", "elasticsearch", "deltalake",
     "iceberg", "nats", "mqtt", "rabbitmq", "kinesis", "dynamodb", "bigquery",
     "redpanda", "airbyte", "debezium", "null", "sharepoint",
+    "clickhouse", "questdb", "pinecone", "qdrant", "chroma",
 ]

@@ -241,18 +241,11 @@ class DeltaSource(DataSource):
                     break
                 for a in _read_actions(self.path, ver):
                     if "add" in a:
-                        fname = a["add"]["path"]
-                        try:
-                            self._file_rows[fname] = self._rows_of(fname)
-                        except OSError:
-                            import logging
-
-                            logging.getLogger(__name__).warning(
-                                "delta part %s vanished before resume; a "
-                                "later remove of it cannot retract its rows",
-                                fname,
-                            )
-                            self._file_rows[fname] = []
+                        # lazy: rows materialize only if a remove for this
+                        # part ever arrives (parts persist until vacuum);
+                        # eager loading would scan the whole table on every
+                        # resume
+                        self._file_rows[a["add"]["path"]] = None
                     elif "remove" in a:
                         self._file_rows.pop(a["remove"]["path"], None)
 
@@ -301,7 +294,19 @@ class DeltaSource(DataSource):
                         events.append((0, key, row, diff))
                 elif "remove" in a:
                     fname = a["remove"]["path"]
-                    for key, row, diff in self._file_rows.pop(fname, []):
+                    rows = self._file_rows.pop(fname, [])
+                    if rows is None:  # added pre-resume: load lazily now
+                        try:
+                            rows = self._rows_of(fname)
+                        except OSError:
+                            import logging
+
+                            logging.getLogger(__name__).warning(
+                                "delta part %s already vacuumed; cannot "
+                                "retract its rows", fname,
+                            )
+                            rows = []
+                    for key, row, diff in rows:
                         events.append((0, key, row, -diff))
             self._applied = ver
         return events

@@ -146,6 +146,7 @@ def smart_fuzzy_match(
     normalization=FuzzyJoinNormalization.LOGWEIGHT,
     feature_generation=FuzzyJoinFeatureGeneration.AUTO,
     threshold: float = 0.0,
+    _append_by_hand: bool = True,
 ) -> Table:
     """Match rows of two string columns; returns (left, right, weight).
     Reference: smart_fuzzy_match (:199)."""
@@ -175,7 +176,7 @@ def smart_fuzzy_match(
     if threshold > 0:
         scored = scored.filter(scored.weight >= threshold)
     matched = _mutual_best(scored)
-    if by_hand_match is not None:
+    if by_hand_match is not None and _append_by_hand:
         matched = matched.concat_reindex(
             by_hand_match.select(
                 left=by_hand_match.left, right=by_hand_match.right,
@@ -239,6 +240,9 @@ def fuzzy_match_tables(
                 _concat_desc(lb).desc, _concat_desc(rb).desc,
                 by_hand_match=by_hand_match, normalization=normalization,
                 feature_generation=feature_generation,
+                # exclusion per bucket, but the authoritative rows are
+                # appended ONCE after the merge (not summed per bucket)
+                _append_by_hand=False,
             )
         )
     if not parts:
@@ -253,6 +257,13 @@ def fuzzy_match_tables(
     if threshold > 0:
         # threshold applies to the summed cross-bucket weight
         out = out.filter(out.weight >= threshold)
+    if by_hand_match is not None:
+        out = out.concat_reindex(
+            by_hand_match.select(
+                left=by_hand_match.left, right=by_hand_match.right,
+                weight=by_hand_match.weight,
+            )
+        )
     return out
 
 

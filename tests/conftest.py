@@ -26,7 +26,10 @@ def clear_parse_graph():
     """Reference parity: autouse fixture clears the global ParseGraph after
     every test (python/pathway/conftest.py:21-77)."""
     from pathway_tpu.internals import parse_graph as pg
+    from pathway_tpu.io._synchronization import clear_groups
 
     pg.G.clear()
+    clear_groups()
     yield
     pg.G.clear()
+    clear_groups()

@@ -1,0 +1,99 @@
+"""Connector synchronization groups (reference: synchronization.rs 816 LoC):
+sources advance through their sync column together within max_difference;
+an exhausted source goes idle instead of deadlocking the group."""
+
+import threading
+import time
+
+import pathway_tpu as pw
+from pathway_tpu.internals import parse_graph as pg
+from pathway_tpu.io._synchronization import SynchronizationGroup
+
+
+def test_group_algorithm_bounds():
+    g = SynchronizationGroup(max_difference=10)
+    a = g.register_source()
+    b = g.register_source()
+    # a's first value cannot go while b has proposed nothing (b's first
+    # value could be arbitrarily small)
+    assert not g.can_send(a, 0)
+    # b proposes 3: now the laggard (a at 0) may send; b must wait for it
+    assert not g.can_send(b, 3)
+    assert g.can_send(a, 0)
+    g.report(a, 0)
+    assert g.can_send(b, 3)  # now within a.last + 10
+    g.report(b, 3)
+    # a may run ahead up to b.last + 10
+    assert g.can_send(a, 13)
+    g.report(a, 13)
+    assert not g.can_send(a, 14)  # beyond b.last(3) + 10
+    g.report(b, 9)
+    assert g.can_send(a, 14)  # window moved
+    g.report(a, 14)
+    # idle source leaves the computation
+    g.set_idle(b)
+    assert g.can_send(a, 1000)
+
+
+def test_sources_advance_together_e2e():
+    """Two python-connector sources with skewed timelines: the fast one's
+    events must not outrun the slow one by more than max_difference at any
+    observed point."""
+    pg.G.clear()
+
+    class S(pw.Schema):
+        t: int
+        src: str
+
+    from pathway_tpu.internals.datasource import SubjectDataSource
+    from pathway_tpu.io._utils import make_input_table
+
+    class _Feeder:
+        def __init__(self, name, times, delay):
+            self.name = name
+            self.times = times
+            self.delay = delay
+
+        def _run(self, handle):
+            for t in self.times:
+                handle.push((t, self.name), 1, None)
+                time.sleep(self.delay)
+            handle.close()
+
+    # fast source races ahead to 100; slow source crawls to 40
+    fast = _Feeder("fast", list(range(0, 101, 20)), 0.01)
+    slow = _Feeder("slow", list(range(0, 41, 10)), 0.15)
+    sf = SubjectDataSource(_Feeder(fast.name, fast.times, fast.delay),
+                           ["t", "src"], None)
+    ss = SubjectDataSource(_Feeder(slow.name, slow.times, slow.delay),
+                           ["t", "src"], None)
+    tf = make_input_table(S, sf, name="fast")
+    ts = make_input_table(S, ss, name="slow")
+
+    pw.io.register_input_synchronization_group(
+        tf.t, ts.t, max_difference=20
+    )
+
+    seen = []
+    seen_max = {"fast": -1, "slow": -1}
+    violations = []
+
+    def on_change(key, row, time, is_addition):
+        seen.append((row["src"], row["t"]))
+        seen_max[row["src"]] = max(seen_max[row["src"]], row["t"])
+        if row["src"] == "fast" and seen_max["slow"] < 40:
+            # while the slow source is still running, a delivered fast
+            # event must be within max_difference of the furthest slow
+            # event (once slow finishes it goes idle and the constraint
+            # lifts, so fast may drain — reference idle semantics)
+            if row["t"] > seen_max["slow"] + 20:
+                violations.append((row["t"], seen_max["slow"]))
+
+    pw.io.subscribe(tf.concat_reindex(ts), on_change=on_change)
+    pw.run(timeout_s=5.0, autocommit_duration_ms=20,
+           monitoring_level=pw.MonitoringLevel.NONE)
+
+    assert not violations, violations
+    # everything was eventually delivered (slow finishing lets fast drain)
+    assert seen_max["fast"] == 100
+    assert seen_max["slow"] == 40
