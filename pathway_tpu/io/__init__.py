@@ -101,13 +101,26 @@ chroma = types.ModuleType("pathway_tpu.io.chroma")
 chroma.write = vector_writers.write_chroma
 sys.modules["pathway_tpu.io.chroma"] = chroma
 
-sharepoint = _make_stub("sharepoint", "Office365-REST client")
+from . import sharepoint  # noqa: E402  (real: Graph REST + OAuth2, no client lib)
+from . import kinesis  # noqa: E402  (real: SigV4-signed REST, no boto3)
+from . import dynamodb  # noqa: E402  (real: SigV4-signed REST, no boto3)
+from . import bigquery  # noqa: E402  (real: service-account JWT + insertAll)
 iceberg = _make_stub("iceberg", "pyiceberg")
 rabbitmq = _make_stub("rabbitmq", "pika")
-kinesis = _make_stub("kinesis", "boto3")
-dynamodb = _make_stub("dynamodb", "boto3")
-bigquery = _make_stub("bigquery", "google-cloud-bigquery")
 redpanda = kafka
+
+# logstash sink: its HTTP input plugin takes plain JSON POSTs
+logstash = types.ModuleType("pathway_tpu.io.logstash")
+
+
+def _logstash_write(table, endpoint: str, **kwargs):
+    from .http import write as _http_write
+
+    return _http_write(table, endpoint, **kwargs)
+
+
+logstash.write = _logstash_write
+sys.modules["pathway_tpu.io.logstash"] = logstash
 
 from . import airbyte  # noqa: E402  (real: executable/venv/docker protocol runner)
 
@@ -123,7 +136,7 @@ def _debezium_read(rdkafka_settings, topic_name=None, *, schema=None, **kw):
 
 debezium.read = _debezium_read
 sys.modules["pathway_tpu.io.debezium"] = debezium
-logstash = _make_stub("logstash", "http wiring")
+
 null = types.ModuleType("pathway_tpu.io.null")
 null.write = lambda table, **kwargs: None
 sys.modules["pathway_tpu.io.null"] = null
