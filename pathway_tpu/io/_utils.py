@@ -433,3 +433,13 @@ def add_output_node(table: Table, writer) -> None:
     pg.new_output_node(
         "output", [table], colnames=table.column_names(), writer=writer
     )
+
+
+def plain_scalar(v):
+    """JSON/transport-safe scalar: passthrough primitives, stringify rest
+    (shared by the sink connectors)."""
+    if isinstance(v, (int, float, str, bool, type(None))):
+        return v
+    if isinstance(v, Json):
+        return v.value
+    return str(v)

@@ -18,6 +18,7 @@ from typing import Any
 from ..engine.types import unwrap_row
 from ..internals import parse_graph as pg
 from ..internals.table import Table
+from ._utils import plain_scalar
 
 _SCOPE = "https://www.googleapis.com/auth/bigquery.insertdata"
 
@@ -89,7 +90,7 @@ class _BigQueryWriter:
         rows = []
         colnames = list(colnames)
         for key, row, diff in updates:
-            d = dict(zip(colnames, (_plain(v) for v in unwrap_row(row))))
+            d = dict(zip(colnames, (plain_scalar(v) for v in unwrap_row(row))))
             d["time"] = time_
             d["diff"] = diff
             rows.append({"insertId": f"{key}:{time_}:{diff}", "json": d})
@@ -117,10 +118,6 @@ class _BigQueryWriter:
         pass
 
 
-def _plain(v):
-    if isinstance(v, (int, float, str, bool, type(None))):
-        return v
-    return str(v)
 
 
 def write(table: Table, dataset: str, table_name: str, *,

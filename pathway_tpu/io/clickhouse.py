@@ -26,7 +26,7 @@ from ..internals.datasource import DataSource
 from ..internals.schema import SchemaMetaclass
 from ..internals.table import Table
 from ..internals.value import ref_scalar
-from ._utils import coerce_value, make_input_table
+from ._utils import coerce_value, make_input_table, plain_scalar
 
 _log = logging.getLogger("pathway_tpu.io.clickhouse")
 
@@ -211,7 +211,7 @@ class _ClickHouseWriter:
         if not self.snapshot:
             lines = []
             for _key, row, diff in updates:
-                d = dict(zip(colnames, (_plain(v) for v in unwrap_row(row))))
+                d = dict(zip(colnames, (plain_scalar(v) for v in unwrap_row(row))))
                 d["time"] = time_
                 d["diff"] = diff
                 lines.append(json.dumps(d))
@@ -223,7 +223,7 @@ class _ClickHouseWriter:
         pk = self.primary_key or [list(colnames)[0]]
         inserts = []
         for _key, row, diff in updates:
-            vals = [_plain(v) for v in unwrap_row(row)]
+            vals = [plain_scalar(v) for v in unwrap_row(row)]
             d = dict(zip(colnames, vals))
             if diff > 0:
                 inserts.append(json.dumps(d))
@@ -244,10 +244,6 @@ class _ClickHouseWriter:
         pass
 
 
-def _plain(v):
-    if isinstance(v, (int, float, str, bool, type(None))):
-        return v
-    return str(v)
 
 
 def _sql_lit(v) -> str:

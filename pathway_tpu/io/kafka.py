@@ -19,6 +19,11 @@ from ..internals import parse_graph as pg
 
 
 def _get_consumer(rdkafka_settings: dict, topic: str):
+    injected = rdkafka_settings.get("_consumer")
+    if injected is not None:
+        # test seam (same standard as postgres/mysql/clickhouse): any object
+        # with the kafka-python poll(timeout_ms)->{tp: [records]} surface
+        return ("kafka-python", injected)
     try:
         from confluent_kafka import Consumer  # type: ignore
     except ImportError:
@@ -130,9 +135,14 @@ class KafkaSource(DataSource):
                 )
                 self._n += 1
                 continue
-            if self.format == "json":
+            if self.format in ("json", "bson"):
                 try:
-                    d = json.loads(raw)
+                    if self.format == "bson":
+                        from ._bson import decode_document
+
+                        d, _ = decode_document(raw)
+                    else:
+                        d = json.loads(raw)
                 except Exception:
                     continue
                 row = tuple(coerce_value(d.get(c), dtypes[c]) for c in colnames)

@@ -22,7 +22,7 @@ from ..internals.datasource import DataSource
 from ..internals.schema import ColumnDefinition, SchemaMetaclass
 from ..internals.table import Table
 from ._aws import AwsCredentials, aws_call
-from ._utils import coerce_value, make_input_table
+from ._utils import coerce_value, make_input_table, plain_scalar
 
 _log = logging.getLogger("pathway_tpu.io.kinesis")
 _T = "Kinesis_20131202"
@@ -90,37 +90,42 @@ class KinesisSource(DataSource):
         from ..internals.value import ref_scalar
 
         events = []
-        pk_cols = self.schema.primary_key_columns() if self.schema else []
+        schema = self.schema
+        pk_cols = schema.primary_key_columns() if schema else []
+        colnames = schema.column_names() if schema else []
+        dtypes = schema.dtypes() if schema else {}
+        pk_idx = [colnames.index(c) for c in pk_cols]
         for shard in self._shard_ids():
             try:
                 resp = self._call(
                     "GetRecords", {"ShardIterator": self._iterator(shard),
                                    "Limit": 1000}
                 )
-            except Exception:
-                # expired/broken iterator: rebuild from the committed
-                # sequence number on the next poll
+            except Exception as exc:
+                # one shard's failure (expired iterator, throttle) must not
+                # drop the records already fetched from healthy shards: its
+                # iterator is discarded for a clean rebuild from the
+                # committed sequence number, and we move on
                 self._iterators.pop(shard, None)
-                raise
+                _log.warning("kinesis shard %s fetch failed: %s", shard, exc)
+                continue
             shard_events = []
             last_seq = None
             for rec in resp.get("Records", []):
                 payload = base64.b64decode(rec["Data"])
                 last_seq = rec["SequenceNumber"]
-                if self.fmt == "json" and self.schema is not None:
+                if self.fmt == "json" and schema is not None:
                     try:
                         d = json.loads(payload)
                     except ValueError:
                         continue
-                    dtypes = self.schema.dtypes()
                     row = tuple(
-                        coerce_value(d.get(c), dtypes[c])
-                        for c in self.schema.column_names()
+                        coerce_value(d.get(c), dtypes[c]) for c in colnames
                     )
                     if pk_cols:
-                        # pk-declared schemas keep upsert key semantics
-                        # (parity with io/kafka.py json keying)
-                        key = ref_scalar(*[d.get(c) for c in pk_cols])
+                        # key off the COERCED row values (pointer_from
+                        # parity — identical to io/kafka.py json keying)
+                        key = ref_scalar(*[row[i] for i in pk_idx])
                     else:
                         key = ref_scalar("#kinesis", self.stream_name,
                                          shard, rec["SequenceNumber"])
@@ -195,7 +200,7 @@ class _KinesisWriter:
         records = []
         colnames = list(colnames)
         for key, row, diff in updates:
-            d = dict(zip(colnames, (_plain(v) for v in unwrap_row(row))))
+            d = dict(zip(colnames, (plain_scalar(v) for v in unwrap_row(row))))
             d["time"] = time_
             d["diff"] = diff
             pk = (
@@ -218,10 +223,6 @@ class _KinesisWriter:
         pass
 
 
-def _plain(v):
-    if isinstance(v, (int, float, str, bool, type(None))):
-        return v
-    return str(v)
 
 
 def write(table: Table, stream_name: str, *, access_key: str = "",

@@ -25,7 +25,7 @@ from ..internals.schema import ColumnDefinition, SchemaMetaclass
 from ..internals.table import Table
 from ..internals.value import Json
 from ..internals.compat import schema_builder
-from ._utils import coerce_value, make_input_table
+from ._utils import coerce_value, make_input_table, plain_scalar
 
 _log = logging.getLogger("pathway_tpu.io.nats")
 
@@ -173,7 +173,7 @@ class _NatsWriter:
         if self._conn is None:
             self._conn = _NatsConn(self.uri)
         for _key, row, diff in updates:
-            d = dict(zip(colnames, (_plain(v) for v in unwrap_row(row))))
+            d = dict(zip(colnames, (plain_scalar(v) for v in unwrap_row(row))))
             d["diff"] = diff
             d["time"] = time_
             self._conn.publish(self.topic, json.dumps(d).encode())
@@ -183,12 +183,6 @@ class _NatsWriter:
             self._conn.close()
 
 
-def _plain(v):
-    if isinstance(v, (int, float, str, bool, type(None))):
-        return v
-    if isinstance(v, Json):
-        return v.value
-    return str(v)
 
 
 def write(table: Table, uri: str, *, topic: str, **kwargs) -> None:

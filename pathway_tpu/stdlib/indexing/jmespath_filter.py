@@ -110,6 +110,15 @@ def _parse_atom(toks, i, md):
             i += 1
         return val, i
     if kind == "str":
+        if v[0] == "`":
+            # jmespath backticks delimit JSON literals (`1` is the number 1,
+            # `"x"` the string x), not strings
+            import json as _json
+
+            try:
+                return _json.loads(v[1:-1]), i + 1
+            except ValueError:
+                return v[1:-1], i + 1
         return v[1:-1], i + 1
     if kind == "num":
         return float(v) if "." in v else int(v), i + 1

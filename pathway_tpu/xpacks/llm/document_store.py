@@ -70,6 +70,7 @@ class DocumentStore:
                 _pw_pp=apply(lambda t, m, _p=post: tuple(_p(t, m)), parsed.text, parsed.metadata)
             ).select(text=this._pw_pp[0], metadata=this._pw_pp[1])
 
+        self.parsed_docs = parsed  # post-parse, pre-split (SlidesDocumentStore)
         chunked = parsed.select(
             _pw_pieces=self.splitter(parsed.text), metadata=parsed.metadata
         )
@@ -162,7 +163,47 @@ def _pack_results(texts, metas, scores) -> Json:
 
 
 class SlidesDocumentStore(DocumentStore):
-    """Parity class (reference: document_store.py:576)."""
+    """Slide-search document store (reference: document_store.py:576).
+
+    Adds `parsed_documents_query`: the set of document metadata after the
+    parsing/post-processing stages (pre-split), with bulky fields like
+    b64_image stripped from responses and optional jmespath filtering."""
+
+    excluded_response_metadata = ["b64_image"]
+
+    def parsed_documents_query(self, parse_docs_queries: Table) -> Table:
+        docs = self.parsed_docs
+        all_metas = docs.reduce(metadatas=R.tuple(docs.metadata))
+        cols = parse_docs_queries.column_names()
+        mf = (
+            parse_docs_queries.metadata_filter
+            if "metadata_filter" in cols else None
+        )
+        excluded = list(self.excluded_response_metadata)
+
+        def fmt(metadatas, metadata_filter) -> Json:
+            metas = [
+                m.value if isinstance(m, Json) else m
+                for m in (metadatas or ())
+            ]
+            if metadata_filter:
+                from ...stdlib.indexing.jmespath_filter import evaluate_filter
+
+                metas = [m for m in metas if evaluate_filter(metadata_filter, m)]
+            out = []
+            for m in metas:
+                m = dict(m) if isinstance(m, dict) else {"value": m}
+                for k in excluded:
+                    m.pop(k, None)
+                out.append(m)
+            return Json(out)
+
+        joined = parse_docs_queries.asof_now_join(
+            all_metas, how="left", id=parse_docs_queries.id
+        ).select(
+            result=apply_with_type(fmt, dt.JSON, all_metas.metadatas, mf)
+        )
+        return joined
 
 
 class DocumentStoreClient:

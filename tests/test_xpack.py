@@ -140,3 +140,57 @@ def test_mcp_server_protocol():
          "params": {"name": "echo", "arguments": {"x": 1}}}
     )
     assert "echo" in call["result"]["content"][0]["text"]
+
+
+def test_slides_document_store_parsed_documents_query():
+    """SlidesDocumentStore.parsed_documents_query: metadata after parsing,
+    excluded fields stripped, jmespath filtering applied."""
+    import pathway_tpu as pw
+    from pathway_tpu.debug import table_from_rows
+    from pathway_tpu.engine.runner import run_tables
+    from pathway_tpu.internals import parse_graph as pg
+    from pathway_tpu.internals.value import Json
+    from pathway_tpu.xpacks.llm.document_store import SlidesDocumentStore
+    from pathway_tpu.stdlib.indexing import BruteForceKnnFactory
+
+    pg.G.clear()
+
+    class DS(pw.Schema):
+        data: str
+        _metadata: object
+
+    docs = table_from_rows(DS, [
+        ("slide one", Json({"page": 1, "b64_image": "HUGE", "deck": "a"})),
+        ("slide two", Json({"page": 2, "b64_image": "HUGE", "deck": "b"})),
+    ])
+
+    class _Emb:
+        def get_embedding_dimension(self):
+            return 8
+
+        def __call__(self, col):
+            import numpy as np
+
+            from pathway_tpu.internals import dtype as dt
+            from pathway_tpu.internals.expression import ApplyExpression
+
+            return ApplyExpression(
+                lambda t: np.ones(8, np.float32), dt.ANY_ARRAY, (col,), {}
+            )
+
+    store = SlidesDocumentStore(
+        docs,
+        retriever_factory=BruteForceKnnFactory(dimensions=8, embedder=_Emb()),
+    )
+
+    class QS(pw.Schema):
+        metadata_filter: str
+
+    q = table_from_rows(QS, [("page == `1`",)])
+    res = store.parsed_documents_query(q)
+    [cap] = run_tables(res)
+    [row] = cap.squash().values()
+    metas = row[0].value
+    assert len(metas) == 1 and metas[0]["page"] == 1
+    assert "b64_image" not in metas[0]  # stripped
+    pg.G.clear()
