@@ -105,8 +105,8 @@ from . import sharepoint  # noqa: E402  (real: Graph REST + OAuth2, no client li
 from . import kinesis  # noqa: E402  (real: SigV4-signed REST, no boto3)
 from . import dynamodb  # noqa: E402  (real: SigV4-signed REST, no boto3)
 from . import bigquery  # noqa: E402  (real: service-account JWT + insertAll)
-iceberg = _make_stub("iceberg", "pyiceberg")
-rabbitmq = _make_stub("rabbitmq", "pika")
+from . import iceberg  # noqa: E402  (real: native v1 format, avro manifests)
+from . import rabbitmq  # noqa: E402  (real: native AMQP 0.9.1 frames)
 redpanda = kafka
 
 # logstash sink: its HTTP input plugin takes plain JSON POSTs

@@ -435,11 +435,18 @@ def add_output_node(table: Table, writer) -> None:
     )
 
 
-def plain_scalar(v):
-    """JSON/transport-safe scalar: passthrough primitives, stringify rest
-    (shared by the sink connectors)."""
+def plain_scalar(v, keep_bytes: bool = False):
+    """JSON/transport-safe scalar: passthrough primitives, unwrap Json,
+    stringify the rest (shared by the sink connectors).  keep_bytes
+    passes bytes through for binary-capable sinks (parquet)."""
     if isinstance(v, (int, float, str, bool, type(None))):
         return v
+    if keep_bytes and isinstance(v, (bytes, bytearray)):
+        return bytes(v)
     if isinstance(v, Json):
         return v.value
+    import numpy as np
+
+    if isinstance(v, np.generic):
+        return v.item()
     return str(v)

@@ -39,7 +39,7 @@ from ..internals.schema import SchemaMetaclass
 from ..internals.table import Table
 from ..internals.value import ref_scalar
 from ..engine.types import unwrap_row
-from ._utils import coerce_value, make_input_table
+from ._utils import coerce_value, make_input_table, plain_scalar
 
 _LOG_DIR = "_delta_log"
 
@@ -144,7 +144,7 @@ class DeltaWriter:
         for _key, row, diff in updates:
             vals = unwrap_row(row)
             for c, v in zip(self.colnames, vals):
-                cols[c].append(_plain(v))
+                cols[c].append(plain_scalar(v, keep_bytes=True))
             cols["time"].append(time_)
             cols["diff"].append(diff)
         table = pa.table(cols)
@@ -175,14 +175,6 @@ class DeltaWriter:
         pass
 
 
-def _plain(v):
-    if isinstance(v, (int, float, str, bytes, bool, type(None))):
-        return v
-    import numpy as np
-
-    if isinstance(v, np.generic):
-        return v.item()
-    return str(v)
 
 
 def write(table: Table, uri: str, *,

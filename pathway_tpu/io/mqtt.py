@@ -22,7 +22,6 @@ from ..internals.compat import schema_builder
 from ..internals.datasource import SubjectDataSource
 from ..internals.schema import ColumnDefinition, SchemaMetaclass
 from ..internals.table import Table
-from ..internals.value import Json
 from ._utils import coerce_value, make_input_table, plain_scalar
 
 _log = logging.getLogger("pathway_tpu.io.mqtt")

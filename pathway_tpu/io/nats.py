@@ -23,7 +23,6 @@ from ..internals import parse_graph as pg
 from ..internals.datasource import SubjectDataSource
 from ..internals.schema import ColumnDefinition, SchemaMetaclass
 from ..internals.table import Table
-from ..internals.value import Json
 from ..internals.compat import schema_builder
 from ._utils import coerce_value, make_input_table, plain_scalar
 
