@@ -1,10 +1,11 @@
 """I/O connectors (reference: python/pathway/io/, 43 modules, io/__init__.py:4-46).
 
-Implemented natively: fs, csv, jsonlines, python (ConnectorSubject), kafka,
-http (REST server), plaintext, debug helpers, subscribe.  The long tail of
-system connectors (databases, lakes, queues, vector stores) shares the same
-Reader/Writer seam and is stubbed with an informative error until its client
-library is wired in.
+Every reference io module is implemented as real code.  Protocol-native
+where the reference links a client crate (kafka wire protocol, AMQP, MQTT,
+NATS, ILP, SigV4 REST, Graph REST, Delta/Iceberg table formats, vector-DB
+REST APIs); DB-API/client seams with injectable fakes where a driver is
+genuinely external (postgres, mysql, mssql, duckdb); object-injection
+contracts where the reference takes a client object (pubsub, pyfilesystem).
 """
 
 from __future__ import annotations
@@ -30,21 +31,6 @@ def _plaintext_read(path: str, *, mode: str = "streaming", **kwargs):
 
 plaintext.read = _plaintext_read
 sys.modules["pathway_tpu.io.plaintext"] = plaintext
-
-
-def _make_stub(name: str, needs: str) -> types.ModuleType:
-    mod = types.ModuleType(f"pathway_tpu.io.{name}")
-
-    def _raise(*args: Any, **kwargs: Any):
-        raise NotImplementedError(
-            f"pw.io.{name} requires {needs}; this connector is stubbed in this "
-            "build — use fs/csv/jsonlines/kafka/python/http or add the client"
-        )
-
-    mod.read = _raise
-    mod.write = _raise
-    sys.modules[f"pathway_tpu.io.{name}"] = mod
-    return mod
 
 
 # s3-compatible aliases (reference: io/s3_csv, io/minio)
@@ -102,6 +88,14 @@ chroma.write = vector_writers.write_chroma
 sys.modules["pathway_tpu.io.chroma"] = chroma
 
 from . import sharepoint  # noqa: E402  (real: Graph REST + OAuth2, no client lib)
+from . import weaviate  # noqa: E402  (real: REST /v1/objects + /v1/batch)
+from . import milvus  # noqa: E402  (real: RESTful v2 entities API)
+from . import leann  # noqa: E402  (real: snapshot-rebuild index sink)
+from . import slack  # noqa: E402  (real: chat.postMessage REST)
+from . import pubsub  # noqa: E402  (real: injected PublisherClient contract)
+from . import duckdb  # noqa: E402  (real: DB-API seam, duckdb pkg or injected)
+from . import mssql  # noqa: E402  (real: CDC/LSN polling + T-SQL writers)
+from . import pyfilesystem  # noqa: E402  (real: duck-typed FS walker)
 from . import kinesis  # noqa: E402  (real: SigV4-signed REST, no boto3)
 from . import dynamodb  # noqa: E402  (real: SigV4-signed REST, no boto3)
 from . import bigquery  # noqa: E402  (real: service-account JWT + insertAll)
@@ -154,4 +148,6 @@ __all__ = [
     "iceberg", "nats", "mqtt", "rabbitmq", "kinesis", "dynamodb", "bigquery",
     "redpanda", "airbyte", "debezium", "null", "sharepoint",
     "clickhouse", "questdb", "pinecone", "qdrant", "chroma",
+    "weaviate", "milvus", "leann", "slack", "pubsub", "duckdb", "mssql",
+    "pyfilesystem", "sqlite", "logstash",
 ]

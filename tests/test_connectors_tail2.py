@@ -1,0 +1,612 @@
+"""The final 8 reference io modules as real code: weaviate, milvus, leann,
+slack, pubsub, duckdb, mssql (CDC/LSN), pyfilesystem."""
+
+import datetime
+import json
+import sqlite3
+import threading
+import time
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.internals import parse_graph as pg
+
+
+class S(pw.Schema):
+    name: str = pw.column_definition(primary_key=True)
+    age: int
+
+
+def _md(t):
+    return pw.debug.table_from_markdown(t)
+
+
+TWO_ROWS = """
+name | age
+alice | 30
+bob | 41
+"""
+
+
+def _run():
+    pw.run(monitoring_level=pw.MonitoringLevel.NONE)
+
+
+# ---------------------------------------------------------------------------
+# weaviate
+
+
+def test_weaviate_write_upsert_delete():
+    pg.G.clear()
+    calls = []
+
+    def fake_http(method, url, payload, headers):
+        calls.append((method, url, payload))
+        return {}
+
+    t = _md(TWO_ROWS)
+    pw.io.weaviate.write(
+        t, "Docs", primary_key=t.name, vector=None,
+        api_key="k", _http=fake_http,
+    )
+    _run()
+    posts = [c for c in calls if c[0] == "POST"]
+    assert len(posts) == 1
+    objs = posts[0][2]["objects"]
+    assert {o["properties"]["age"] for o in objs} == {30, 41}
+    assert all(o["class"] == "Docs" for o in objs)
+    # the pk column derives the UUID and is not stored as a property
+    assert all("name" not in o["properties"] for o in objs)
+    from pathway_tpu.io.weaviate import _uuid_for
+
+    assert {o["id"] for o in objs} \
+        == {_uuid_for("alice"), _uuid_for("bob")}
+
+
+def test_weaviate_vector_column():
+    pg.G.clear()
+    calls = []
+
+    def fake_http(method, url, payload, headers):
+        calls.append((method, url, payload))
+        return {}
+
+    t = _md("""
+    name | x | y
+    a | 1.0 | 2.0
+    """)
+    t = t.select(pw.this.name, vec=pw.apply(lambda x, y: [x, y], pw.this.x, pw.this.y))
+    pw.io.weaviate.write(t, "Vecs", primary_key=t.name, vector=t.vec,
+                         _http=fake_http)
+    _run()
+    obj = [c for c in calls if c[0] == "POST"][0][2]["objects"][0]
+    assert obj["vector"] == [1.0, 2.0]
+    assert "vec" not in obj["properties"]
+
+
+# ---------------------------------------------------------------------------
+# milvus
+
+
+def test_milvus_upsert_and_delete_order():
+    pg.G.clear()
+    calls = []
+
+    def fake_http(method, url, payload, headers):
+        calls.append((url.rsplit("/", 1)[-1], payload))
+        return {"code": 0}
+
+    t = _md(TWO_ROWS)
+    pw.io.milvus.write(t, "http://milvus:19530", "docs",
+                       primary_key=t.name, _http=fake_http)
+    _run()
+    ups = [p for op, p in calls if op == "upsert"]
+    assert len(ups) == 1 and len(ups[0]["data"]) == 2
+    assert ups[0]["collectionName"] == "docs"
+
+    # pk from another table is rejected
+    pg.G.clear()
+    t2 = _md(TWO_ROWS)
+    other = _md("""
+    z
+    1
+    """)
+    with pytest.raises(ValueError):
+        pw.io.milvus.write(t2, "http://x", "c", primary_key=other.z)
+
+
+def test_milvus_error_surfaces():
+    pg.G.clear()
+
+    def fake_http(method, url, payload, headers):
+        return {"code": 1100, "message": "collection not found"}
+
+    t = _md(TWO_ROWS)
+    pw.io.milvus.write(t, "http://x", "missing", primary_key=t.name,
+                       _http=fake_http)
+    with pytest.raises(Exception, match="collection not found"):
+        _run()
+
+
+# ---------------------------------------------------------------------------
+# leann (native fallback index)
+
+
+def test_leann_write_and_native_search(tmp_path):
+    pg.G.clear()
+    t = _md("""
+    text | topic
+    the quick brown fox | animals
+    jax compiles to xla | tpu
+    """)
+    prefix = tmp_path / "articles.leann"
+    pw.io.leann.write(t, prefix, t.text, metadata_columns=[t.topic])
+    _run()
+    meta = json.loads((tmp_path / "articles.leann.meta.json").read_text())
+    assert meta["num_documents"] == 2
+    loaded = pw.io.leann.load_native_index(prefix)
+    hits = loaded["index"].search("fox", k=1)
+    assert len(hits) == 1
+    assert loaded["documents"][hits[0][0]]["metadata"]["topic"] == "animals"
+
+
+def test_leann_rejects_non_str_and_skips_empty(tmp_path):
+    pg.G.clear()
+    t = _md(TWO_ROWS)
+    with pytest.raises(ValueError, match="must be of type str"):
+        pw.io.leann.write(t, tmp_path / "i", t.age)
+
+    pg.G.clear()
+    t2 = _md("""
+    text
+    hello
+    """)
+    t2 = t2.select(text=pw.apply_with_type(
+        lambda s: "" if s == "hello" else s, str, pw.this.text))
+    pw.io.leann.write(t2, tmp_path / "empty.leann", t2.text)
+    _run()
+    # the only row was empty -> skipped, no index files written
+    assert not (tmp_path / "empty.leann.meta.json").exists()
+
+
+# ---------------------------------------------------------------------------
+# slack
+
+
+def test_slack_send_alerts():
+    pg.G.clear()
+    posted = []
+
+    def fake_http(url, payload, headers):
+        posted.append((url, payload, headers))
+        return {"ok": True}
+
+    t = _md("""
+    msg
+    deploy_failed
+    """)
+    pw.io.slack.send_alerts(t.msg, "C012345", "xoxb-token", _http=fake_http)
+    _run()
+    assert len(posted) == 1
+    url, payload, headers = posted[0]
+    assert "chat.postMessage" in url
+    assert payload == {"channel": "C012345", "text": "deploy_failed"}
+    assert headers["Authorization"] == "Bearer xoxb-token"
+
+
+# ---------------------------------------------------------------------------
+# pubsub
+
+
+class _FakePublisher:
+    def __init__(self):
+        self.messages = []
+
+    def topic_path(self, project, topic):
+        return f"projects/{project}/topics/{topic}"
+
+    def publish(self, topic, data, **attrs):
+        self.messages.append((topic, data, attrs))
+
+        class _F:
+            def done(self):
+                return True
+
+            def result(self, timeout=None):
+                return "id"
+
+        return _F()
+
+
+def test_pubsub_write():
+    pg.G.clear()
+    pub = _FakePublisher()
+    t = _md("""
+    payload
+    hello
+    """)
+    pw.io.pubsub.write(t, pub, "proj", "blobs")
+    _run()
+    assert len(pub.messages) == 1
+    topic, data, attrs = pub.messages[0]
+    assert topic == "projects/proj/topics/blobs"
+    assert data == b"hello"
+    assert attrs["pathway_diff"] == "1"
+
+    # multi-column tables are rejected
+    pg.G.clear()
+    with pytest.raises(ValueError, match="single binary column"):
+        pw.io.pubsub.write(_md(TWO_ROWS), pub, "p", "t")
+
+
+# ---------------------------------------------------------------------------
+# duckdb (sqlite shares the ?-placeholder + ON CONFLICT dialect)
+
+
+def test_duckdb_stream_of_changes():
+    pg.G.clear()
+    conn = sqlite3.connect(":memory:", check_same_thread=False)
+    t = _md(TWO_ROWS)
+    pw.io.duckdb.write(
+        t, table_name="changes", database=":memory:",
+        init_mode="create_if_not_exists", _connection=conn,
+    )
+    _run()
+    rows = conn.execute(
+        "SELECT name, age, diff FROM changes ORDER BY name").fetchall()
+    assert rows == [("alice", 30, 1), ("bob", 41, 1)]
+
+
+def test_duckdb_snapshot_upsert_delete():
+    pg.G.clear()
+    conn = sqlite3.connect(":memory:", check_same_thread=False)
+    t = _md(TWO_ROWS)
+    pw.io.duckdb.write(
+        t, table_name="snap", database=":memory:",
+        output_table_type="snapshot", primary_key=[t.name],
+        init_mode="create_if_not_exists", _connection=conn,
+    )
+    _run()
+    assert sorted(conn.execute("SELECT name, age FROM snap").fetchall()) \
+        == [("alice", 30), ("bob", 41)]
+
+
+def test_duckdb_validation():
+    pg.G.clear()
+    t = _md(TWO_ROWS)
+    with pytest.raises(ValueError, match="requires\\s+primary_key"):
+        pw.io.duckdb.write(t, table_name="x", database=":memory:",
+                           output_table_type="snapshot")
+    with pytest.raises(ValueError, match="snapshot"):
+        pw.io.duckdb.write(t, table_name="x", database=":memory:",
+                           primary_key=[t.name])
+    # default mode against a missing table fails with a clear error
+    pg.G.clear()
+    conn = sqlite3.connect(":memory:", check_same_thread=False)
+    t2 = _md(TWO_ROWS)
+    pw.io.duckdb.write(t2, table_name="absent", database=":memory:",
+                       _connection=conn)
+    with pytest.raises(Exception, match="does not exist"):
+        _run()
+
+
+# ---------------------------------------------------------------------------
+# mssql: fake DB-API connection emulating the CDC surface
+
+
+class _FakeMssql:
+    """Emulates the table + cdc.fn_cdc_get_all_changes_* query surface."""
+
+    def __init__(self):
+        self.rows = {}           # pk -> (name, age)
+        self.changes = []        # (lsn, op, name, age)
+        self._lsn = 0
+        self.cdc_enabled = True        # database-level CDC
+        self.table_cdc_enabled = True  # table-level capture instance
+
+    def commit_row(self, name, age):
+        self._lsn += 1
+        if name in self.rows:
+            old = self.rows[name]
+            self.changes.append((self._lsn, 3, *old))
+            self.changes.append((self._lsn, 4, name, age))
+        else:
+            self.changes.append((self._lsn, 2, name, age))
+        self.rows[name] = (name, age)
+
+    def delete_row(self, name):
+        if name not in self.rows:
+            return
+        self._lsn += 1
+        self.changes.append((self._lsn, 1, *self.rows.pop(name)))
+
+    def rename_row(self, old_name, new_name):
+        """UPDATE that changes the primary-key column: CDC emits the
+        before-image under the old key, the after-image under the new."""
+        self._lsn += 1
+        old = self.rows.pop(old_name)
+        new = (new_name, old[1])
+        self.changes.append((self._lsn, 3, *old))
+        self.changes.append((self._lsn, 4, *new))
+        self.rows[new_name] = new
+
+    def cursor(self):
+        return _FakeMssqlCursor(self)
+
+    def close(self):
+        pass
+
+
+class _FakeMssqlCursor:
+    def __init__(self, db):
+        self.db = db
+        self._result = []
+        self.description = None
+        self.rowcount = -1
+
+    def execute(self, sql, params=()):
+        q = " ".join(sql.split())
+        if "FROM cdc.change_tables" in q:
+            if not self.db.cdc_enabled:
+                raise RuntimeError("Invalid object name 'cdc.change_tables'")
+            self._result = [("dbo_people",)] if self.db.table_cdc_enabled \
+                else []
+        elif "fn_cdc_get_max_lsn" in q:
+            self._result = [(self.db._lsn.to_bytes(10, "big")
+                             if self.db._lsn else None,)]
+        elif "fn_cdc_get_min_lsn" in q:
+            self._result = [((1).to_bytes(10, "big"),)]
+        elif "fn_cdc_increment_lsn" in q:
+            cur = int.from_bytes(params[0], "big")
+            self._result = [((cur + 1).to_bytes(10, "big"),)]
+        elif "fn_cdc_get_all_changes_dbo_people" in q:
+            lo = int.from_bytes(params[0], "big")
+            hi = int.from_bytes(params[1], "big")
+            self._result = [
+                (op, name, age)
+                for lsn, op, name, age in self.db.changes
+                if lo <= lsn <= hi
+            ]
+        elif q.startswith("SELECT [name], [age] FROM"):
+            self._result = [v for v in self.db.rows.values()]
+        else:
+            raise AssertionError(f"unexpected SQL: {q}")
+
+    def fetchall(self):
+        return list(self._result)
+
+    def fetchone(self):
+        return self._result[0] if self._result else None
+
+
+class PeopleSchema(pw.Schema):
+    name: str = pw.column_definition(primary_key=True)
+    age: int
+
+
+def test_mssql_snapshot_then_cdc_stream():
+    pg.G.clear()
+    db = _FakeMssql()
+    db.commit_row("alice", 30)
+    db.commit_row("bob", 41)
+    events = []
+    t = pw.io.mssql.read(
+        {"_connection": db}, "people", PeopleSchema,
+        autocommit_duration_ms=50,
+    )
+    pw.io.subscribe(
+        t, on_change=lambda key, row, time, is_addition:
+        events.append((row["name"], row["age"], is_addition)))
+
+    def mutate():
+        time.sleep(0.4)
+        db.commit_row("alice", 31)     # update
+        db.commit_row("carol", 22)     # insert
+        db.delete_row("bob")           # delete
+
+    th = threading.Thread(target=mutate)
+    th.start()
+    pw.run(timeout_s=2.0, autocommit_duration_ms=50,
+           monitoring_level=pw.MonitoringLevel.NONE)
+    th.join()
+    assert ("alice", 30, True) in events
+    assert ("alice", 30, False) in events and ("alice", 31, True) in events
+    assert ("carol", 22, True) in events
+    assert ("bob", 41, False) in events
+
+
+def test_mssql_pk_change_update_retracts_old_key():
+    pg.G.clear()
+    db = _FakeMssql()
+    db.commit_row("alice", 30)
+    events = []
+    t = pw.io.mssql.read({"_connection": db}, "people", PeopleSchema,
+                         autocommit_duration_ms=50)
+    pw.io.subscribe(
+        t, on_change=lambda key, row, time, is_addition:
+        events.append((row["name"], is_addition)))
+
+    def mutate():
+        time.sleep(0.4)
+        db.rename_row("alice", "alicia")
+
+    th = threading.Thread(target=mutate)
+    th.start()
+    pw.run(timeout_s=2.0, autocommit_duration_ms=50,
+           monitoring_level=pw.MonitoringLevel.NONE)
+    th.join()
+    assert ("alice", False) in events, events     # old key retracted
+    assert ("alicia", True) in events, events     # new key inserted
+
+
+def test_mssql_requires_table_level_cdc():
+    pg.G.clear()
+    db = _FakeMssql()
+    db.table_cdc_enabled = False
+    db.commit_row("alice", 30)
+    t = pw.io.mssql.read({"_connection": db}, "people", PeopleSchema,
+                         autocommit_duration_ms=50)
+    pw.io.subscribe(t, on_change=lambda *a: None)
+    with pytest.raises(Exception, match="sp_cdc_enable_table"):
+        pw.run(timeout_s=1.0, autocommit_duration_ms=50,
+               monitoring_level=pw.MonitoringLevel.NONE)
+
+
+def test_mssql_requires_cdc_in_streaming_mode():
+    pg.G.clear()
+    db = _FakeMssql()
+    db.cdc_enabled = False
+    db.commit_row("alice", 30)
+    t = pw.io.mssql.read({"_connection": db}, "people", PeopleSchema,
+                         autocommit_duration_ms=50)
+    pw.io.subscribe(t, on_change=lambda *a: None)
+    with pytest.raises(Exception, match="sp_cdc_enable_table"):
+        pw.run(timeout_s=1.0, autocommit_duration_ms=50,
+               monitoring_level=pw.MonitoringLevel.NONE)
+
+
+def test_mssql_static_mode_and_writers():
+    pg.G.clear()
+    db = _FakeMssql()
+    db.commit_row("alice", 30)
+    t = pw.io.mssql.read({"_connection": db}, "people", PeopleSchema,
+                         mode="static")
+    got = []
+    pw.io.subscribe(t, on_change=lambda key, row, time, is_addition:
+                    got.append((row["name"], row["age"])))
+    _run()
+    assert got == [("alice", 30)]
+
+    with pytest.raises(ValueError, match="identifier"):
+        pw.io.mssql.read({}, "people; DROP TABLE x", PeopleSchema)
+
+
+# ---------------------------------------------------------------------------
+# pyfilesystem: duck-typed fake FS
+
+
+class _FakeFS:
+    def __init__(self):
+        self.files = {}   # path -> (bytes, mtime)
+
+    def put(self, path, data, mtime=1000):
+        self.files[path] = (data, mtime)
+
+    def listdir(self, p):
+        p = p.rstrip("/") or ""
+        names = set()
+        for path in self.files:
+            if path.startswith(p + "/") or (not p and path.startswith("/")):
+                rest = path[len(p) + 1:]
+                names.add(rest.split("/")[0])
+        return sorted(names)
+
+    def isdir(self, p):
+        p = p.rstrip("/")
+        return any(f.startswith(p + "/") for f in self.files)
+
+    def getinfo(self, path, namespaces=None):
+        data, mtime = self.files[path]
+
+        class _Info:
+            name = path.rsplit("/", 1)[-1]
+            size = len(data)
+            modified = datetime.datetime.fromtimestamp(mtime)
+            created = None
+            user = "tester"
+
+        return _Info()
+
+    def readbytes(self, path):
+        return self.files[path][0]
+
+
+def test_pyfilesystem_static_binary_with_metadata():
+    pg.G.clear()
+    fs = _FakeFS()
+    fs.put("/docs/a.txt", b"alpha")
+    fs.put("/docs/sub/b.txt", b"beta")
+    t = pw.io.pyfilesystem.read(fs, path="", mode="static",
+                                with_metadata=True)
+    got = []
+    pw.io.subscribe(t, on_change=lambda key, row, time, is_addition:
+                    got.append((bytes(row["data"]),
+                                row["_metadata"].value["name"])))
+    _run()
+    assert sorted(got) == [(b"alpha", "a.txt"), (b"beta", "b.txt")]
+
+
+def test_pyfilesystem_streaming_add_modify_delete():
+    pg.G.clear()
+    fs = _FakeFS()
+    fs.put("/a.bin", b"v1", mtime=1)
+    events = []
+    t = pw.io.pyfilesystem.read(fs, refresh_interval=0.05)
+    pw.io.subscribe(t, on_change=lambda key, row, time, is_addition:
+                    events.append((bytes(row["data"]), is_addition)))
+
+    def mutate():
+        time.sleep(0.3)
+        fs.put("/a.bin", b"v2", mtime=2)      # modify
+        fs.put("/b.bin", b"new", mtime=2)     # add
+        time.sleep(0.3)
+        del fs.files["/b.bin"]                # delete
+
+    th = threading.Thread(target=mutate)
+    th.start()
+    pw.run(timeout_s=2.0, autocommit_duration_ms=50,
+           monitoring_level=pw.MonitoringLevel.NONE)
+    th.join()
+    assert (b"v1", True) in events
+    assert (b"v1", False) in events and (b"v2", True) in events
+    assert (b"new", True) in events and (b"new", False) in events
+
+
+def test_pyfilesystem_failed_scan_loses_nothing():
+    """A transient error mid-scan must not swallow an already-diffed
+    modification (scan state commits only on full success)."""
+    from pathway_tpu.io.pyfilesystem import PyFilesystemSource
+
+    fs = _FakeFS()
+    fs.put("/a.bin", b"v1", mtime=1)
+    fs.put("/b.bin", b"x", mtime=1)
+    src = PyFilesystemSource(fs, "", format="binary", with_metadata=False,
+                             refresh_interval_s=0.0, mode="streaming")
+    assert len(src.poll()) == 2     # initial adds
+    fs.put("/a.bin", b"v2", mtime=2)
+    # fail on b's getinfo: the walk visits a first, diffs its
+    # modification, then hits the error mid-scan
+    orig_info = fs.getinfo
+    fs.getinfo = lambda p, namespaces=None: (_ for _ in ()).throw(
+        OSError("net")) if p == "/b.bin" else orig_info(p, namespaces)
+    assert src.poll() == []         # scan failed, nothing emitted
+    fs.getinfo = orig_info
+    events = src.poll()             # retry sees the modification
+    assert any(bytes(row[0]) == b"v2" and d == 1 for _t, _k, row, d in events)
+    assert any(bytes(row[0]) == b"v1" and d == -1 for _t, _k, row, d in events)
+
+
+def test_weaviate_foreign_pk_rejected():
+    pg.G.clear()
+    t = _md(TWO_ROWS)
+    other = _md("""
+    z
+    1
+    """)
+    with pytest.raises(ValueError, match="does not belong"):
+        pw.io.weaviate.write(t, "Docs", primary_key=other.z,
+                             _http=lambda *a: {})
+
+
+def test_pyfilesystem_only_metadata():
+    pg.G.clear()
+    fs = _FakeFS()
+    fs.put("/x.dat", b"12345")
+    t = pw.io.pyfilesystem.read(fs, mode="static", format="only_metadata")
+    got = []
+    pw.io.subscribe(t, on_change=lambda key, row, time, is_addition:
+                    got.append(row["_metadata"].value))
+    _run()
+    assert got[0]["size"] == 5 and got[0]["owner"] == "tester"
+    assert "data" not in t.column_names()
