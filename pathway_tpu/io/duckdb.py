@@ -131,46 +131,52 @@ class _DuckDBWriter:
         rows = [(key, tuple(plain_scalar(v, keep_bytes=True)
                             for v in unwrap_row(row)), diff)
                 for key, row, diff in updates]
-        if self.max_batch_size:
-            chunks = [rows[i:i + self.max_batch_size]
-                      for i in range(0, len(rows), self.max_batch_size)]
-        else:
-            chunks = [rows]
-        for chunk in chunks:
-            if not self.snapshot:
-                sql = (
-                    f"INSERT INTO {tbl} ({', '.join(qcols)}, time, diff) "
-                    f"VALUES ({', '.join(['?'] * (len(qcols) + 2))})"
-                )
+
+        def chunked(seq):
+            if not self.max_batch_size:
+                return [seq]
+            return [seq[i:i + self.max_batch_size]
+                    for i in range(0, len(seq), self.max_batch_size)]
+
+        if not self.snapshot:
+            sql = (
+                f"INSERT INTO {tbl} ({', '.join(qcols)}, time, diff) "
+                f"VALUES ({', '.join(['?'] * (len(qcols) + 2))})"
+            )
+            for chunk in chunked(rows):
                 cur.executemany(
                     sql, [vals + (time_, diff) for _k, vals, diff in chunk]
                 )
-            else:
-                pk_q = [_q(c) for c in self.primary_key]
-                pk_idx = [colnames.index(c) for c in self.primary_key]
-                non_pk = [c for c in colnames if c not in self.primary_key]
-                set_clause = ", ".join(
-                    f"{_q(c)} = EXCLUDED.{_q(c)}" for c in non_pk
-                ) or f"{pk_q[0]} = {pk_q[0]}"
-                upsert = (
-                    f"INSERT INTO {tbl} ({', '.join(qcols)}) "
-                    f"VALUES ({', '.join(['?'] * len(qcols))}) "
-                    f"ON CONFLICT ({', '.join(pk_q)}) DO UPDATE "
-                    f"SET {set_clause}"
-                )
-                delete = (
-                    f"DELETE FROM {tbl} WHERE "
-                    + " AND ".join(f"{q} = ?" for q in pk_q)
-                )
-                # deletes before upserts so retract+insert is an update
-                for _k, vals, diff in chunk:
-                    if diff < 0:
-                        cur.execute(delete,
-                                    tuple(vals[i] for i in pk_idx))
-                for _k, vals, diff in chunk:
-                    if diff > 0:
-                        cur.execute(upsert, vals)
-            conn.commit()
+                conn.commit()
+        else:
+            pk_q = [_q(c) for c in self.primary_key]
+            pk_idx = [colnames.index(c) for c in self.primary_key]
+            non_pk = [c for c in colnames if c not in self.primary_key]
+            set_clause = ", ".join(
+                f"{_q(c)} = EXCLUDED.{_q(c)}" for c in non_pk
+            ) or f"{pk_q[0]} = {pk_q[0]}"
+            upsert = (
+                f"INSERT INTO {tbl} ({', '.join(qcols)}) "
+                f"VALUES ({', '.join(['?'] * len(qcols))}) "
+                f"ON CONFLICT ({', '.join(pk_q)}) DO UPDATE "
+                f"SET {set_clause}"
+            )
+            delete = (
+                f"DELETE FROM {tbl} WHERE "
+                + " AND ".join(f"{q} = ?" for q in pk_q)
+            )
+            # ALL deletes before ANY upsert — an update pair split across
+            # size chunks must never end with its key deleted
+            deletes = [r for r in rows if r[2] < 0]
+            upserts = [r for r in rows if r[2] > 0]
+            for chunk in chunked(deletes):
+                for _k, vals, _d in chunk:
+                    cur.execute(delete, tuple(vals[i] for i in pk_idx))
+                conn.commit()
+            for chunk in chunked(upserts):
+                for _k, vals, _d in chunk:
+                    cur.execute(upsert, vals)
+                conn.commit()
         if self.detach_between_batches and self._injected is None:
             try:
                 conn.close()

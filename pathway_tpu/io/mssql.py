@@ -47,16 +47,12 @@ def _connect(settings):
         import pyodbc  # type: ignore
 
         return pyodbc.connect(conn_str)
-    except ImportError:
-        pass
-    try:
-        import pymssql  # type: ignore
-
-        return pymssql.connect(conn_str)
     except ImportError as exc:
+        # only pyodbc: this module speaks qmark paramstyle throughout,
+        # which pymssql (pyformat) cannot execute
         raise ImportError(
-            "pw.io.mssql requires pyodbc or pymssql (or an injected "
-            "_connection for tests)"
+            "pw.io.mssql requires pyodbc (or an injected _connection "
+            "for tests)"
         ) from exc
 
 
@@ -81,6 +77,11 @@ class MssqlCdcSource(DataSource):
         self.mode = mode
         self.poll_interval_s = poll_interval_s
         self.capture_instance = f"{schema_name}_{table_name}"
+        # schema-derived structures hoisted off the per-row hot path
+        self._colnames = schema.column_names()
+        self._dtypes = schema.dtypes()
+        self._pk_idx = [self._colnames.index(c)
+                        for c in schema.primary_key_columns()]
         self._conn = None
         self._lsn = None  # bytes: last processed LSN
         self._snapshot_done = False
@@ -113,12 +114,11 @@ class MssqlCdcSource(DataSource):
         return self._conn.cursor()
 
     def _key_row(self, raw: tuple):
-        colnames = self.schema.column_names()
-        dtypes = self.schema.dtypes()
-        pk = self.schema.primary_key_columns()
-        d = dict(zip(colnames, raw))
-        row = tuple(coerce_value(d[c], dtypes[c]) for c in colnames)
-        key = ref_scalar(*[d[c] for c in pk])
+        row = tuple(
+            coerce_value(v, self._dtypes[c])
+            for v, c in zip(raw, self._colnames)
+        )
+        key = ref_scalar(*[raw[i] for i in self._pk_idx])
         return key, row
 
     def _apply_upsert(self, key, row) -> list:
@@ -204,7 +204,8 @@ class MssqlCdcSource(DataSource):
             "SELECT __$operation, "
             + ", ".join(_q(c) for c in colnames)
             + f" FROM cdc.fn_cdc_get_all_changes_{self.capture_instance}"
-            "(?, ?, N'all update old') ORDER BY __$start_lsn, __$seqval",
+            "(?, ?, N'all update old') "
+            "ORDER BY __$start_lsn, __$seqval, __$operation",
             (from_lsn, to_lsn),
         )
         events = []

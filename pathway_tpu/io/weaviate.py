@@ -11,6 +11,7 @@ an update to the same key overwrites in place.
 
 from __future__ import annotations
 
+import urllib.error
 import uuid
 from typing import Any, Iterable
 
@@ -69,16 +70,30 @@ class _WeaviateWriter:
         # deletes first so an update (retract+insert of one key) lands as
         # the new object
         for oid in deletes:
-            self._http(
-                "DELETE",
-                f"{self.base_url}/v1/objects/{self.collection}/{oid}",
-                None, self.headers,
-            )
+            try:
+                self._http(
+                    "DELETE",
+                    f"{self.base_url}/v1/objects/{self.collection}/{oid}",
+                    None, self.headers,
+                )
+            except urllib.error.HTTPError as exc:
+                if exc.code != 404:  # already absent: retraction is a no-op
+                    raise
         for i in range(0, len(upserts), self.batch_size):
-            self._http(
+            resp = self._http(
                 "POST", f"{self.base_url}/v1/batch/objects",
                 {"objects": upserts[i:i + self.batch_size]}, self.headers,
             )
+            # weaviate reports per-object failures inside a 200 body
+            if isinstance(resp, list):
+                for obj in resp:
+                    errors = (obj.get("result", {}) or {}).get(
+                        "errors") if isinstance(obj, dict) else None
+                    if errors:
+                        raise RuntimeError(
+                            f"weaviate batch insert failed for "
+                            f"{obj.get('id')}: {errors}"
+                        )
 
     def close(self) -> None:
         pass

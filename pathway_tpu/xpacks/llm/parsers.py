@@ -253,11 +253,41 @@ class SlideParser(ImageParser):
 
 
 class PaddleOCRParser(ParserBase):
+    """OCR parser (reference PaddleOCR wrapper, parsers.py:55-1170).
+
+    Photographic/scene OCR uses the paddleocr package when installed;
+    otherwise the native template-correlation engine (`_ocr.py`) reads
+    machine-printed text (screenshots, rendered documents, terminal
+    captures) with zero dependencies beyond pillow."""
+
     def __init__(self, **kwargs):
-        pass
+        self.kwargs = kwargs
+        self._paddle = None
+        try:
+            from paddleocr import PaddleOCR  # type: ignore
+
+            self._paddle = PaddleOCR(**kwargs)
+        except ImportError:
+            pass
 
     def _parse(self, contents):
-        raise ImportError("PaddleOCRParser requires paddleocr")
+        if self._paddle is not None:
+            result = self._paddle.ocr(contents)
+            lines: list[str] = []
+            for page in result or []:
+                if page is None:
+                    continue
+                if isinstance(page, dict) or hasattr(page, "get"):
+                    # paddleocr >= 3.x: dict-like OCRResult
+                    lines.extend(page.get("rec_texts") or [])
+                else:
+                    # paddleocr 2.x: [[bbox, (text, confidence)], ...]
+                    lines.extend(entry[1][0] for entry in page)
+            return [("\n".join(lines), {"engine": "paddleocr"})]
+        from ._ocr import ocr_image
+
+        image = _decode_image(contents)
+        return [(ocr_image(image), {"engine": "native-template"})]
 
 
 __all__ = [

@@ -1,0 +1,114 @@
+"""Native OCR engine + PaddleOCRParser fallback path."""
+
+import difflib
+import os
+
+import numpy as np
+import pytest
+
+PIL = pytest.importorskip("PIL")
+from PIL import Image, ImageDraw, ImageFont  # noqa: E402
+
+from pathway_tpu.xpacks.llm._ocr import ocr_image  # noqa: E402
+
+
+def _mono(size=18):
+    import matplotlib
+
+    path = os.path.join(os.path.dirname(matplotlib.__file__),
+                        "mpl-data", "fonts", "ttf", "DejaVuSansMono.ttf")
+    return ImageFont.truetype(path, size)
+
+
+def _render(lines, font, w=900, pad=8, line_h=40, invert=False):
+    im = Image.new("L", (w, pad * 2 + line_h * len(lines)),
+                   0 if invert else 255)
+    d = ImageDraw.Draw(im)
+    for i, ln in enumerate(lines):
+        d.text((pad, pad + i * line_h), ln, fill=255 if invert else 0,
+               font=font)
+    return np.asarray(im)
+
+
+def _sim(a, b):
+    return difflib.SequenceMatcher(None, a, b).ratio()
+
+
+def test_ocr_monospace_round_trip():
+    truth = "Hello World 42: the quick brown fox\njumps over the LAZY dog"
+    out = ocr_image(_render(truth.split("\n"), _mono(18)))
+    assert _sim(out, truth) >= 0.95, out
+
+
+def test_ocr_scale_invariance():
+    truth = "error: connection refused (port 9092)"
+    small = ocr_image(_render([truth], _mono(14)))
+    large = ocr_image(_render([truth], _mono(28), w=1400, line_h=60))
+    assert _sim(small, truth) >= 0.85, small
+    assert _sim(large, truth) >= 0.85, large
+
+
+def test_ocr_light_on_dark():
+    truth = "terminal capture"
+    out = ocr_image(_render([truth], _mono(18), invert=True))
+    assert _sim(out, truth) >= 0.85, out
+
+
+def test_ocr_proportional_font():
+    import matplotlib
+
+    path = os.path.join(os.path.dirname(matplotlib.__file__),
+                        "mpl-data", "fonts", "ttf", "DejaVuSans.ttf")
+    truth = "Hello World 42"
+    out = ocr_image(_render([truth], ImageFont.truetype(path, 20)))
+
+    def fold(s):
+        # 'l', 'I' and '|' are pixel-identical bars in DejaVuSans —
+        # fold the lookalike class before comparing (standard OCR eval)
+        return s.lower().replace("i", "l").replace("|", "l")
+
+    assert _sim(fold(out), fold(truth)) >= 0.85, out
+
+
+def test_ocr_empty_image():
+    assert ocr_image(np.full((40, 200), 255, np.uint8)) == ""
+
+
+def test_paddle_ocr_parser_native_fallback(tmp_path):
+    import io
+
+    from pathway_tpu.xpacks.llm.parsers import PaddleOCRParser
+
+    im = Image.fromarray(_render(["invoice total: 1234"], _mono(20)))
+    buf = io.BytesIO()
+    im.save(buf, format="PNG")
+    parser = PaddleOCRParser()
+    [(text, meta)] = parser._parse(buf.getvalue())
+    assert meta["engine"] == "native-template"
+    assert _sim(text, "invoice total: 1234") >= 0.85, text
+
+
+def test_paddle_ocr_parser_in_pipeline(tmp_path):
+    """OCR as a DocumentStore-style parse step over the engine."""
+    import io
+
+    import pathway_tpu as pw
+    from pathway_tpu.internals import parse_graph as pg
+    from pathway_tpu.xpacks.llm.parsers import PaddleOCRParser
+
+    pg.G.clear()
+    im = Image.fromarray(_render(["hello ocr"], _mono(20)))
+    buf = io.BytesIO()
+    im.save(buf, format="PNG")
+    png = buf.getvalue()
+    (tmp_path / "shot.png").write_bytes(png)
+
+    docs = pw.io.fs.read(str(tmp_path), format="binary", mode="static")
+    parser = PaddleOCRParser()
+    parsed = docs.select(texts=parser(pw.this.data))
+    got = []
+    pw.io.subscribe(parsed, on_change=lambda key, row, time, is_addition:
+                    got.append(row["texts"]))
+    pw.run(monitoring_level=pw.MonitoringLevel.NONE)
+    assert len(got) == 1
+    assert _sim(got[0][0][0], "hello ocr") >= 0.8, got
