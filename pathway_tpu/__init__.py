@@ -190,6 +190,7 @@ Table.show = utils.viz_show
 from .stdlib import viz as _viz
 
 Table.plot = _viz.plot
+Table.live_show = _viz.live_show
 Table.sort = temporal.sort
 
 from .internals import universes  # noqa: E402

@@ -165,18 +165,20 @@ class _DuckDBWriter:
                 f"DELETE FROM {tbl} WHERE "
                 + " AND ".join(f"{q} = ?" for q in pk_q)
             )
-            # ALL deletes before ANY upsert — an update pair split across
-            # size chunks must never end with its key deleted
+            # ALL deletes before ANY upsert (an update pair split across
+            # size chunks must never end with its key deleted), then ONE
+            # commit: readers never observe the between-passes state and a
+            # crash can't drop updated rows (max_batch_size bounds
+            # statement batching, not transaction scope)
             deletes = [r for r in rows if r[2] < 0]
             upserts = [r for r in rows if r[2] > 0]
             for chunk in chunked(deletes):
                 for _k, vals, _d in chunk:
                     cur.execute(delete, tuple(vals[i] for i in pk_idx))
-                conn.commit()
             for chunk in chunked(upserts):
                 for _k, vals, _d in chunk:
                     cur.execute(upsert, vals)
-                conn.commit()
+            conn.commit()
         if self.detach_between_batches and self._injected is None:
             try:
                 conn.close()

@@ -1,11 +1,14 @@
 """Visualization (reference: stdlib/viz — Bokeh/Panel live plots,
 Table.show/plot).
 
-The reference renders live-updating Bokeh/Panel widgets in notebooks; here
-the equivalent is matplotlib (present in this image): `plot()` draws the
-table once in batch mode, and in streaming mode re-renders on every commit
-through a subscriber — writing to a file (headless/CI) or a live pyplot
-window when interactive.  `show()` prints the live table (console).
+Three tiers, all dependency-free beyond what this image ships:
+- `live_show(table)` — the streaming-widget model (reference Panel
+  parity): an HTTP-served page that re-renders the keyed table state and
+  per-column sparklines on every commit; displays as an iframe under
+  IPython (`live.py`).
+- `plot()` — matplotlib live plots: batch draws once, streaming
+  re-renders per commit to a file (headless/CI) or a pyplot window.
+- `show()` — console table print (batch debugging).
 """
 
 from __future__ import annotations
@@ -14,6 +17,7 @@ from typing import Any, Callable
 
 from ...internals.table import Table
 from ..utils import viz_show as show
+from .live import live_show
 
 
 class LivePlotter:
@@ -136,4 +140,4 @@ def plot(
     return plotter
 
 
-__all__ = ["show", "plot", "LivePlotter"]
+__all__ = ["show", "plot", "LivePlotter", "live_show"]

@@ -115,12 +115,12 @@ def _dp_segment(line: np.ndarray, s: int, e: int, baseline: float,
     ink-minima inside runs; the DP picks the segmentation maximizing
     sum(match - _GLYPH_PENALTY) — the per-glyph penalty keeps an 'm'
     from being read as 'rn' unless the split genuinely matches better.
-    Returns [(start, stop, template_idx)]."""
+    Returns [(start, stop, template_idx, match_score)]."""
     min_w = max(1, int(cap_h * 0.12))
     max_w = max(2, int(cap_h * 1.6))
     if e - s <= min(max_w * 0.75, cap_h * 0.8):  # narrow: single glyph
         sc, b = _match_score(line[:, s:e], baseline, cap_h, atlas, tmpl_sq)
-        return [(s, e, b)] if b >= 0 else []
+        return [(s, e, b, sc)] if b >= 0 else []
     col_ink = line[:, s:e].sum(axis=0)
     cuts = {0, e - s}
     # blank-column boundaries (run edges inside the block)
@@ -153,15 +153,15 @@ def _dp_segment(line: np.ndarray, s: int, e: int, baseline: float,
             cand = score[i] + m - _GLYPH_PENALTY
             if cand > score[j]:
                 score[j] = cand
-                back[j] = (i, b)
+                back[j] = (i, b, m)
     if back[n - 1] is None:  # DP found nothing (degenerate run)
         sc, b = _match_score(line[:, s:e], baseline, cap_h, atlas, tmpl_sq)
-        return [(s, e, b)] if b >= 0 else []
+        return [(s, e, b, sc)] if b >= 0 else []
     out = []
     j = n - 1
     while j > 0 and back[j] is not None:
-        i, b = back[j]
-        out.append((s + cuts[i], s + cuts[j], b))
+        i, b, m = back[j]
+        out.append((s + cuts[i], s + cuts[j], b, m))
         j = i
     return list(reversed(out))
 
@@ -242,7 +242,7 @@ def _atlas():
     height = top-of-'H' to baseline; every template's scalars are
     measured against its own font's anchors."""
     cols, chars = [], []
-    max_ratio, xh_ratios = 0.0, []
+    xh_ratios: list[float] = []
     for font in _template_fonts():
         ink = _binarize(_render_alphabet(font))
 
@@ -268,12 +268,12 @@ def _atlas():
             crop, top, bottom = got
             cols.append(_feature(crop, top, bottom, baseline, cap_h))
             chars.append(ch)
-            max_ratio = max(max_ratio, crop.shape[1] / cap_h)
-    return (np.stack(cols, axis=1), chars, max_ratio,
-            float(np.mean(xh_ratios)))
+    templates = np.stack(cols, axis=1)
+    tmpl_sq = 0.5 * (templates * templates).sum(axis=0)
+    return templates, tmpl_sq, chars, float(np.mean(xh_ratios))
 
 
-def _read_line(line: np.ndarray, atlas, chars, max_ratio, xh_over_cap):
+def _read_line(line: np.ndarray, atlas, tmpl_sq, chars, xh_over_cap):
     """Classify one line under both scale hypotheses; return the better
     (text, mean_score) reading."""
     # provisional scale from glyph statistics
@@ -289,7 +289,6 @@ def _read_line(line: np.ndarray, atlas, chars, max_ratio, xh_over_cap):
     med_h = float(np.median(heights))
     baseline = float(np.median(bottoms))
     best = ("", -np.inf)
-    tmpl_sq = 0.5 * (atlas * atlas).sum(axis=0)
     for cap_hyp in (med_h, med_h / xh_over_cap):
         # group runs separated by sub-glyph gaps into blocks, so a
         # multi-stroke glyph split by binarization heals inside the DP
@@ -300,23 +299,20 @@ def _read_line(line: np.ndarray, atlas, chars, max_ratio, xh_over_cap):
                 blocks[-1][1] = e
             else:
                 blocks.append([s, e])
-        glyphs = []  # (start, stop, template_idx)
+        glyphs = []  # (start, stop, template_idx, score)
         for s, e in blocks:
             glyphs.extend(_dp_segment(line, s, e, baseline, cap_hyp,
                                       atlas, tmpl_sq))
         if not glyphs:
             continue
-        # score the hypothesis by mean nearest-template similarity
-        sims = [
-            _match_score(line[:, s:e], baseline, cap_hyp, atlas, tmpl_sq)[0]
-            for s, e, _b in glyphs
-        ]
-        mean_score = float(np.mean(sims))
+        # hypothesis score = mean nearest-template similarity, reusing
+        # the scores the DP already computed for its chosen segmentation
+        mean_score = float(np.mean([g[3] for g in glyphs]))
         gaps = [glyphs[i][0] - glyphs[i - 1][1]
                 for i in range(1, len(glyphs))]
         space_w = _space_threshold(gaps, cap_hyp)
         text = []
-        for i, (s, e, b) in enumerate(glyphs):
+        for i, (s, e, b, _m) in enumerate(glyphs):
             if i > 0 and gaps[i - 1] >= space_w:
                 text.append(" ")
             text.append(chars[b])
@@ -339,10 +335,10 @@ def _space_threshold(gaps: list[int], cap_h: float) -> float:
 def ocr_image(img: np.ndarray) -> str:
     """Read machine-printed text from an (H, W[, 3]) array."""
     ink = _binarize(np.asarray(img))
-    atlas, chars, max_ratio, xh_over_cap = _atlas()
+    atlas, tmpl_sq, chars, xh_over_cap = _atlas()
     out = []
     for y0, y1 in _segments(ink.sum(axis=1), min_gap=2):
-        text, _score = _read_line(ink[y0:y1], atlas, chars, max_ratio,
+        text, _score = _read_line(ink[y0:y1], atlas, tmpl_sq, chars,
                                   xh_over_cap)
         if text:
             out.append(text)

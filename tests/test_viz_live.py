@@ -1,0 +1,95 @@
+"""Live streaming-widget viz (stdlib/viz/live.py): HTTP-served table state
+re-rendered from the diff stream."""
+
+import json
+import threading
+import time
+import urllib.request
+
+import pathway_tpu as pw
+from pathway_tpu.internals import parse_graph as pg
+
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=5) as r:
+        return r.read()
+
+
+def test_live_show_serves_streaming_state():
+    pg.G.clear()
+    rows = [("alice", 30), ("bob", 41)]
+
+    class Subject(pw.io.python.ConnectorSubject):
+        def run(self):
+            for name, age in rows:
+                self.next(name=name, age=age)
+                time.sleep(0.15)
+
+    class S(pw.Schema):
+        name: str = pw.column_definition(primary_key=True)
+        age: int
+
+    t = pw.io.python.read(Subject(), schema=S)
+    widget = pw.Table.live_show(t)
+    seen = []
+
+    def poll():
+        deadline = time.monotonic() + 4
+        while time.monotonic() < deadline:
+            try:
+                d = json.loads(_get(widget.url + "data"))
+                seen.append(len(d["rows"]))
+                if len(d["rows"]) == 2:
+                    seen.append(d)
+                    return
+            except Exception:
+                pass
+            time.sleep(0.1)
+
+    th = threading.Thread(target=poll)
+    th.start()
+    pw.run(timeout_s=3.0, autocommit_duration_ms=50,
+           monitoring_level=pw.MonitoringLevel.NONE)
+    th.join()
+    final = seen[-1]
+    # the page itself serves (before close: shutdown stops the listener)
+    assert b"pathway_tpu live table" in _get(widget.url)
+    widget.close()
+    assert isinstance(final, dict), seen
+    assert final["columns"] == ["name", "age"]
+    assert sorted(r[0] for r in final["rows"]) == ["alice", "bob"]
+    assert final["numeric"]["age"] and final["updates"] >= 2
+
+
+def test_live_show_applies_deletions():
+    pg.G.clear()
+    t = pw.debug.table_from_markdown("""
+    id | name | age | __time__ | __diff__
+    1 | alice | 30 | 2 | 1
+    1 | alice | 30 | 4 | -1
+    2 | bob | 41 | 4 | 1
+    """)
+    widget = pw.Table.live_show(t, name="deltas")
+    pw.run(monitoring_level=pw.MonitoringLevel.NONE)
+    d = json.loads(_get(widget.url + "data"))
+    widget.close()
+    assert [r[0] for r in d["rows"]] == ["bob"]
+    assert d["name"] == "deltas"
+    assert widget._repr_html_().startswith("<iframe")
+
+
+def test_live_show_escapes_html():
+    """Untrusted strings in table data must never reach the page
+    unescaped (XSS through innerHTML)."""
+    pg.G.clear()
+    t = pw.debug.table_from_markdown("""
+    payload
+    <script>alert(1)</script>
+    """)
+    widget = pw.Table.live_show(t, sorting_enabled=True)
+    pw.run(monitoring_level=pw.MonitoringLevel.NONE)
+    raw = _get(widget.url + "data").decode()
+    widget.close()
+    assert "<script>" not in raw
+    assert "&lt;script&gt;" in raw
+    assert json.loads(raw)["sortable"] is True
