@@ -216,28 +216,57 @@ def _sql_select(q: str, tables: dict) -> Table:
         right = tables[jt_name]
         how = (jm.group("how") or "inner").lower()
         on = jm.group("on").strip()
-        cm = re.match(r"(?s)^(\w+)\.(\w+)\s*=\s*(\w+)\.(\w+)$", on)
-        if not cm:
-            raise NotImplementedError(f"unsupported JOIN condition: {on!r}")
-        lt_n, lc, rt_n, rc = cm.groups()
-        sides = {lt_n, rt_n}
-        if jt_name not in sides:
-            raise ValueError(
-                f"JOIN condition {on!r} must reference the joined table "
-                f"{jt_name!r}"
-            )
-        other = (sides - {jt_name}).pop() if len(sides) == 2 else None
-        if other is not None and other not in tables:
-            raise ValueError(f"JOIN condition references unknown table {other!r}")
-        if len(sides) == 1:
-            raise ValueError(
-                f"JOIN condition {on!r} must reference two different tables"
-            )
-        if rt_n == jt_name:
-            lcol, rcol = lc, rc
-        else:
-            lcol, rcol = rc, lc
-        jr = t.join(right, t[lcol] == right[rcol], how=how)
+        # ON accepts (possibly parenthesized, arbitrarily nested)
+        # AND-composed equality pairs: multi-key joins per the
+        # reference's sqlglot-backed parser
+        def flatten_and(expr: str) -> list[str]:
+            expr = expr.strip()
+            while True:
+                inner = _strip_outer_parens(expr)
+                if inner is None:
+                    break
+                expr = inner.strip()
+            parts = _split_keyword(expr, "and")
+            if len(parts) == 1:
+                return [expr]
+            out: list[str] = []
+            for p in parts:
+                out.extend(flatten_and(p))
+            return out
+
+        conds = []
+        for part in flatten_and(on):
+            cm = re.match(
+                r'(?s)^[`"]?(\w+)[`"]?\.[`"]?(\w+)[`"]?\s*=\s*'
+                r'[`"]?(\w+)[`"]?\.[`"]?(\w+)[`"]?$', part)
+            if not cm:
+                raise NotImplementedError(
+                    f"unsupported JOIN condition: {part!r}")
+            lt_n, lc, rt_n, rc = cm.groups()
+            sides = {lt_n, rt_n}
+            if jt_name not in sides:
+                raise ValueError(
+                    f"JOIN condition {part!r} must reference the joined "
+                    f"table {jt_name!r}"
+                )
+            if len(sides) == 1:
+                raise ValueError(
+                    f"JOIN condition {part!r} must reference two different "
+                    "tables"
+                )
+            other = (sides - {jt_name}).pop()
+            if other not in tables:
+                raise ValueError(
+                    f"JOIN condition references unknown table {other!r}")
+            if rt_n == jt_name:
+                conds.append((lc, rc))
+            else:
+                conds.append((rc, lc))
+        jr = t.join(
+            right,
+            *[t[lcol] == right[rcol] for lcol, rcol in conds],
+            how=how,
+        )
         # flatten the join into a plain table carrying both sides' columns
         sel = {}
         for n in t.column_names():

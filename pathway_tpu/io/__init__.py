@@ -138,6 +138,10 @@ sys.modules["pathway_tpu.io.null"] = null
 from . import http  # noqa: E402  (needs subscribe defined)
 
 from .csv import CsvParserSettings  # noqa: E402
+from ._schema_registry import (  # noqa: E402
+    SchemaRegistryHeader,
+    SchemaRegistrySettings,
+)
 OnChangeCallback = Any
 OnFinishCallback = Any
 

@@ -50,11 +50,16 @@ class KafkaSource(DataSource):
     append_only = True
 
     def __init__(self, rdkafka_settings: dict, topic: str, format: str,  # noqa: A002
-                 schema: SchemaMetaclass):
+                 schema: SchemaMetaclass, schema_registry=None):
         self.settings = rdkafka_settings
         self.topic = topic
         self.format = format
         self.schema = schema
+        self._registry = None
+        if schema_registry is not None:
+            from ._schema_registry import SchemaRegistryClient
+
+            self._registry = SchemaRegistryClient(schema_registry)
         self._consumer = None
         self._kind = None
         self._n = 0
@@ -135,14 +140,20 @@ class KafkaSource(DataSource):
                 )
                 self._n += 1
                 continue
-            if self.format in ("json", "bson"):
+            if self.format in ("json", "bson", "avro"):
                 try:
                     if self.format == "bson":
                         from ._bson import decode_document
 
                         d, _ = decode_document(raw)
+                    elif self.format == "avro":
+                        from ._schema_registry import decode_avro_message
+
+                        d = decode_avro_message(raw, self._registry)
                     else:
                         d = json.loads(raw)
+                except ConnectionError:
+                    raise  # registry down is an error, not a bad message
                 except Exception:
                     continue
                 row = tuple(coerce_value(d.get(c), dtypes[c]) for c in colnames)
@@ -220,23 +231,48 @@ def read(
     format: str = "json",  # noqa: A002
     autocommit_duration_ms: int = 1500,
     topic_names: list[str] | None = None,
+    schema_registry_settings=None,
     **kwargs,
 ) -> Table:
     if topic is None and topic_names:
         topic = topic_names[0]
+    if format == "avro" and schema_registry_settings is None:
+        raise ValueError(
+            "pw.io.kafka.read format='avro' requires schema_registry_settings"
+        )
     if schema is None:
         schema = schema_from_columns(
             {"data": ColumnDefinition(dtype=dt.STR if format == "plaintext" else dt.BYTES)},
             name="KafkaSchema",
         )
-    source = KafkaSource(rdkafka_settings, topic, format, schema)
+    source = KafkaSource(rdkafka_settings, topic, format, schema,
+                         schema_registry=schema_registry_settings)
     return make_input_table(schema, source, name=f"kafka:{topic}")
 
 
 class KafkaWriter:
-    def __init__(self, rdkafka_settings: dict, topic: str, format: str):  # noqa: A002
+    def __init__(self, rdkafka_settings: dict, topic: str, format: str,  # noqa: A002
+                 schema_registry=None, table_schema=None):
         self.topic = topic
         self.format = format
+        self._registry = None
+        self._avro_schema = None
+        self._avro_id = None
+        if format == "avro":
+            from ._schema_registry import SchemaRegistryClient
+
+            if schema_registry is None:
+                raise ValueError(
+                    "pw.io.kafka.write format='avro' requires "
+                    "schema_registry_settings"
+                )
+            self._registry = SchemaRegistryClient(schema_registry)
+            self._table_schema = table_schema
+        injected = rdkafka_settings.get("_producer")
+        if injected is not None:  # test seam (kafka-python send/flush API)
+            self._producer = injected
+            self._kind = "kafka-python"
+            return
         try:
             from confluent_kafka import Producer  # type: ignore
 
@@ -253,11 +289,33 @@ class KafkaWriter:
         from ..engine.types import unwrap_row
         from ._utils import _jsonable
 
+        if self.format == "avro" and self._avro_schema is None:
+            from ._schema_registry import avro_schema_for
+
+            self._avro_schema = avro_schema_for(self._table_schema)
+            self._avro_schema["fields"] += [
+                {"name": "time", "type": "long"},
+                {"name": "diff", "type": "long"},
+            ]
+            self._avro_id = self._registry.register(
+                f"{self.topic}-value", self._avro_schema)
         for key, row, diff in updates:
-            obj = dict(zip(colnames, [_jsonable(v) for v in unwrap_row(row)]))
-            obj["time"] = time
-            obj["diff"] = diff
-            payload = json.dumps(obj, default=str).encode()
+            if self.format == "avro":
+                from ._schema_registry import encode_avro_message
+
+                # raw engine values: bytes must reach the codec unmangled
+                # (coercion to the registered schema happens inside)
+                obj = dict(zip(colnames, unwrap_row(row)))
+                obj["time"] = time
+                obj["diff"] = diff
+                payload = encode_avro_message(
+                    obj, self._avro_schema, self._avro_id)
+            else:
+                obj = dict(zip(colnames,
+                               [_jsonable(v) for v in unwrap_row(row)]))
+                obj["time"] = time
+                obj["diff"] = diff
+                payload = json.dumps(obj, default=str).encode()
             if self._kind == "confluent":
                 self._producer.produce(self.topic, payload)
             else:
@@ -272,6 +330,9 @@ class KafkaWriter:
 
 
 def write(table: Table, rdkafka_settings: dict, topic_name: str, *,
-          format: str = "json", **kwargs) -> None:  # noqa: A002
-    writer = KafkaWriter(rdkafka_settings, topic_name, format)
+          format: str = "json",  # noqa: A002
+          schema_registry_settings=None, **kwargs) -> None:
+    writer = KafkaWriter(rdkafka_settings, topic_name, format,
+                         schema_registry=schema_registry_settings,
+                         table_schema=table.schema)
     pg.new_output_node("output", [table], colnames=table.column_names(), writer=writer)

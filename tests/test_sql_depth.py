@@ -158,3 +158,51 @@ def test_sql_subquery_alias_does_not_shadow_sibling():
         a=a, b=b,
     )
     assert sorted(v[0] for v in run_and_squash(out).values()) == [1, 9]
+
+
+def test_join_on_multi_key_and_parens():
+    """AND-composed (and parenthesized) equality pairs in JOIN ON —
+    reference parity via sqlglot (internals/sql/processing.py)."""
+    import pathway_tpu as pw
+    from pathway_tpu.internals import parse_graph as pg
+
+    pg.G.clear()
+    left = pw.debug.table_from_markdown("""
+    k1 | k2 | v
+    a | 1 | 10
+    b | 2 | 20
+    """)
+    right = pw.debug.table_from_markdown("""
+    k1 | k2 | w
+    a | 1 | 100
+    b | 9 | 900
+    """)
+    for q in (
+        "SELECT v, w FROM l JOIN r ON (l.k1 = r.k1 AND l.k2 = r.k2)",
+        "SELECT v, w FROM l JOIN r ON l.k1 = r.k1 AND l.k2 = r.k2",
+        'SELECT v, w FROM l JOIN r ON ("l".k1 = "r"."k1") AND (l.k2 = r.k2)',
+    ):
+        pg.G.clear()
+        out = pw.sql(q, l=left, r=right)
+        df = pw.debug.table_to_pandas(out)
+        assert list(df.itertuples(index=False, name=None)) == [(10, 100)], q
+
+
+def test_join_on_nested_and_groups():
+    import pathway_tpu as pw
+    from pathway_tpu.internals import parse_graph as pg
+
+    pg.G.clear()
+    left = pw.debug.table_from_markdown("""
+    k1 | k2 | k3 | v
+    a | 1 | x | 10
+    """)
+    right = pw.debug.table_from_markdown("""
+    k1 | k2 | k3 | w
+    a | 1 | x | 100
+    """)
+    out = pw.sql(
+        "SELECT v, w FROM l JOIN r ON (l.k1 = r.k1) AND "
+        "(l.k2 = r.k2 AND l.k3 = r.k3)", l=left, r=right)
+    df = pw.debug.table_to_pandas(out)
+    assert list(df.itertuples(index=False, name=None)) == [(10, 100)]
