@@ -660,7 +660,7 @@ def main() -> None:
             import jax.numpy as jnp
 
             float(jnp.sum(jnp.stack(
-                [jnp.sum(b) for b in enc._store._batches]
+                [jnp.sum(b) for b in enc._store._buffers]
             )))
         t1 = time.perf_counter()
         assert len(caps[0].squash()) == 1
@@ -737,7 +737,7 @@ def main() -> None:
     e2e_store = DeviceVecStore(enc.dimensions)
     t2 = time.perf_counter()
     enc.embed_batch_device(docs, store=e2e_store)
-    float(jnp.sum(jnp.stack([jnp.sum(b) for b in e2e_store._batches])))
+    float(jnp.sum(jnp.stack([jnp.sum(b) for b in e2e_store._buffers])))
     t3 = time.perf_counter()
     embed_tokens_per_sec = n_docs * seq_T / (t3 - t2)
 

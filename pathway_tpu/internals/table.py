@@ -13,7 +13,7 @@ from typing import Any, Iterable, Mapping
 
 from . import dtype as dt
 from . import parse_graph as pg
-from .desugaring import expand_args, rewrite_nodes, substitute, walk
+from .desugaring import expand_args, rewrite, rewrite_nodes, substitute, walk
 from .expression import (
     ApplyExpression,
     ColumnExpression,
@@ -34,39 +34,55 @@ _table_counter = itertools.count()
 
 
 class Universe:
-    """Key-set identity; equality/subset tracked structurally (reference:
-    internals/universe.py + universe_solver.py)."""
+    """Key-set identity with a subset-relation solver (reference:
+    internals/universe.py + universe_solver.py).
 
-    __slots__ = ("id", "parent")
+    Relations are edges in a global graph: `parent` (structural — filter,
+    intersect, difference results are subsets of their source) plus
+    declared edges from the set-operation algebra (every concat /
+    update_rows input is a subset of the result; an intersect result is a
+    subset of EVERY argument) and user promises.  `is_subset_of` answers
+    by graph reachability with transitivity — the reference solver's
+    query, without its LP machinery."""
+
+    __slots__ = ("id", "parent", "_supers")
 
     def __init__(self, parent: "Universe | None" = None):
         self.id = next(_table_counter)
         self.parent = parent
+        # edges live ON the instance (not a module-global relation store),
+        # so cleared/discarded graphs free their solver state via GC
+        self._supers: list["Universe"] = [parent] if parent is not None else []
+
+    def declare_subset_of(self, other: "Universe") -> None:
+        self._supers.append(other)
 
     def is_subset_of(self, other: "Universe") -> bool:
-        u: Universe | None = self
-        while u is not None:
+        seen = {id(self)}
+        stack = [self]
+        while stack:
+            u = stack.pop()
             if u is other:
                 return True
-            u = u.parent
+            for nxt in u._supers:
+                if id(nxt) not in seen:
+                    seen.add(id(nxt))
+                    stack.append(nxt)
         return False
 
 
-_promised_equal: set[tuple[int, int]] = set()
-
-
 def promise_universes_equal(a: "Table", b: "Table") -> None:
-    _promised_equal.add((a._universe.id, b._universe.id))
-    _promised_equal.add((b._universe.id, a._universe.id))
+    a._universe.declare_subset_of(b._universe)
+    b._universe.declare_subset_of(a._universe)
 
 
 def _universes_compatible(a: "Table", b: "Table") -> bool:
-    return (
-        a._universe is b._universe
-        or a._universe.is_subset_of(b._universe)
-        or b._universe.is_subset_of(a._universe)
-        or (a._universe.id, b._universe.id) in _promised_equal
-    )
+    """May a table with universe `a` read columns of `b`?  Requires every
+    key of `a` to exist in `b`: a ⊆ b (reference type-checker boundary).
+    The reverse direction (b ⊂ a) is NOT sufficient — reading b's column
+    at a key of a \\ b is undefined; the reference rejects it at build
+    time and so do we."""
+    return a._universe.is_subset_of(b._universe)
 
 
 class Table:
@@ -310,7 +326,10 @@ class Table:
 
     def intersect(self, *others: "Table") -> "Table":
         node = pg.new_node("intersect", [self, *others])
-        return Table(node, self._colnames, self._dtypes, Universe(parent=self._universe))
+        u = Universe(parent=self._universe)
+        for o in others:  # an intersection is a subset of EVERY argument
+            u.declare_subset_of(o._universe)
+        return Table(node, self._colnames, self._dtypes, u)
 
     def restrict(self, other: "Table") -> "Table":
         return self.with_universe_of(other)
@@ -365,7 +384,10 @@ class Table:
             n: dt.lub(self._dtypes.get(n, dt.ANY), other._dtypes.get(n, dt.ANY))
             for n in self._colnames
         }
-        return Table(node, self._colnames, dtypes, Universe())
+        u = Universe()  # the union: every input is a subset of it
+        self._universe.declare_subset_of(u)
+        other._universe.declare_subset_of(u)
+        return Table(node, self._colnames, dtypes, u)
 
     def update_cells(self, other: "Table") -> "Table":
         extra = set(other._colnames) - set(self._colnames)
@@ -392,7 +414,10 @@ class Table:
             n: dt.lub(*[t._dtypes.get(n, dt.ANY) for t in [self, *others]])
             for n in self._colnames
         }
-        return Table(node, self._colnames, dtypes, Universe())
+        u = Universe()  # the disjoint union: every input is a subset
+        for t in [self, *others]:
+            t._universe.declare_subset_of(u)
+        return Table(node, self._colnames, dtypes, u)
 
     def concat_reindex(self, *others: "Table") -> "Table":
         parts = []
@@ -665,7 +690,10 @@ class Table:
         return self
 
     def promise_universe_is_subset_of(self, other: "Table") -> "Table":
-        promise_universes_equal(self, other)
+        # one-way: self's keys resolve in other, NOT the reverse — a
+        # bidirectional promise would let the superset read the subset's
+        # columns, the exact undefined read the solver exists to reject
+        self._universe.declare_subset_of(other._universe)
         return self
 
     def promise_universe_is_equal_to(self, other: "Table") -> "Table":
@@ -797,7 +825,6 @@ class JoinResult:
 
     def _side_of(self, e: ColumnExpression) -> str:
         tables = {r.table for r in e._dependencies()}
-        in_left = any(t is self._left or (isinstance(t, Table) and _universes_compatible(t, self._left)) for t in tables)
         in_right = any(t is self._right for t in tables)
         if self._left is self._right:
             raise ValueError("self-join requires .copy() of one side")
@@ -805,12 +832,14 @@ class JoinResult:
             return "r"
         if any(t is self._left for t in tables):
             return "l"
-        # fall back on universe comparison
+        # fall back on universe comparison: the join SIDE must be a subset
+        # of the referenced table (side keys resolve in it), so the side
+        # goes first in the asymmetric check
         for t in tables:
             if isinstance(t, Table):
-                if _universes_compatible(t, self._left):
+                if _universes_compatible(self._left, t):
                     return "l"
-                if _universes_compatible(t, self._right):
+                if _universes_compatible(self._right, t):
                     return "r"
         raise ValueError("cannot attribute join condition side")
 
@@ -828,11 +857,38 @@ class JoinResult:
                 raise ValueError("join conditions must be `left_expr == right_expr`")
             a, b = cond._left, cond._right
             if self._side_of(a) == "l":
-                self._left_on.append(a)
-                self._right_on.append(b)
+                self._left_on.append(self._rebind(a, self._left))
+                self._right_on.append(self._rebind(b, self._right))
             else:
-                self._left_on.append(b)
-                self._right_on.append(a)
+                self._left_on.append(self._rebind(b, self._left))
+                self._right_on.append(self._rebind(a, self._right))
+
+    def _rebind(self, e: ColumnExpression, side: "Table") -> ColumnExpression:
+        """Rewrite references to SUPERSET tables of `side` onto `side`'s
+        same-named columns: side keys resolve in the superset, and a
+        structural subset (filter result) physically carries the column,
+        so the per-row evaluation reads the side's own copy."""
+        mapping: dict = {}
+        for ref in e._dependencies():
+            t = ref.table
+            if (isinstance(t, Table) and t is not side
+                    and _universes_compatible(side, t)):
+                if ref.name not in side.column_names():
+                    raise ValueError(
+                        f"join condition reads {ref.name!r} of a superset "
+                        f"table, but the join side has no such column; "
+                        "select it onto the side first"
+                    )
+                mapping[(id(t), ref.name)] = ColumnReference(side, ref.name)
+        if not mapping:
+            return e
+
+        def fn(node):
+            if isinstance(node, ColumnReference):
+                return mapping.get((id(node.table), node.name), node)
+            return node
+
+        return rewrite(e, fn)
 
     def _materialize(self) -> Table:
         if self._joined is not None:

@@ -21,4 +21,4 @@ def promise_are_equal(*tables: Table) -> None:
 
 
 def promise_is_subset_of(subset: Table, superset: Table) -> None:
-    promise_universes_equal(subset, superset)
+    subset._universe.declare_subset_of(superset._universe)

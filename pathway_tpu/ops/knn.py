@@ -71,7 +71,7 @@ def _batched_topk_fn(metric: str, k: int):
     jax, jnp = _jax()
 
     @jax.jit
-    def run(m, qs):
+    def run(m, qs, n_valid):
         if metric == "cos":
             mn = m / (jnp.linalg.norm(m, axis=1, keepdims=True) + 1e-12)
             qn = qs / (jnp.linalg.norm(qs, axis=1, keepdims=True) + 1e-12)
@@ -84,18 +84,26 @@ def _batched_topk_fn(metric: str, k: int):
                 - jnp.sum(m * m, axis=1)[None, :]
                 - jnp.sum(qs * qs, axis=1)[:, None]
             )
+        # n_valid is a traced scalar: bucket-padded matrices mask their
+        # padding rows without a recompile per index version
+        scores = jnp.where(
+            jnp.arange(m.shape[0])[None, :] < n_valid, scores, -jnp.inf)
         vals, idx = jax.lax.top_k(scores, k)
         return vals, idx
 
     return run
 
 
-def batched_topk(matrix: np.ndarray, queries: np.ndarray, k: int, metric: str = "cos"):
+def batched_topk(matrix: np.ndarray, queries: np.ndarray, k: int,
+                 metric: str = "cos", n_valid: int | None = None):
     """(Q,k) top-k values and indices for a batch of queries — one device
-    dispatch for the whole micro-batch."""
+    dispatch for the whole micro-batch.  `n_valid` masks bucket padding
+    rows (scores forced to -inf)."""
     jax, jnp = _jax()
-    k = min(k, matrix.shape[0])
-    vals, idx = _batched_topk_fn(metric, k)(jnp.asarray(matrix), jnp.asarray(queries))
+    nv = int(matrix.shape[0]) if n_valid is None else int(n_valid)
+    k = min(k, nv)
+    vals, idx = _batched_topk_fn(metric, k)(
+        jnp.asarray(matrix), jnp.asarray(queries), nv)
     return np.asarray(vals), np.asarray(idx)
 
 
@@ -104,7 +112,7 @@ def _single_topk_fn(metric: str, k: int):
     jax, jnp = _jax()
 
     @jax.jit
-    def run(m, q):
+    def run(m, q, n_valid):
         if metric == "cos_prenorm":
             scores = m @ (q / (jnp.linalg.norm(q) + 1e-12))
         elif metric == "cos":
@@ -115,18 +123,22 @@ def _single_topk_fn(metric: str, k: int):
             scores = m @ q
         else:  # l2sq
             scores = 2.0 * (m @ q) - jnp.sum(m * m, axis=1) - jnp.sum(q * q)
+        scores = jnp.where(jnp.arange(m.shape[0]) < n_valid, scores,
+                           -jnp.inf)
         return jax.lax.top_k(scores, k)
 
     return run
 
 
-def device_topk(matrix, query: np.ndarray, k: int, metric: str = "cos"):
+def device_topk(matrix, query: np.ndarray, k: int, metric: str = "cos",
+                n_valid: int | None = None):
     """Single-query top-k computed ENTIRELY on device; only the (k,) values
     and indices cross back to the host.  Fetching the full score vector (the
     old device_topk_scores path) costs O(N) device->host bytes — measured
     ~1.5-7 MB/s over the axon tunnel, this dominates serving latency for any
-    index past ~100k rows."""
+    index past ~100k rows.  `n_valid` masks bucket-padding rows."""
     jax, jnp = _jax()
-    k = min(k, int(matrix.shape[0]))
-    vals, idx = _single_topk_fn(metric, k)(matrix, jnp.asarray(query))
+    nv = int(matrix.shape[0]) if n_valid is None else int(n_valid)
+    k = min(k, nv)
+    vals, idx = _single_topk_fn(metric, k)(matrix, jnp.asarray(query), nv)
     return np.asarray(vals), np.asarray(idx)

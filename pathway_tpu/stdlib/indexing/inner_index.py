@@ -73,7 +73,8 @@ class BruteForceKnn(InnerIndex):
         # (store, batch, row); consolidation gathers them on device.
         self._dev_refs: dict[int, tuple] = {}
         self._version = 0
-        self._dev_matrix = None  # (token, device (n,d) matrix, prenormed?)
+        self._dev_matrix = None  # (token, device (bucket,d) matrix)
+        self._dev_valid = 0      # live rows in the bucketed device matrix
         self._host_mirror = None  # (token, np matrix) for the CPU latency tier
 
     def _ensure(self, dim: int) -> None:
@@ -154,8 +155,19 @@ class BruteForceKnn(InnerIndex):
         self._invalidate()
 
     # -- device-resident consolidation ------------------------------------
+    @staticmethod
+    def _bucket_rows(n: int) -> int:
+        """Next power-of-two row bucket (min 256): consolidated matrices
+        keep a STATIC shape as the index grows, so the search matmul +
+        top-k recompiles only when the bucket steps, not per commit."""
+        b = 256
+        while b < n:
+            b *= 2
+        return b
+
     def _device_matrix(self, prenorm: bool):
-        """One (n, d) device array over all live slots, gathered with a
+        """One (bucket, d) device array over all live slots (zero-padded to
+        the row bucket; `self._dev_valid` rows are live), gathered with a
         single dispatch; host rows (if any) are uploaded alongside.  Cached
         until the next mutation."""
         token = (self._version, prenorm)
@@ -163,6 +175,7 @@ class BruteForceKnn(InnerIndex):
             return self._dev_matrix[1]
         import jax.numpy as jnp
 
+        self._dev_valid = self.n
         stores = {ref[0].id for ref in self._dev_refs.values()}
         single_store = len(stores) == 1
         if single_store and len(self._dev_refs) == self.n and self.n > 0:
@@ -171,7 +184,7 @@ class BruteForceKnn(InnerIndex):
                 (self._dev_refs[s][1], self._dev_refs[s][2])
                 for s in range(self.n)
             ]
-            m = store.gather(refs)
+            m = store.gather(refs, pad_to=self._bucket_rows(self.n))
         else:
             # mixed, host-only, or multi-store: upload host rows, then one
             # gather-and-scatter per distinct DeviceVecStore
@@ -205,7 +218,8 @@ class BruteForceKnn(InnerIndex):
             import jax.numpy as jnp
 
             dev = self._device_matrix(prenorm=False)
-            m = np.asarray(dev.astype(jnp.float16)).astype(np.float32)
+            m = np.asarray(dev.astype(jnp.float16)).astype(
+                np.float32)[: self._dev_valid]
         self._host_mirror = (self._version, m)
         return m
 
@@ -235,7 +249,8 @@ class BruteForceKnn(InnerIndex):
                 [np.asarray(q, np.float32).reshape(-1) for q in queries]
             )
             vals, idx = batched_topk(
-                self._device_matrix(prenorm=False), qs, k, self.metric
+                self._device_matrix(prenorm=False), qs, k, self.metric,
+                n_valid=self._dev_valid,
             )
             return [
                 [(self.keys[int(i)], float(v)) for v, i in zip(vi, ii)]
@@ -285,7 +300,8 @@ class BruteForceKnn(InnerIndex):
             prenorm = self.metric == "cos"
             metric = "cos_prenorm" if prenorm else self.metric
             vals, idx = device_topk(
-                self._device_matrix(prenorm=prenorm), q, k, metric
+                self._device_matrix(prenorm=prenorm), q, k, metric,
+                n_valid=self._dev_valid,
             )
             return [(self.keys[int(i)], float(v)) for v, i in zip(vals, idx)]
         if self.mesh is not None and metadata_filter is None and self.n >= k:

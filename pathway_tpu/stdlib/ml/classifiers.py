@@ -13,16 +13,22 @@ from .index import KNNIndex
 def knn_lsh_classifier_train(data: Table, L: int = 8, type: str = "euclidean",  # noqa: A002
                              d: int | None = None, M: int = 6, A: float = 1.0):
     """Returns a classify(labels, queries) function (reference API)."""
-    index = KNNIndex(
-        data.data, data, n_dimensions=d, n_or=L, n_and=M,
-        distance_type="cosine" if type == "cosine" else "euclidean", use_lsh=True,
+    # one kwargs dict for BOTH index builds: the labeled index must use
+    # the SAME metric/LSH configuration the classifier was trained with
+    # (two drifting call sites silently switched euclidean-trained
+    # classifiers to cosine)
+    idx_kwargs = dict(
+        n_dimensions=d, n_or=L, n_and=M,
+        distance_type="cosine" if type == "cosine" else "euclidean",
+        use_lsh=True,
     )
+    index = KNNIndex(data.data, data, **idx_kwargs)
 
     def classify(labels: Table, queries: Table) -> Table:
         labeled = index.data.with_columns(
             _pw_label=labels.with_universe_of(index.data).label
         )
-        idx2 = KNNIndex(labeled.data, labeled, use_lsh=True)
+        idx2 = KNNIndex(labeled.data, labeled, **idx_kwargs)
         reply = idx2.get_nearest_items(queries.data, k=5)
 
         def vote(ls):

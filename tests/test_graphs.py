@@ -122,3 +122,53 @@ def test_louvain_communities_multilevel():
     assert n_comms < n_cliques, (
         f"level 2 should merge adjacent triangles: {n_comms} communities"
     )
+
+
+def test_bellman_ford_unreachable_and_relaxation():
+    """Unreachable vertices keep inf distance; multi-hop relaxation finds
+    the cheaper indirect path."""
+    v = _vertices(["a", "b", "c", "d"])
+    e = table_from_markdown(
+        """
+        | su | sv | dist
+      1 | a  | b  | 10.0
+      2 | a  | c  | 2.0
+      3 | c  | b  | 3.0
+        """
+    )
+    e2 = e.select(u=v.pointer_from(e.su), v=v.pointer_from(e.sv), dist=e.dist)
+    out = bellman_ford(v, e2)
+    state = run_and_squash(out)
+    dists = sorted(r[0] for r in state.values())
+    assert dists == [0.0, 2.0, 5.0, math.inf]  # a->c->b beats the direct edge
+
+
+def test_louvain_streaming_update_moves_vertex():
+    """Adding strong edges in a later minibatch re-clusters: the new
+    vertex lands in the clique it attaches to (incremental Louvain)."""
+    names = ["a", "b", "c", "x", "y", "z", "w"]
+    v = table_from_markdown(
+        "\n".join(["n"] + names), id_from=["n"]
+    )
+    e = table_from_markdown(
+        """
+        | su | sv | weight | __time__ | __diff__
+      1 | a  | b  | 1.0 | 2 | 1
+      2 | b  | c  | 1.0 | 2 | 1
+      3 | a  | c  | 1.0 | 2 | 1
+      4 | x  | y  | 1.0 | 2 | 1
+      5 | y  | z  | 1.0 | 2 | 1
+      6 | x  | z  | 1.0 | 2 | 1
+      7 | w  | x  | 5.0 | 4 | 1
+      8 | w  | y  | 5.0 | 4 | 1
+        """
+    )
+    e2 = e.select(u=v.pointer_from(e.su), v=v.pointer_from(e.sv),
+                  weight=e.weight)
+    out = louvain_level(v, e2)
+    state = run_and_squash(out)
+    comms = {}
+    for key, row in state.items():
+        comms.setdefault(row[0], set()).add(key)
+    sizes = sorted(len(m) for m in comms.values())
+    assert sizes == [3, 4]  # {a,b,c} and {x,y,z,w}

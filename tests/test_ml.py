@@ -280,3 +280,78 @@ def test_hmm_legacy_dict_form_still_works():
     final = list(cap.squash().values())[0][0]
     assert most_likely_state(final) == "y"
     pg.G.clear()
+
+
+def test_knn_lsh_classifier_votes_majority():
+    """knn_lsh_classifier_train returns a classify() that majority-votes
+    the labels of the nearest training points (reference:
+    stdlib/ml/classifiers/_knn_lsh.py)."""
+    import pathway_tpu as pw
+    from pathway_tpu.internals import parse_graph as pg
+    from pathway_tpu.stdlib.ml.classifiers import knn_lsh_classifier_train
+
+    pg.G.clear()
+    train = pw.debug.table_from_markdown("""
+    id | x | y
+    1 | 0.0 | 0.1
+    2 | 0.1 | 0.0
+    3 | 0.05 | 0.05
+    4 | 5.0 | 5.1
+    5 | 5.1 | 5.0
+    6 | 5.05 | 5.05
+    """)
+    train = train.select(
+        data=pw.apply(lambda x, y: [x, y], pw.this.x, pw.this.y))
+    labels = train.select(
+        label=pw.apply_with_type(
+            lambda v: "low" if v[0] < 1 else "high", str, pw.this.data))
+    queries = pw.debug.table_from_markdown("""
+    qx | qy
+    0.02 | 0.03
+    5.02 | 5.03
+    """)
+    queries = queries.select(
+        data=pw.apply(lambda x, y: [x, y], pw.this.qx, pw.this.qy))
+    classify = knn_lsh_classifier_train(train, L=12, M=4)
+    out = classify(labels, queries)
+    df = pw.debug.table_to_pandas(out)
+    assert sorted(df["predicted_label"]) == ["high", "low"]
+
+
+def test_knn_index_streaming_updates_and_metadata_filter():
+    """KNNIndex.query is fully incremental: late-arriving rows revise
+    earlier answers; jmespath metadata filters restrict candidates."""
+    import pathway_tpu as pw
+    from pathway_tpu.internals import parse_graph as pg
+    from pathway_tpu.stdlib.ml.index import KNNIndex
+
+    pg.G.clear()
+    docs = pw.debug.table_from_markdown("""
+    id | x | y | topic | __time__ | __diff__
+    1 | 0.0 | 1.0 | news | 2 | 1
+    2 | 1.0 | 0.0 | sport | 2 | 1
+    3 | 0.0 | 0.9 | news | 4 | 1
+    """)
+    docs = docs.select(
+        data=pw.apply(lambda x, y: [x, y], pw.this.x, pw.this.y),
+        meta=pw.apply(lambda t: {"topic": t}, pw.this.topic),
+        topic=pw.this.topic,
+    )
+    index = KNNIndex(docs.data, docs, n_dimensions=2, metadata=docs.meta)
+    q = pw.debug.table_from_markdown("""
+    qx | qy
+    0.0 | 1.0
+    """)
+    q = q.select(data=pw.apply(lambda x, y: [x, y], pw.this.qx, pw.this.qy),
+                 flt=pw.apply_with_type(lambda x: "topic == 'sport'", str,
+                                        pw.this.qx))
+    near = index.get_nearest_items(q.data, k=1).select(
+        hit=pw.this.topic)
+    df = pw.debug.table_to_pandas(near)
+    assert list(df["hit"].iloc[0]) == ["news"]
+
+    # metadata filter forces the sport row despite worse distance
+    pg_filtered = index.get_nearest_items(
+        q.data, k=1, metadata_filter=q.flt).select(hit=pw.this.topic)
+    df2 = pw.debug.table_to_pandas(pg_filtered)
+    assert list(df2["hit"].iloc[0]) == ["sport"]

@@ -1,0 +1,162 @@
+"""Universe-algebra accept/reject boundary (reference:
+internals/universe_solver.py) — the solver must accept exactly the
+column mixes whose key sets are provably compatible."""
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.internals import parse_graph as pg
+
+
+def _md(t):
+    return pw.debug.table_from_markdown(t)
+
+
+BASE = """
+id | a
+1 | 10
+2 | 20
+3 | 30
+"""
+
+
+def test_filter_result_reads_parent_columns():
+    pg.G.clear()
+    t = _md(BASE)
+    f = t.filter(t.a > 15)
+    out = f.select(doubled=t.a * 2)  # f ⊆ t: every key resolves
+    df = pw.debug.table_to_pandas(out)
+    assert sorted(df["doubled"]) == [40, 60]
+
+
+def test_parent_cannot_read_subset_columns():
+    pg.G.clear()
+    t = _md(BASE)
+    f = t.filter(t.a > 15).select(b=pw.this.a)
+    with pytest.raises(ValueError, match="incompatible universe"):
+        t.select(x=f.b)  # t ⊋ f: key 1 has no row in f
+
+
+def test_intersect_is_subset_of_every_argument():
+    pg.G.clear()
+    t = _md(BASE)
+    other = _md("""
+    id | z
+    2 | 5
+    3 | 6
+    4 | 7
+    """)
+    i = t.intersect(other)
+    # i ⊆ t (structural parent) AND i ⊆ other (solver edge): columns of
+    # BOTH sides are readable
+    out = i.select(s=t.a + other.z)
+    df = pw.debug.table_to_pandas(out)
+    assert sorted(df["s"]) == [25, 36]
+
+
+def test_difference_is_subset_of_left_only():
+    pg.G.clear()
+    t = _md(BASE)
+    other = _md("""
+    id | z
+    3 | 6
+    """)
+    d = t.difference(other)
+    out = d.select(v=t.a)  # d ⊆ t
+    df = pw.debug.table_to_pandas(out)
+    assert sorted(df["v"]) == [10, 20]
+    with pytest.raises(ValueError, match="incompatible universe"):
+        d.select(v=other.z)  # d ⊄ other (keys 1,2 are not in other)
+
+
+def test_concat_inputs_read_concat_columns():
+    pg.G.clear()
+    a = _md("""
+    id | a
+    1 | 10
+    """)
+    b = _md("""
+    id | a
+    2 | 20
+    """)
+    pw.universes.promise_are_pairwise_disjoint(a, b)
+    u = a.concat(b)
+    out = a.select(v=u.a)  # a ⊆ u: reading the union's column is safe
+    df = pw.debug.table_to_pandas(out)
+    assert list(df["v"]) == [10]
+    with pytest.raises(ValueError, match="incompatible universe"):
+        u.select(v=a.a)  # u ⊋ a: key 2 unresolvable
+
+
+def test_update_rows_union_superset():
+    pg.G.clear()
+    t = _md(BASE)
+    patch = _md("""
+    id | a
+    3 | 99
+    4 | 44
+    """)
+    u = t.update_rows(patch)
+    out = t.select(v=u.a)  # t ⊆ union
+    df = pw.debug.table_to_pandas(out)
+    assert sorted(df["v"]) == [10, 20, 99]
+    with pytest.raises(ValueError, match="incompatible universe"):
+        u.select(v=t.a)  # union ⊋ t: key 4 unresolvable
+
+
+def test_promise_overrides_structure():
+    pg.G.clear()
+    t = _md(BASE)
+    f = t.filter(t.a > 0).select(b=pw.this.a)  # actually keeps every key
+    with pytest.raises(ValueError, match="incompatible universe"):
+        t.select(x=f.b)
+    t.promise_universes_are_equal(f)
+    df = pw.debug.table_to_pandas(t.select(x=f.b))
+    assert sorted(df["x"]) == [10, 20, 30]
+
+
+def test_subset_transitivity():
+    pg.G.clear()
+    t = _md(BASE)
+    f1 = t.filter(t.a > 5)
+    f2 = f1.filter(f1.a > 15)
+    out = f2.select(v=t.a)  # f2 ⊆ f1 ⊆ t composes
+    df = pw.debug.table_to_pandas(out)
+    assert sorted(df["v"]) == [20, 30]
+
+
+def test_join_condition_references_parent_of_side():
+    """A join condition may reference a SUPERSET table of a join side
+    (side keys resolve in it): f ⊆ t, so t.b attributes to f's side."""
+    pg.G.clear()
+    t = _md("""
+    id | a | b
+    1 | 10 | 7
+    2 | 20 | 8
+    """)
+    other = _md("""
+    id | c | v
+    1 | 7 | 70
+    2 | 8 | 80
+    """)
+    f = t.filter(t.a > 15)
+    out = f.join(other, t.b == other.c).select(v=other.v)
+    df = pw.debug.table_to_pandas(out)
+    assert list(df["v"]) == [80]
+
+
+def test_subset_promise_is_one_way():
+    """promise_universe_is_subset_of must NOT let the superset read the
+    subset's columns (the undefined read the solver exists to reject)."""
+    pg.G.clear()
+    big = _md(BASE)
+    small = _md("""
+    id | b
+    1 | 100
+    """)
+    small.promise_universe_is_subset_of(big)
+    out = small.select(v=big.a)  # small ⊆ big: fine
+    df = pw.debug.table_to_pandas(out)
+    assert list(df["v"]) == [10]
+    with pytest.raises(ValueError, match="incompatible universe"):
+        big.select(v=small.b)  # big ⊋ small: still rejected
