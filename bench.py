@@ -780,6 +780,40 @@ def main() -> None:
     _PARTIAL["embed_mfu"] = mfu
     _PARTIAL["embed_tokens_per_sec"] = round(embed_tokens_per_sec)
 
+    if backend == "tpu":
+        # Pallas KNN kernel compiled FOR REAL (interpret=False on TPU):
+        # tiled (Q,d)x(d,N) scores at serving scale vs the plain XLA path
+        _stage("pallas knn kernel")
+        from pathway_tpu.ops.knn_pallas import pallas_scores
+
+        # Q matches TILE_Q so both paths execute the same MACs (an
+        # unaligned Q would bill the kernel for its own padding)
+        Qn, Nn, dn = 128, 131072, 384
+        rngk = np.random.default_rng(3)
+        qk = jnp.asarray(rngk.normal(size=(Qn, dn)).astype(np.float32))
+        mk = jnp.asarray(rngk.normal(size=(Nn, dn)).astype(np.float32))
+        xla_mm = jax.jit(lambda a, b: a @ b.T)
+        pallas_scores(qk, mk, interpret=False).block_until_ready()  # compile
+        xla_mm(qk, mk).block_until_ready()
+        t0 = time.perf_counter()
+        for _ in range(10):
+            out_p = pallas_scores(qk, mk, interpret=False)
+        out_p.block_until_ready()
+        t_pallas = (time.perf_counter() - t0) / 10
+        t0 = time.perf_counter()
+        for _ in range(10):
+            out_x = xla_mm(qk, mk)
+        out_x.block_until_ready()
+        t_xla = (time.perf_counter() - t0) / 10
+        assert np.allclose(np.asarray(out_p), np.asarray(out_x), atol=1e-3)
+        gf = 2.0 * Qn * Nn * dn / 1e9
+        _PARTIAL["pallas_knn"] = {
+            "gflops_per_sec": round(gf / t_pallas, 1),
+            "xla_gflops_per_sec": round(gf / t_xla, 1),
+            "vs_xla": round(t_xla / t_pallas, 2),
+            "shape": f"Q{Qn} N{Nn} d{dn}",
+        }
+
     _stage("wordcount")
     wordcount_rps = bench_wordcount()
     _PARTIAL["wordcount_rows_per_sec"] = round(wordcount_rps)
@@ -828,6 +862,7 @@ def main() -> None:
                 "stages": stages,
                 "generation": generation,
                 "retrieval_quality": retrieval_quality,
+                "pallas_knn": _PARTIAL.get("pallas_knn"),
                 "parallel": parallel,
                 "data_plane": data_plane,
                 "n_docs": n_docs,

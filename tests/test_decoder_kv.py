@@ -104,16 +104,20 @@ def test_decode_step_is_o_t_not_o_t2():
     step(params, cache, tok, pos)[0].block_until_ready()  # compile
     full(params, token_ids=buf).block_until_ready()
 
-    n = 8
-    t0 = time.perf_counter()
-    for _ in range(n):
-        step(params, cache, tok, pos)[0].block_until_ready()
-    t_step = (time.perf_counter() - t0) / n
+    # min-of-runs: robust to transient host-load spikes (a concurrent
+    # bench process once compressed mean-based ratios below the gate)
+    def best_of(fn, n):
+        best = float("inf")
+        for _ in range(n):
+            t0 = time.perf_counter()
+            fn()
+            best = min(best, time.perf_counter() - t0)
+        return best
 
-    t0 = time.perf_counter()
-    for _ in range(max(n // 4, 2)):
-        full(params, token_ids=buf).block_until_ready()
-    t_full = (time.perf_counter() - t0) / max(n // 4, 2)
+    t_step = best_of(
+        lambda: step(params, cache, tok, pos)[0].block_until_ready(), 8)
+    t_full = best_of(
+        lambda: full(params, token_ids=buf).block_until_ready(), 3)
 
     assert t_full / t_step >= 10, (
         f"cached step {t_step*1e3:.2f}ms vs full {t_full*1e3:.2f}ms — "
