@@ -57,6 +57,18 @@ class Universe:
     def declare_subset_of(self, other: "Universe") -> None:
         self._supers.append(other)
 
+    def is_structural_subset_of(self, other: "Universe") -> bool:
+        """Subset relation via `parent` edges ONLY (filter / intersect /
+        difference chains), where the subset's rows are physically derived
+        from the superset — unlike user promises, which assert key
+        containment but say nothing about column values."""
+        u: Universe | None = self
+        while u is not None:
+            if u is other:
+                return True
+            u = u.parent
+        return False
+
     def is_subset_of(self, other: "Universe") -> bool:
         seen = {id(self)}
         stack = [self]
@@ -866,13 +878,27 @@ class JoinResult:
     def _rebind(self, e: ColumnExpression, side: "Table") -> ColumnExpression:
         """Rewrite references to SUPERSET tables of `side` onto `side`'s
         same-named columns: side keys resolve in the superset, and a
-        structural subset (filter result) physically carries the column,
-        so the per-row evaluation reads the side's own copy."""
+        STRUCTURAL subset (filter result) physically carries the column,
+        so the per-row evaluation reads the side's own copy.
+
+        Promise-declared subsets (promise_universe_is_subset_of between
+        unrelated tables) are rejected: the promise asserts key containment
+        only, so the side's same-named column may hold different data and a
+        silent rebind would join on keys the user never wrote (advisor r3
+        finding; the reference rejects third-table references outright)."""
         mapping: dict = {}
         for ref in e._dependencies():
             t = ref.table
             if (isinstance(t, Table) and t is not side
                     and _universes_compatible(side, t)):
+                if not side._universe.is_structural_subset_of(t._universe):
+                    raise ValueError(
+                        f"join condition reads {ref.name!r} of a table that "
+                        "is only promise-related to the join side; a "
+                        "promise asserts key containment, not value "
+                        "equality, so the reference cannot be rebound — "
+                        "select the column onto the join side first"
+                    )
                 if ref.name not in side.column_names():
                     raise ValueError(
                         f"join condition reads {ref.name!r} of a superset "

@@ -47,6 +47,16 @@ class _MilvusWriter:
         for _key, row, diff in updates:
             vals = unwrap_row(row)
             pk = vals[pi]
+            # the delete path str()s the key into a filter expression, so
+            # only types with an exact filter-grammar rendering are sound
+            # primary keys (advisor r3: bool/float/None render as tokens
+            # the grammar won't match, silently dropping the retraction)
+            if pk is None or isinstance(pk, (bool, float)) or not isinstance(
+                    pk, (int, str)):
+                raise ValueError(
+                    f"milvus primary key {self.primary_key!r} must be a "
+                    f"non-null int or str, got {type(pk).__name__}: {pk!r}"
+                )
             if diff > 0:
                 ent: dict[str, Any] = {}
                 for i, c in enumerate(colnames):

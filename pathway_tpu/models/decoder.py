@@ -325,12 +325,23 @@ class JaxDecoderLM:
     new_buckets = (16, 32, 64, 128, 256)
 
     def generate(self, prompt: str, max_new_tokens: int = 32,
-                 stop_token: int | None = None, fused: bool = True) -> str:
-        """Greedy completion.  fused=True (default) runs prefill + the whole
-        decode loop as ONE device program (generate_tokens_fused) — over the
-        TPU tunnel this is the difference between ~12 tokens/sec (one
+                 stop_token: int | None = None,
+                 fused: bool | str = "auto") -> str:
+        """Greedy completion.  fused=True runs prefill + the whole decode
+        loop as ONE device program (generate_tokens_fused) — over the TPU
+        tunnel this is the difference between ~12 tokens/sec (one
         synchronizing dispatch per token) and compute-bound decoding.
-        fused=False keeps the per-step host loop (streaming/debug)."""
+        fused=False keeps the per-step host loop (streaming/debug).
+
+        fused="auto" (default) tier-selects by backend: on TPU the fused
+        program wins (it removes the ~50-90 ms per-token dispatch round
+        trip); on the CPU fallback decoding is host-bandwidth-bound
+        (~500 MB of params per token), per-step dispatch is ~1 ms, and the
+        fused program runs its full max_new bucket when no stop token fires
+        — so the stepwise loop, which stops exactly at max_new_tokens, is
+        never slower there (VERDICT r3 #3)."""
+        if fused == "auto":
+            fused = jax.default_backend() == "tpu"
         ids = self.tokenizer.encode(prompt)
         keep = self.cfg.max_len - max_new_tokens
         ids = ids[-max(keep, 1):] or [4]

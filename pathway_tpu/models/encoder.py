@@ -332,6 +332,43 @@ class JaxEncoder:
     def embed(self, text: str) -> np.ndarray:
         return self.embed_batch([text])[0]
 
+    def host_batch(self):
+        """Batched host-BLAS bulk tier (models/host_encoder.py
+        TorchBatchEncoder) — weight-identical; None if torch is absent."""
+        if not hasattr(self, "_host_batch"):
+            try:
+                from .host_encoder import TorchBatchEncoder
+
+                self._host_batch = TorchBatchEncoder(
+                    self.cfg, self.params, self.tokenizer
+                )
+            except ImportError:
+                self._host_batch = None
+        return self._host_batch
+
+    def embed_batch_host(self, texts: list[str], chunk: int = 128) -> np.ndarray:
+        """Bulk embed on the host BLAS tier — the fastest CPU-backend path
+        (the jit'd XLA forward measures ~55 GFLOPS on the 1-core fallback vs
+        ~90+ for torch/BLAS on the same GEMMs).  Same weights, same outputs
+        (~1e-3) as embed_batch; stage times land in the same stats keys so
+        bench attribution carries over."""
+        hb = self.host_batch()
+        if hb is None:
+            return self.embed_batch(texts)
+        if not texts:
+            return np.zeros((0, self.cfg.d_model), np.float32)
+        return hb.embed_batch(texts, chunk=chunk, stats=self.stats)
+
+    def embed_batch_fastest(self, texts: list[str]):
+        """Tier-select bulk embedding by backend (VERDICT r3 #2): device-
+        resident handles on TPU (no fetch over the tunnel), host-BLAS batch
+        on the CPU fallback, XLA batch otherwise."""
+        if jax.default_backend() == "tpu":
+            return self.embed_batch_device(texts)
+        if self.host_batch() is not None:
+            return self.embed_batch_host(texts)
+        return self.embed_batch(texts)
+
     def cpu_mirror(self):
         """Host-side mirror — the serving latency tier (single queries).
 

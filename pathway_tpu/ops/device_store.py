@@ -105,8 +105,12 @@ class DeviceVecStore:
         downstream matmul/top-k shapes stay static as the index grows."""
         import jax.numpy as jnp
 
-        if not refs and not pad_to:
-            return jnp.zeros((0, self.dim or 0), jnp.float32)
+        if not refs and (pad_to is None or not self._buffers):
+            # empty store: honor pad_to with a zero-fill instead of
+            # indexing _buffers[0] (advisor r3); pad_to=0 is treated like
+            # None rather than conflated with it
+            n_pad = pad_to or 0
+            return jnp.zeros((n_pad, self.dim or 0), jnp.float32)
         full = (self._buffers[0] if len(self._buffers) == 1
                 else jnp.concatenate(self._buffers, axis=0))
         flat = np.fromiter(

@@ -76,6 +76,7 @@ class BruteForceKnn(InnerIndex):
         self._dev_matrix = None  # (token, device (bucket,d) matrix)
         self._dev_valid = 0      # live rows in the bucketed device matrix
         self._host_mirror = None  # (token, np matrix) for the CPU latency tier
+        self._host_mirror_norm = None  # (token, L2-normed matrix) for cos
 
     def _ensure(self, dim: int) -> None:
         if self.matrix is None:
@@ -130,6 +131,7 @@ class BruteForceKnn(InnerIndex):
         self._device_cache = None
         self._dev_matrix = None
         self._host_mirror = None
+        self._host_mirror_norm = None
         self._version += 1
 
     def remove(self, key: int) -> None:
@@ -279,7 +281,15 @@ class BruteForceKnn(InnerIndex):
             m = self.host_matrix()
             if self.metric == "cos":
                 qn = q / (np.linalg.norm(q) + 1e-12)
-                mn = m / (np.linalg.norm(m, axis=1, keepdims=True) + 1e-12)
+                # normalized mirror cached per index version: re-norming the
+                # whole matrix per query dominated the r3 serving p50
+                if (
+                    self._host_mirror_norm is None
+                    or self._host_mirror_norm[0] != self._version
+                ):
+                    mn = m / (np.linalg.norm(m, axis=1, keepdims=True) + 1e-12)
+                    self._host_mirror_norm = (self._version, mn)
+                mn = self._host_mirror_norm[1]
                 scores = mn @ qn
             elif self.metric == "l2sq":
                 scores = -np.sum((m - q) ** 2, axis=1)

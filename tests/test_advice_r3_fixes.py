@@ -1,0 +1,192 @@
+"""Regression tests for the round-3 advisor findings (ADVICE.md r3):
+
+1. (med) JoinResult._rebind must not silently rewrite a join condition that
+   references a table related to the join side only by a user PROMISE
+   (promise_universe_is_subset_of): the side's same-named column may hold
+   different data.  Structural subsets (filter results) still rebind.
+2. (low) mssql snapshot mode: CREATE TABLE carries a PRIMARY KEY on the key
+   columns, and an upsert must not double-insert when the driver reports
+   rowcount == -1 (NOCOUNT / some ODBC configurations).
+3. (low) DeviceVecStore.gather([]) with pad_to must zero-fill instead of
+   indexing an empty buffer list; pad_to=0 is not conflated with None.
+4. (low) milvus writer validates primary-key dtype at write time (bool /
+   float / None keys would render into filter expressions that silently
+   miss the stored key).
+"""
+
+import numpy as np
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.internals import parse_graph as pg
+
+
+def _md(s):
+    return pw.debug.table_from_markdown(s)
+
+
+def _run():
+    pw.run(monitoring_level=pw.MonitoringLevel.NONE)
+
+
+# ---------------------------------------------------------------------------
+# 1. join-condition rebind: structural vs promise subsets
+
+
+def test_join_rebind_structural_subset_still_works():
+    pg.G.clear()
+    t = _md(
+        """
+        k | v
+        1 | 10
+        2 | 20
+        3 | 30
+        """
+    )
+    sub = t.filter(t.v > 10)  # structural subset of t
+    other = _md(
+        """
+        k | w
+        2 | 200
+        3 | 300
+        """
+    )
+    # condition references t (the structural superset of sub)
+    res = sub.join(other, t.k == other.k).select(k=other.k, v=sub.v,
+                                                w=other.w)
+    from pathway_tpu.engine.runner import run_tables
+
+    [cap] = run_tables(res)
+    assert sorted(row[2] for row in cap.squash().values()) == [200, 300]
+
+
+def test_join_rebind_rejects_promise_only_subset():
+    pg.G.clear()
+    a = _md(
+        """
+        k | v
+        1 | 10
+        """
+    )
+    c = _md(
+        """
+        k | v
+        1 | 99
+        """
+    )
+    other = _md(
+        """
+        k | w
+        1 | 100
+        """
+    )
+    # a is declared a subset of c only by promise — the tables are
+    # unrelated and a.v (10) != c.v (99)
+    a.promise_universe_is_subset_of(c)
+    with pytest.raises(ValueError, match="promise"):
+        a.join(other, c.v == other.w)
+
+
+# ---------------------------------------------------------------------------
+# 2. mssql snapshot writer: PK in DDL + rowcount == -1 upsert
+
+
+class _RecordingCursor:
+    def __init__(self, conn):
+        self.conn = conn
+        self.rowcount = -1  # DB-API-permitted "unknown"
+        self._result = []
+
+    def execute(self, sql, params=()):
+        q = " ".join(sql.split())
+        self.conn.executed.append((q, tuple(params)))
+        if q.startswith("IF OBJECT_ID"):
+            self._result = []
+        elif q.startswith("SELECT 1 FROM"):
+            key = params[0]
+            self._result = [(1,)] if key in self.conn.present else []
+        elif q.startswith("INSERT INTO"):
+            self.conn.present.add(params[0])
+            self.conn.inserts.append(tuple(params))
+            self._result = []
+        elif q.startswith("UPDATE") or q.startswith("DELETE"):
+            self._result = []
+        else:
+            raise AssertionError(f"unexpected SQL: {q}")
+
+    def fetchone(self):
+        return self._result[0] if self._result else None
+
+
+class _RecordingConn:
+    def __init__(self):
+        self.executed = []
+        self.inserts = []
+        self.present = set()
+
+    def cursor(self):
+        return _RecordingCursor(self)
+
+    def commit(self):
+        pass
+
+    def close(self):
+        pass
+
+
+def test_mssql_snapshot_pk_ddl_and_rowcount_unknown():
+    from pathway_tpu.io.mssql import _MssqlWriter
+
+    conn = _RecordingConn()
+    w = _MssqlWriter({"_connection": conn}, "snap", snapshot=True,
+                     primary_key=["name"], init_mode="create_if_not_exists")
+    # first wave inserts the row
+    w.write_batch(0, ["name", "age"], [(1, ("alice", "30"), 1)])
+    ddl = next(q for q, _p in conn.executed if "CREATE TABLE" in q)
+    assert "PRIMARY KEY ([name])" in ddl
+    assert "[name] NVARCHAR(450) NOT NULL" in ddl
+    assert len(conn.inserts) == 1
+    # second wave updates the same key; rowcount == -1 must NOT duplicate
+    w.write_batch(1, ["name", "age"], [(1, ("alice", "31"), 1)])
+    assert len(conn.inserts) == 1, "rowcount=-1 upsert double-inserted"
+    # existence probe ran instead
+    assert any(q.startswith("SELECT 1 FROM") for q, _p in conn.executed)
+
+
+# ---------------------------------------------------------------------------
+# 3. DeviceVecStore.gather on an empty store
+
+
+def test_device_store_gather_empty_with_pad():
+    from pathway_tpu.ops.device_store import DeviceVecStore
+
+    store = DeviceVecStore(4)
+    out = np.asarray(store.gather([], pad_to=8))
+    assert out.shape == (8, 4)
+    assert not out.any()
+    # pad_to=0 is an explicit zero-row request, not "no padding"
+    out0 = np.asarray(store.gather([], pad_to=0))
+    assert out0.shape == (0, 4)
+    assert np.asarray(store.gather([])).shape == (0, 4)
+
+
+# ---------------------------------------------------------------------------
+# 4. milvus primary-key dtype validation
+
+
+def test_milvus_rejects_float_primary_key():
+    pg.G.clear()
+
+    def fake_http(method, url, payload, headers):
+        return {"code": 0}
+
+    t = _md(
+        """
+        score | name
+        1.5   | a
+        """
+    )
+    pw.io.milvus.write(t, "http://x", "c", primary_key=t.score,
+                       _http=fake_http)
+    with pytest.raises(Exception, match="primary key"):
+        _run()
