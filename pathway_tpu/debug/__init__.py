@@ -277,7 +277,7 @@ def compute_and_print(
     squash_updates: bool = True,
     terminate_on_error: bool = True,
 ) -> None:
-    [cap] = _runner.run_tables(table)
+    [cap] = _runner.run_tables(table, terminate_on_error=terminate_on_error)
     state = cap.squash()
     keys = sorted(state.keys())
     if n_rows is not None:

@@ -26,6 +26,22 @@ from .types import Key, Row, Time, Update, consolidate, rows_equal
 
 _op_counter = itertools.count()
 
+# trace of the operator currently executing on this thread (error-log
+# provenance for poisoned ERROR values); scheduler-managed, thread-local
+import threading as _threading
+
+_tls = _threading.local()
+
+
+def _set_current_op_trace(trace):
+    prev = getattr(_tls, "op_trace", None)
+    _tls.op_trace = trace
+    return prev
+
+
+def current_op_trace():
+    return getattr(_tls, "op_trace", None)
+
 
 class Operator:
     """Base engine operator."""
@@ -39,6 +55,9 @@ class Operator:
         # observability (reference: ProberStats, src/engine/dataflow/monitoring.rs)
         self.rows_in = 0
         self.rows_out = 0
+        # user stack frame that created this operator's ParseGraph node
+        # (set by runner.lower; surfaced on engine errors)
+        self.trace = None
 
     def connect(self, *upstream: "Operator") -> "Operator":
         for port, up in enumerate(upstream):
@@ -161,6 +180,26 @@ class Scheduler:
             self.pending[time][down.id].append((port, updates))
         self._note_time(time)
 
+    def _invoke(self, op: Operator, fn, *args):
+        """Run one operator callback, attributing failures to the user code
+        that created the operator (reference: EngineErrorWithTrace,
+        graph_runner/__init__.py:228).  The error-log picks up the same
+        trace for poisoned-ERROR provenance via _CURRENT_OP_TRACE."""
+        from ..internals.trace import EngineErrorWithTrace
+
+        token = _set_current_op_trace(op.trace)
+        try:
+            return fn(*args)
+        except EngineErrorWithTrace:
+            raise
+        except Exception as exc:
+            raise EngineErrorWithTrace(
+                f"{type(exc).__name__}: {exc}", operator=op.name,
+                trace=op.trace,
+            ) from exc
+        finally:
+            _set_current_op_trace(token)
+
     # -- main loop ---------------------------------------------------------
     def step(self) -> bool:
         """Process the earliest pending time fully. Returns False when idle."""
@@ -182,10 +221,10 @@ class Scheduler:
                 if batches:
                     for port, updates in batches:
                         op.rows_in += len(updates)
-                        op.process(port, updates, t)
+                        self._invoke(op, op.process, port, updates, t)
                     # route() may have added to this time's bucket again
                     bucket = self.pending.get(t)
-            op.flush(t)
+            self._invoke(op, op.flush, t)
             bucket = self.pending.get(t)
         self.pending.pop(t, None)
         self.frontier = t

@@ -1034,9 +1034,18 @@ class OutputOperator(Operator):
                 if self.terminate_on_error:
                     for _k, row, _d in batch:
                         if any(isinstance(v, Error) for v in row):
+                            detail = ""
+                            from .telemetry import global_error_log
+
+                            if global_error_log.entries:
+                                e = global_error_log.entries[-1]
+                                detail = f"; last error: {e['message']}"
+                                if e.get("trace"):
+                                    detail += f" at {e['trace']}"
                             raise RuntimeError(
-                                "Error value reached an output (terminate_on_error "
-                                "is set); use pw.fill_error to handle it"
+                                "Error value reached an output "
+                                "(terminate_on_error is set); use "
+                                f"pw.fill_error to handle it{detail}"
                             )
                 self._on_time(time, batch)
 

@@ -110,6 +110,7 @@ def lower(sinks: list[pg.OpNode]) -> LoweredGraph:
             return lg.by_node[node.id]
         upstream = [build(t._node) for t in node.input_tables]
         op = _make_operator(node, lg)
+        op.trace = node.trace  # user file:line for error attribution
         lg.scheduler.register(op)
         op.connect(*upstream)
         lg.by_node[node.id] = op
@@ -514,7 +515,9 @@ class GraphRunner:
         return self.lg.captures
 
 
-def run_tables(*tables: Table) -> list[CapturedStream]:
+def run_tables(
+    *tables: Table, terminate_on_error: bool = False
+) -> list[CapturedStream]:
     """Capture the final update streams of the given tables (test harness —
     mirrors GraphRunner.run_tables, reference tests/utils.py:314).
 
@@ -522,7 +525,7 @@ def run_tables(*tables: Table) -> list[CapturedStream]:
     subjects that close when done) run the streaming loop until those
     sources finish; pure-static graphs take the batch path."""
     sinks = [t._materialize_capture() for t in tables]
-    runner = GraphRunner(sinks)
+    runner = GraphRunner(sinks, terminate_on_error=terminate_on_error)
     if has_live_sources(sinks):
         # the harness must terminate: sources that close when done (the
         # AsyncTransformer loop, finite connector subjects) finish the run;

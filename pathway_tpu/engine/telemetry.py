@@ -187,6 +187,16 @@ class ErrorLog:
         self.limit = 10_000
 
     def record(self, message: str, operator: str = "", trace: str = "") -> None:
+        if not trace:
+            # default provenance: the user stack frame that created the
+            # operator currently executing (set by the scheduler)
+            try:
+                from .graph import current_op_trace
+
+                t = current_op_trace()
+                trace = str(t) if t is not None else ""
+            except Exception:
+                pass
         with self._lock:
             if len(self.entries) < self.limit:
                 self.entries.append(

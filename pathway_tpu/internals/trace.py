@@ -20,6 +20,21 @@ class Trace:
         return f"{self.filename}:{self.line_number} :: {self.line}"
 
 
+class EngineErrorWithTrace(RuntimeError):
+    """An engine-side failure attributed to the user code that built the
+    failing operator (reference: EngineErrorWithTrace,
+    python/pathway/internals/trace.py + graph_runner/__init__.py:228)."""
+
+    def __init__(self, message: str, operator: str = "",
+                 trace: "Trace | None" = None):
+        self.operator = operator
+        self.trace = trace
+        loc = f"\n  operator: {operator}" if operator else ""
+        if trace is not None:
+            loc += f"\n  defined at {trace}"
+        super().__init__(f"{message}{loc}")
+
+
 def capture_trace() -> Trace | None:
     for frame in reversed(traceback.extract_stack()):
         fn = frame.filename

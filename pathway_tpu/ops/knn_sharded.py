@@ -33,8 +33,15 @@ def _sharded_topk_fn(mesh, axis: str, k: int, metric: str):
 
     import jax
     import jax.numpy as jnp
-    from jax.experimental.shard_map import shard_map
     from jax.sharding import PartitionSpec as P
+
+    try:
+        from jax import shard_map  # jax >= 0.8 (check_rep renamed)
+        _smap_kw = {"check_vma": False}
+    except ImportError:
+        from jax.experimental.shard_map import shard_map
+
+        _smap_kw = {"check_rep": False}
 
     def local_topk(m_shard, qs, n_live):
         # m_shard: (rows/n_dev, d) local rows; qs: (Q, d) replicated;
@@ -70,7 +77,7 @@ def _sharded_topk_fn(mesh, axis: str, k: int, metric: str):
             mesh=mesh,
             in_specs=(P(axis, None), P(), P()),
             out_specs=(P(), P()),
-            check_rep=False,
+            **_smap_kw,
         )
     )
     _FNS[key] = fn

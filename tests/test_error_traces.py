@@ -1,0 +1,87 @@
+"""Engine errors name the user's file:line (VERDICT r3 weak #7 / next #7).
+
+Reference: EngineErrorWithTrace — python/pathway/internals/trace.py +
+graph_runner/__init__.py:228: operators remember the user stack frame that
+created them, and engine-side failures surface it.
+"""
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.engine.runner import run_tables
+from pathway_tpu.engine.telemetry import global_error_log
+from pathway_tpu.internals import parse_graph as pg
+from pathway_tpu.internals.trace import EngineErrorWithTrace
+
+
+def test_operator_crash_names_user_line():
+    pg.G.clear()
+    t = pw.debug.table_from_markdown(
+        """
+        a
+        1
+        """
+    )
+
+    def boom(v):
+        raise RuntimeError("kaboom from udf")
+
+    bad = t.select(x=pw.apply(boom, t.a))  # TRACE_LINE
+    sink = bad._materialize_capture()
+    from pathway_tpu.engine.runner import GraphRunner
+
+    runner = GraphRunner([sink], terminate_on_error=True)
+    with pytest.raises(RuntimeError) as ei:
+        runner.run_batch()
+    msg = str(ei.value)
+    assert "test_error_traces.py" in msg, msg
+    # the reported line is the select() that built the failing operator
+    this_file = __file__
+    src = open(this_file).read().splitlines()
+    lineno = next(i + 1 for i, ln in enumerate(src) if "# TRACE_LINE" in ln)
+    assert f":{lineno}" in msg, msg
+
+
+def test_poisoned_error_log_carries_trace():
+    pg.G.clear()
+    global_error_log.clear()
+    t = pw.debug.table_from_markdown(
+        """
+        a | b
+        1 | 0
+        """
+    )
+    bad = t.select(x=t.a // t.b)  # DIV_LINE — poisons to ERROR, logged
+    [cap] = run_tables(bad)
+    rows = list(cap.squash().values())
+    assert len(rows) == 1
+    entries = [e for e in global_error_log.entries
+               if "ZeroDivision" in e["message"]]
+    assert entries, global_error_log.entries
+    assert "test_error_traces.py" in entries[-1]["trace"], entries[-1]
+
+
+def test_engine_error_with_trace_is_chained():
+    pg.G.clear()
+    t = pw.debug.table_from_markdown(
+        """
+        a
+        1
+        """
+    )
+
+    class _BadWriter:
+        def write_batch(self, *a):
+            raise ValueError("sink exploded")
+
+        def close(self):
+            pass
+
+    from pathway_tpu.internals import parse_graph as _pg
+
+    _pg.new_output_node("output", [t], colnames=t.column_names(),
+                        writer=_BadWriter())
+    with pytest.raises(EngineErrorWithTrace) as ei:
+        pw.run(monitoring_level=pw.MonitoringLevel.NONE)
+    assert isinstance(ei.value.__cause__, ValueError)
+    assert "sink exploded" in str(ei.value)

@@ -1,0 +1,156 @@
+"""interval_join time-bucketing (VERDICT r3 weak #4 / next #5): an
+`on`-less interval join must NOT degenerate into a single-key cross
+product.  Times shift into interval-width buckets, so the equi-join's
+output (pre-filter) is proportional to true temporal neighbours, not
+|L| x |R|.
+"""
+
+import datetime
+
+import pathway_tpu as pw
+from pathway_tpu.debug import table_from_rows
+from pathway_tpu.engine.operators import JoinOperator
+from pathway_tpu.engine.runner import GraphRunner
+from pathway_tpu.internals import parse_graph as pg
+from pathway_tpu.stdlib.temporal._interval_join import _bucket_fns
+
+
+class TimeSchema(pw.Schema):
+    t: int
+    tag: str
+
+
+def _build(n: int, lo: int, hi: int):
+    left = table_from_rows(TimeSchema, [(i, f"l{i}") for i in range(n)])
+    right = table_from_rows(TimeSchema, [(i, f"r{i}") for i in range(n)])
+    out = left.interval_join(
+        right, left.t, right.t, pw.temporal.interval(lo, hi)
+    ).select(a=left.tag, b=right.tag)
+    return out
+
+
+def test_interval_join_work_is_bucketed_not_cross_product():
+    pg.G.clear()
+    n = 400
+    out = _build(n, -1, 1)
+    runner = GraphRunner([out._materialize_capture()])
+    caps = runner.run_batch()
+    [cap] = caps.values()
+    state = cap.squash()
+    # correctness: each left row matches its <=3 temporal neighbours
+    assert len(state) == 3 * n - 2
+    pairs = set(state.values())
+    assert ("l5", "r5") in pairs and ("l5", "r6") in pairs \
+        and ("l5", "r4") in pairs
+    assert ("l5", "r7") not in pairs
+    # the work bound: the equi-join's emitted rows stay O(neighbours).
+    # A constant-bucket design emits n*n = 160,000 pre-filter rows here.
+    join_rows_out = sum(
+        op.rows_out for op in runner.lg.scheduler.operators
+        if isinstance(op, JoinOperator)
+    )
+    assert join_rows_out <= 8 * n, join_rows_out
+
+
+def test_interval_join_streaming_incremental_additions():
+    """Rows arriving over multiple engine times keep incremental work
+    bounded and results identical to the batch run."""
+    pg.G.clear()
+    n = 120
+    left = table_from_rows(
+        TimeSchema,
+        [(i, f"l{i}", 1 + (i % 6), 1) for i in range(n)],
+        is_stream=True,
+    )
+    right = table_from_rows(
+        TimeSchema,
+        [(i, f"r{i}", 1 + ((i + 3) % 6), 1) for i in range(n)],
+        is_stream=True,
+    )
+    out = left.interval_join(
+        right, left.t, right.t, pw.temporal.interval(-2, 0)
+    ).select(a=left.tag, b=right.tag)
+    runner = GraphRunner([out._materialize_capture()])
+    caps = runner.run_batch()
+    [cap] = caps.values()
+    state = cap.squash()
+    expected = {(f"l{i}", f"r{j}") for i in range(n) for j in range(n)
+                if -2 <= j - i <= 0}
+    assert set(state.values()) == expected
+    join_rows_out = sum(
+        op.rows_out for op in runner.lg.scheduler.operators
+        if isinstance(op, JoinOperator)
+    )
+    assert join_rows_out <= 10 * n, join_rows_out
+
+
+def test_interval_join_datetime_times():
+    pg.G.clear()
+
+    class DtSchema(pw.Schema):
+        t: object
+        tag: str
+
+    base = datetime.datetime(2026, 1, 1)
+    mins = datetime.timedelta(minutes=1)
+    left = table_from_rows(
+        DtSchema, [(base + i * mins, f"l{i}") for i in range(10)]
+    )
+    right = table_from_rows(
+        DtSchema, [(base + i * mins, f"r{i}") for i in range(10)]
+    )
+    out = left.interval_join(
+        right, left.t, right.t,
+        pw.temporal.interval(-mins, mins),
+    ).select(a=left.tag, b=right.tag)
+    from pathway_tpu.engine.runner import run_tables
+
+    [cap] = run_tables(out)
+    pairs = set(cap.squash().values())
+    assert ("l3", "r2") in pairs and ("l3", "r3") in pairs \
+        and ("l3", "r4") in pairs
+    assert ("l3", "r5") not in pairs
+    assert len(pairs) == 28
+
+
+def test_interval_join_point_interval():
+    pg.G.clear()
+    left = table_from_rows(TimeSchema, [(0, "l0"), (5, "l5")])
+    right = table_from_rows(TimeSchema, [(3, "r3"), (8, "r8"), (4, "r4")])
+    # point interval: right.t - left.t == 3 exactly
+    out = left.interval_join(
+        right, left.t, right.t, pw.temporal.interval(3, 3)
+    ).select(a=left.tag, b=right.tag)
+    from pathway_tpu.engine.runner import run_tables
+
+    [cap] = run_tables(out)
+    assert set(cap.squash().values()) == {("l0", "r3"), ("l5", "r8")}
+
+
+def test_interval_join_float_times():
+    pg.G.clear()
+
+    class FSchema(pw.Schema):
+        t: float
+        tag: str
+
+    left = table_from_rows(FSchema, [(0.5, "a"), (2.5, "b")])
+    right = table_from_rows(FSchema, [(1.0, "x"), (3.9, "y")])
+    out = left.interval_join(
+        right, left.t, right.t, pw.temporal.interval(-0.75, 0.75)
+    ).select(a=left.tag, b=right.tag)
+    from pathway_tpu.engine.runner import run_tables
+
+    [cap] = run_tables(out)
+    assert set(cap.squash().values()) == {("a", "x")}
+
+
+def test_bucket_fns_cover_window_exactly():
+    lb, rb = _bucket_fns(-2, 2)
+    for t in range(-10, 10):
+        probed = lb(t)
+        for s in range(-15, 15):
+            if -2 <= s - t <= 2:
+                assert rb(s) in probed, (t, s, probed, rb(s))
+    # None times never match and never crash
+    assert lb(None) == () and rb(None) is None
