@@ -20,6 +20,10 @@ class ColumnDefinition:
     primary_key: bool = False
     default_value: Any = ...
     name: str | None = None
+    # OpenAPI documentation traits (reference: internals/schema.py
+    # ColumnDefinition.description/example, surfaced by io/http/_server.py)
+    description: str | None = None
+    example: Any = None
 
     def has_default(self) -> bool:
         return self.default_value is not ...
@@ -31,12 +35,16 @@ def column_definition(
     default_value: Any = ...,
     dtype: Any = None,
     name: str | None = None,
+    description: str | None = None,
+    example: Any = None,
 ) -> Any:
     return ColumnDefinition(
         dtype=dt.wrap(dtype) if dtype is not None else dt.ANY,
         primary_key=primary_key,
         default_value=default_value,
         name=name,
+        description=description,
+        example=example,
     )
 
 
