@@ -112,6 +112,21 @@ def main(argv: list[str] | None = None) -> int:
 
     sub.add_parser("spawn-from-env", help="spawn using PATHWAY_SPAWN_PROGRAM env")
 
+    sub.add_parser("dashboard", add_help=False,
+                   help="serve the web dashboard over recorded metrics")
+
+    rp = sub.add_parser("run", help="run a YAML app template")
+    rp.add_argument("template", help="path to app.yaml")
+    rp.add_argument("--host", default="0.0.0.0")
+    rp.add_argument("--port", type=int, default=8080)
+    rp.add_argument("--timeout-s", type=float, default=None)
+
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if argv[:1] == ["dashboard"]:
+        # delegate the whole surface (single source of truth incl. --help)
+        from .web_dashboard.dashboard import main as dashboard_main
+
+        return dashboard_main(argv[1:])
     args = parser.parse_args(argv)
     if args.command == "spawn":
         program = args.program
@@ -123,7 +138,46 @@ def main(argv: list[str] | None = None) -> int:
                      first_port=args.first_port, record=args.record)
     if args.command == "spawn-from-env":
         return spawn_from_env()
+    if args.command == "run":
+        return run_template(args.template, host=args.host, port=args.port,
+                            timeout_s=args.timeout_s)
     return 2
+
+
+def run_template(path: str, *, host: str = "0.0.0.0", port: int = 8080,
+                 timeout_s: float | None = None) -> int:
+    """Load and run a YAML app template (reference: examples/templates/ run
+    via `pathway spawn`).  Conventions, in precedence order:
+
+    - `question_answerer:` → served with QARestServer at host:port
+    - `document_store:` (top-level, no answerer) → DocumentStoreServer
+    - anything else: the yaml's side effects (io writes) ran at load time;
+      pw.run() executes them.  `persistence_config:` is honored.
+    """
+    from . import load_yaml
+
+    with open(path) as f:
+        app = load_yaml(f, host=host, port=port)
+    run_kwargs = {}
+    if isinstance(app, dict) and app.get("persistence_config") is not None:
+        run_kwargs["persistence_config"] = app["persistence_config"]
+    if timeout_s is not None:
+        run_kwargs["timeout_s"] = timeout_s
+    qa = app.get("question_answerer") if isinstance(app, dict) else None
+    store = app.get("document_store") if isinstance(app, dict) else None
+    if qa is not None:
+        from .xpacks.llm.servers import QARestServer
+
+        QARestServer(host, port, qa).run(**run_kwargs)
+    elif store is not None:
+        from .xpacks.llm.servers import DocumentStoreServer
+
+        DocumentStoreServer(host, port, store).run(**run_kwargs)
+    else:
+        from . import run as pw_run
+
+        pw_run(**run_kwargs)
+    return 0
 
 
 if __name__ == "__main__":

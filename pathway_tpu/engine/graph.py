@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import heapq
 import itertools
+import time as _time
 from collections import defaultdict
 from typing import Any, Callable, Iterable
 
@@ -55,6 +56,8 @@ class Operator:
         # observability (reference: ProberStats, src/engine/dataflow/monitoring.rs)
         self.rows_in = 0
         self.rows_out = 0
+        self.rows_out_neg = 0  # retractions emitted (diff < 0)
+        self.busy_s = 0.0  # wall time spent inside process()/flush()
         # user stack frame that created this operator's ParseGraph node
         # (set by runner.lower; surfaced on engine errors)
         self.trace = None
@@ -80,6 +83,7 @@ class Operator:
         if not updates:
             return
         self.rows_out += len(updates)
+        self.rows_out_neg += sum(1 for _k, _r, d in updates if d < 0)
         assert self.scheduler is not None
         self.scheduler.route(self, time, updates)
 
@@ -188,6 +192,7 @@ class Scheduler:
         from ..internals.trace import EngineErrorWithTrace
 
         token = _set_current_op_trace(op.trace)
+        t0 = _time.perf_counter()
         try:
             return fn(*args)
         except EngineErrorWithTrace:
@@ -198,6 +203,7 @@ class Scheduler:
                 trace=op.trace,
             ) from exc
         finally:
+            op.busy_s += _time.perf_counter() - t0
             _set_current_op_trace(token)
 
     # -- main loop ---------------------------------------------------------

@@ -79,13 +79,36 @@ def run(
     finally:
         _build_span.__exit__(None, None, None)
 
-    metrics = reporter = dashboard = None
+    metrics = reporter = dashboard = recorder = None
     if with_http_server:
         from ..engine.telemetry import MetricsServer
 
         metrics = MetricsServer(scheduler)
         metrics.fabric = getattr(runner, "fabric", None)
         metrics.start()
+    import os as _os
+
+    _metrics_dir = _os.environ.get("PATHWAY_DETAILED_METRICS_DIR")
+    if _metrics_dir:
+        # detailed-metrics recording for the web dashboard (reference:
+        # web_dashboard/db.py reads metrics_*.db from this directory)
+        from ..web_dashboard.db import MetricsRecorder
+
+        recorder = MetricsRecorder(
+            scheduler, _metrics_dir,
+            worker_id=pathway_config.process_id,
+            graph={
+                "nodes": [
+                    {"id": op.id, "name": op.name} for op in scheduler.operators
+                ],
+                "edges": [
+                    [up.id, op.id]
+                    for op in scheduler.operators
+                    for up in op.inputs
+                ],
+            },
+        )
+        recorder.start()
     from ..internals.monitoring import MonitoringDashboard, MonitoringLevel
 
     if monitoring_level not in (None, MonitoringLevel.NONE):
@@ -140,6 +163,8 @@ def run(
             reporter.stop()
         if metrics is not None:
             metrics.stop()
+        if recorder is not None:
+            recorder.stop()
     if global_error_log.entries:
         first = global_error_log.entries[0]
         import logging

@@ -14,8 +14,18 @@ def _resolve_symbol(path: str):
     if path.startswith("pw."):
         mod = importlib.import_module("pathway_tpu")
         obj: Any = mod
+        import types
+
         for part in path[3:].split("."):
-            obj = getattr(obj, part)
+            try:
+                obj = getattr(obj, part)
+            except AttributeError:
+                # lazily-loaded subpackage (e.g. pw.xpacks.llm.*); only
+                # modules can have importable children — a missing attribute
+                # on a class/function is the user's typo, keep that error
+                if not isinstance(obj, types.ModuleType):
+                    raise
+                obj = importlib.import_module(f"{obj.__name__}.{part}")
         return obj
     parts = path.split(".")
     for i in range(len(parts), 0, -1):

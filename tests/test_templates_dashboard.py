@@ -1,0 +1,126 @@
+"""Template gallery + web dashboard (VERDICT r3 next #9).
+
+Reference: examples/templates/*/app.yaml run via the CLI, and
+python/pathway/web_dashboard/ (metrics_*.db sqlite + served endpoints).
+"""
+
+import json
+import os
+import socket
+import time
+import urllib.request
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.cli import run_template
+from pathway_tpu.internals import parse_graph as pg
+
+TEMPLATES = os.path.join(os.path.dirname(__file__), "..", "examples", "templates")
+
+
+def _free_port() -> int:
+    sock = socket.socket()
+    sock.bind(("127.0.0.1", 0))
+    port = sock.getsockname()[1]
+    sock.close()
+    return port
+
+
+def _load(name, **vars):  # noqa: A002
+    with open(os.path.join(TEMPLATES, name, "app.yaml")) as f:
+        return pw.load_yaml(f, **vars)
+
+
+def test_adaptive_rag_template_builds(tmp_path):
+    pg.G.clear()
+    (tmp_path / "doc.txt").write_text("z-sets are weighted multisets")
+    app = _load("adaptive-rag", DOCS_DIR=str(tmp_path))
+    qa = app["question_answerer"]
+    from pathway_tpu.xpacks.llm.question_answering import (
+        AdaptiveRAGQuestionAnswerer,
+    )
+
+    assert isinstance(qa, AdaptiveRAGQuestionAnswerer)
+    assert qa.indexer is not None
+    assert len(pg.G.nodes) > 0  # DocumentStore pipeline registered nodes
+
+
+def test_document_store_template_builds(tmp_path):
+    pg.G.clear()
+    (tmp_path / "a.txt").write_text("alpha beta gamma")
+    app = _load("document-store", DOCS_DIR=str(tmp_path))
+    from pathway_tpu.xpacks.llm.document_store import DocumentStore
+
+    assert isinstance(app["document_store"], DocumentStore)
+
+
+def test_el_pipeline_template_builds():
+    pg.G.clear()
+    app = _load(
+        "el-pipeline",
+        KAFKA_HOSTNAME="localhost:9092", KAFKA_GROUP_ID="g", KAFKA_TOPIC="t",
+        DB_HOSTNAME="localhost", DB_PORT="5432", DB_NAME="db", DB_USER="u",
+        DB_PASSWORD="p",
+    )
+    # source + sink registered on the graph without touching the network
+    assert len(pg.G.outputs) == 1
+    assert app.get("output") is None  # io.*.write returns None
+
+
+def test_live_etl_template_runs_end_to_end(tmp_path):
+    pg.G.clear()
+    out = tmp_path / "out.jsonl"
+    os.environ["OUTPUT_PATH"] = str(out)
+    try:
+        run_template(
+            os.path.join(TEMPLATES, "live-etl", "app.yaml"), timeout_s=8.0
+        )
+    finally:
+        del os.environ["OUTPUT_PATH"]
+    rows = [json.loads(ln) for ln in out.read_text().splitlines()]
+    assert sorted(r["value"] for r in rows) == list(range(50))
+
+
+def test_dashboard_records_and_serves(tmp_path):
+    pg.G.clear()
+    os.environ["PATHWAY_DETAILED_METRICS_DIR"] = str(tmp_path)
+    try:
+        t = pw.demo.range_stream(nb_rows=30, input_rate=500)
+        agg = t.reduce(total=pw.reducers.sum(t.value))
+        pw.io.subscribe(agg, on_change=lambda *a, **k: None)
+        pw.run(idle_stop_s=1.0, monitoring_level=pw.MonitoringLevel.NONE)
+    finally:
+        del os.environ["PATHWAY_DETAILED_METRICS_DIR"]
+
+    dbs = [f for f in os.listdir(tmp_path) if f.startswith("metrics_")]
+    assert dbs, "no metrics db recorded"
+
+    from pathway_tpu.web_dashboard import DashboardServer
+
+    port = _free_port()
+    srv = DashboardServer(str(tmp_path), "127.0.0.1", port, wait_for_db=False)
+    srv.start()
+    try:
+        def get(path):
+            return json.loads(urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}", timeout=10).read())
+
+        latest = get("/metrics/latest")
+        assert latest and any(r["rows_positive"] > 0 for r in latest)
+        rng = get("/metrics/available_range")
+        assert rng["min"] is not None and rng["max"] >= rng["min"]
+        graph = get("/graph")
+        names = [n["name"] for n in graph["nodes"]]
+        assert any("reduce" in n or "groupby" in n for n in names), names
+        assert graph["edges"], "graph has no edges"
+        at = get(f"/metrics/at/{rng['max'] + 10_000}")
+        assert at  # a snapshot strictly before a future ts exists
+        charts = get("/metrics/charts")
+        assert isinstance(charts, list)
+        # frontend served
+        html = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/", timeout=10).read().decode()
+        assert "pathway-tpu" in html
+    finally:
+        srv.stop()
