@@ -498,7 +498,15 @@ class GraphRunner:
                     # standalone or at a bound: keep running
             if timeout_s is not None and now - start > timeout_s:
                 break
-            if idle_stop_s is not None and now - last_event > idle_stop_s:
+            if any(
+                getattr(s, "replay_backfill_pending", False) for _o, s in live
+            ):
+                # a paced journal backfill (realtime_replay) is in progress:
+                # waiting for the next recorded gap is activity, not
+                # idleness — idle_stop must not truncate the backfill
+                # (timeout_s stays a hard cap)
+                last_event = now
+            elif idle_stop_s is not None and now - last_event > idle_stop_s:
                 break
         # graceful drain even on rescale: flush buffered sink output first
         for op in self.lg.scheduler.topo_order():

@@ -55,8 +55,13 @@ def coerce_value(v: Any, d: dt.DType):
 
 
 def make_input_table(
-    schema: SchemaMetaclass, source: DataSource, name: str = "io"
+    schema: SchemaMetaclass, source: DataSource, name: str = "io",
+    persistent_id: str | None = None,
 ) -> Table:
+    if persistent_id is not None:
+        # opt-in marker for selective_persisting (reference: connectors with
+        # explicit persistent ids are the only ones persisted in that mode)
+        source.persistent_id = persistent_id
     node = pg.new_node("input", [], source=source)
     return Table(node, schema.column_names(), dict(schema.dtypes()), Universe(), name=name)
 

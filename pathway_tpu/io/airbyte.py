@@ -400,4 +400,4 @@ def read(
         },
         name="AirbyteRecord",
     )
-    return make_input_table(schema, ds, name=name or "airbyte")
+    return make_input_table(schema, ds, name=name or "airbyte", persistent_id=kwargs.get("persistent_id"))

@@ -164,7 +164,7 @@ def read(settings: ClickHouseSettings, table_name: str,
         poll_interval_s = autocommit_duration_ms / 1000.0
     source = ClickHouseSource(settings, table_name, schema,
                               poll_interval_s, mode)
-    return make_input_table(schema, source, name=f"clickhouse:{table_name}")
+    return make_input_table(schema, source, name=f"clickhouse:{table_name}", persistent_id=kwargs.get("persistent_id"))
 
 
 class _ClickHouseWriter:

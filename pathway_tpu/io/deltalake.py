@@ -334,4 +334,4 @@ def read(
         uri, schema, mode, poll_interval_s=poll_interval_s,
         has_diff_columns=has_diff_columns,
     )
-    return make_input_table(schema, source, name=f"deltalake:{uri}")
+    return make_input_table(schema, source, name=f"deltalake:{uri}", persistent_id=kwargs.get("persistent_id"))

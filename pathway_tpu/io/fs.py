@@ -115,11 +115,11 @@ def read(
             events = []
             for f in sorted(files):
                 events.extend(events_from_dicts(parse_file(f), sch, seed=f))
-            return make_input_table(sch, StaticDataSource(events), name="fs")
+            return make_input_table(sch, StaticDataSource(events), name="fs", persistent_id=kwargs.get("persistent_id"))
         source = FilePollingSource(path, parse_file, sch)
         if with_metadata:
             source.cache_metadata_fn = _metadata_for
-        return make_input_table(sch, source, name="fs")
+        return make_input_table(sch, source, name="fs", persistent_id=kwargs.get("persistent_id"))
     raise ValueError(f"unknown format {format!r}")
 
 

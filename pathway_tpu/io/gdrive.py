@@ -220,4 +220,4 @@ def read(
         colnames.append("_metadata")
     ds = SubjectDataSource(subject, colnames, None, append_only=False)
     schema = schema_builder(cols, name="GDriveFile")
-    return make_input_table(schema, ds, name=name or "gdrive")
+    return make_input_table(schema, ds, name=name or "gdrive", persistent_id=kwargs.get("persistent_id"))

@@ -397,4 +397,4 @@ def read(catalog_uri_or_path: str, *, namespace=None,
     if poll_interval_s is None:
         poll_interval_s = autocommit_duration_ms / 1000.0
     source = IcebergSource(path, schema, mode, poll_interval_s)
-    return make_input_table(schema, source, name=f"iceberg:{path}")
+    return make_input_table(schema, source, name=f"iceberg:{path}", persistent_id=kwargs.get("persistent_id"))

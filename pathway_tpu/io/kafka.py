@@ -247,7 +247,7 @@ def read(
         )
     source = KafkaSource(rdkafka_settings, topic, format, schema,
                          schema_registry=schema_registry_settings)
-    return make_input_table(schema, source, name=f"kafka:{topic}")
+    return make_input_table(schema, source, name=f"kafka:{topic}", persistent_id=kwargs.get("persistent_id"))
 
 
 class KafkaWriter:

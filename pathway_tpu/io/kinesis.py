@@ -182,7 +182,7 @@ def read(stream_name: str, *, schema: SchemaMetaclass | None = None,
             )},
             name="KinesisRecord",
         )
-    return make_input_table(schema, src, name=f"kinesis:{stream_name}")
+    return make_input_table(schema, src, name=f"kinesis:{stream_name}", persistent_id=kwargs.get("persistent_id"))
 
 
 class _KinesisWriter:

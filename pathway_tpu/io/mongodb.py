@@ -144,4 +144,4 @@ def read(connection_string: str, database: str, collection: str, *,
         poll_interval_s=poll_interval_s, live=(mode == "streaming"),
         _client=kwargs.get("_client"),
     )
-    return make_input_table(schema, src, name=f"mongodb:{collection}")
+    return make_input_table(schema, src, name=f"mongodb:{collection}", persistent_id=kwargs.get("persistent_id"))

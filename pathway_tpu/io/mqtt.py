@@ -173,7 +173,7 @@ def read(uri: str, *, topic: str, schema: SchemaMetaclass | None = None,
     source = SubjectDataSource(
         subject, schema.column_names(), None, append_only=True
     )
-    return make_input_table(schema, source, name=f"mqtt:{topic}")
+    return make_input_table(schema, source, name=f"mqtt:{topic}", persistent_id=kwargs.get("persistent_id"))
 
 
 class _MqttWriter:

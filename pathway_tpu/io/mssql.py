@@ -287,7 +287,7 @@ def read(connection_string, table_name: str, schema: SchemaMetaclass, *,
         mode=mode,
         poll_interval_s=(autocommit_duration_ms or 1500) / 1000.0,
     )
-    return make_input_table(schema, source, name=f"mssql:{table_name}")
+    return make_input_table(schema, source, name=f"mssql:{table_name}", persistent_id=kwargs.get("persistent_id"))
 
 
 class _MssqlWriter:

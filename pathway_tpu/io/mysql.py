@@ -167,7 +167,7 @@ def read(
         mysql_settings, table_name, schema,
         poll_interval_s=poll_interval_s, mode=mode,
     )
-    return make_input_table(schema, source, name=f"mysql:{table_name}")
+    return make_input_table(schema, source, name=f"mysql:{table_name}", persistent_id=kwargs.get("persistent_id"))
 
 
 class _MysqlWriter:

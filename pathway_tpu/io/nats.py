@@ -159,7 +159,7 @@ def read(uri: str, *, topic: str, schema: SchemaMetaclass | None = None,
         )
     colnames = schema.column_names()
     source = SubjectDataSource(subject, colnames, None, append_only=True)
-    return make_input_table(schema, source, name=f"nats:{topic}")
+    return make_input_table(schema, source, name=f"nats:{topic}", persistent_id=kwargs.get("persistent_id"))
 
 
 class _NatsWriter:

@@ -172,7 +172,8 @@ def read(source, *, path: str = "",
          mode: Literal["streaming", "static"] = "streaming",
          format: Literal["binary", "only_metadata"] = "binary",  # noqa: A002
          with_metadata: bool = False, name: str | None = None,
-         max_backlog_size: int | None = None) -> Table:
+         max_backlog_size: int | None = None,
+         persistent_id: str | None = None) -> Table:
     """Read a table from a PyFilesystem source."""
     if format not in ("binary", "only_metadata"):
         raise ValueError(f"unknown format {format!r}")
@@ -183,4 +184,4 @@ def read(source, *, path: str = "",
         source, path, format=format, with_metadata=with_metadata,
         refresh_interval_s=float(refresh_interval), mode=mode,
     )
-    return make_input_table(sch, src, name=name or "pyfilesystem")
+    return make_input_table(sch, src, name=name or "pyfilesystem", persistent_id=persistent_id)

@@ -84,7 +84,7 @@ def read(
     source = SubjectDataSource(subject, colnames, pk_positions)
     subject._colnames = colnames
     subject._dtypes = dict(schema.dtypes())
-    return make_input_table(schema, source, name=name or "python")
+    return make_input_table(schema, source, name=name or "python", persistent_id=kwargs.get("persistent_id"))
 
 
 class InteractiveCsvPlayer(ConnectorSubject):  # pragma: no cover - interactive

@@ -195,4 +195,4 @@ def read(http_url: str, table_name: str, schema: SchemaMetaclass, *,
         http_url, table_name, schema, poll_interval_s, mode,
         _http=kwargs.pop("_http", None),
     )
-    return make_input_table(schema, source, name=f"questdb:{table_name}")
+    return make_input_table(schema, source, name=f"questdb:{table_name}", persistent_id=kwargs.get("persistent_id"))

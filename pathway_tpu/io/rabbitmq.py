@@ -269,7 +269,7 @@ def read(uri: str, *, queue_name: str, schema: SchemaMetaclass | None = None,
     source = SubjectDataSource(
         subject, schema.column_names(), None, append_only=True
     )
-    return make_input_table(schema, source, name=f"rabbitmq:{queue_name}")
+    return make_input_table(schema, source, name=f"rabbitmq:{queue_name}", persistent_id=kwargs.get("persistent_id"))
 
 
 class _RabbitWriter:

@@ -272,7 +272,7 @@ def read(
         settings, bucket, prefix, format, schema,
         live=(mode == "streaming"),
     )
-    return make_input_table(schema, src, name=name or f"s3:{bucket}/{prefix}")
+    return make_input_table(schema, src, name=name or f"s3:{bucket}/{prefix}", persistent_id=kwargs.get("persistent_id"))
 
 
 def read_from_digital_ocean(path, do_s3_settings, **kw) -> Table:

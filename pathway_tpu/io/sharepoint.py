@@ -235,4 +235,4 @@ def read(url: str = "", *, tenant: str = "", client_id: str = "",
     if with_metadata:
         cols["_metadata"] = ColumnDefinition(dtype=dt.JSON)
     schema = schema_builder(cols, name="SharePointFile")
-    return make_input_table(schema, source, name=f"sharepoint:{root_path}")
+    return make_input_table(schema, source, name=f"sharepoint:{root_path}", persistent_id=kwargs.get("persistent_id"))

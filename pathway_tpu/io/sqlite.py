@@ -132,7 +132,7 @@ def read(
     source = SqliteSnapshotSource(
         path, table_name, schema, poll_interval_s=poll_interval_s, mode=mode
     )
-    return make_input_table(schema, source, name=f"sqlite:{table_name}")
+    return make_input_table(schema, source, name=f"sqlite:{table_name}", persistent_id=kwargs.get("persistent_id"))
 
 
 class SqliteWriter:

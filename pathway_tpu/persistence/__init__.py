@@ -305,14 +305,40 @@ class Config:
     @classmethod
     def simple_config(cls, backend: Backend, persistence_mode: str = "persisting",
                       snapshot_interval_ms: int = 0, **kwargs) -> "Config":
-        return cls(backend, snapshot_interval_ms, persistence_mode)
+        return cls(backend, snapshot_interval_ms=snapshot_interval_ms,
+                   persistence_mode=persistence_mode)
+
+    #: full PersistenceMode matrix (reference: src/connectors/mod.rs:140-148)
+    #: - persisting (default): journal + replay, distinct times preserved
+    #: - speedrun_replay: journal + replay preserving every recorded commit
+    #:   time, injected as fast as downstream keeps up (alias of the default
+    #:   replay path, named for parity)
+    #: - realtime_replay: replay paced by the recorded wall-clock gaps
+    #:   between journal records
+    #: - batch: replay collapses onto a single logical time
+    #: - selective_persisting: only sources created with a persistent_id
+    #:   are journaled/replayed
+    #: - udf_caching: no input journaling; only UDF caches persist
+    #: - operator_persisting: operator snapshots + journal tail
+    MODES = (
+        "persisting", "speedrun_replay", "realtime_replay", "batch",
+        "selective_persisting", "udf_caching", "operator_persisting",
+    )
 
     def __init__(self, backend: Backend | None = None, *, snapshot_interval_ms: int = 0,
                  persistence_mode: str = "persisting", cache_objects: bool = True,
                  **kwargs):
         self.backend = backend
         self.snapshot_interval_ms = snapshot_interval_ms
-        self.persistence_mode = persistence_mode
+        mode = persistence_mode
+        if hasattr(mode, "value"):  # pw.PersistenceMode enum member
+            mode = mode.value
+        if mode not in self.MODES:
+            raise ValueError(
+                f"unknown persistence_mode {persistence_mode!r}; "
+                f"expected one of {self.MODES}"
+            )
+        self.persistence_mode = mode
         # raw-object caching (CachedObjectStorage); on by default like the
         # reference's scanner-backed connectors
         self.cache_objects = cache_objects
@@ -394,6 +420,12 @@ def attach_persistence(runner, config: Config) -> None:
     backend = config.backend
     if backend is None:
         return
+    mode = getattr(config, "persistence_mode", "persisting")
+    if mode == "udf_caching":
+        # only UDF caches persist (reference: PersistenceMode::UdfCaching);
+        # udf cache backends are configured on the UDFs themselves
+        # (internals/udfs.py) — no input journaling, no snapshots
+        return
     lg = runner.lg
     nprocs = getattr(runner, "nprocs", 1)
     pid = getattr(runner, "pid", 0)
@@ -429,7 +461,11 @@ def attach_persistence(runner, config: Config) -> None:
     snapshots_on = (
         config.snapshot_interval_ms > 0
         or config.persistence_mode == "operator_persisting"
-    )
+    ) and mode != "selective_persisting"
+    # selective mode cannot take operator snapshots: restored operator state
+    # would fold events of NON-persisted sources (which replay from scratch
+    # at their original times), double-applying them and violating the
+    # restored-frontier invariant for fresh pushes
     snap = None
     if snapshots_on:
         from . import snapshots as snapmod
@@ -437,6 +473,12 @@ def attach_persistence(runner, config: Config) -> None:
         snap = snapmod.try_restore(runner, backend, {})
     journal_seqs: dict[str, int] = {}
     for idx, (op, source) in enumerate(lg.input_ops):
+        if mode == "selective_persisting" and not getattr(
+            source, "persistent_id", None
+        ):
+            # only explicitly-named sources persist
+            # (reference: PersistenceMode::SelectivePersisting)
+            continue
         base_stream = _stream_name(idx, source)
         write_stream = (
             f"{base_stream}__p{pid}" if nprocs > 1 else base_stream
@@ -450,6 +492,7 @@ def attach_persistence(runner, config: Config) -> None:
         # so snapshot watermarks survive journal trimming; offsets travel
         # inside records so journal+offsets commit atomically
         replayed: list = []
+        replay_records: list = []  # (wall_ts, events) per surviving record
         last_offsets: dict | None = None
         if snap is not None and idx in snap.get("offsets", {}):
             so = snap["offsets"][idx]
@@ -469,7 +512,7 @@ def attach_persistence(runner, config: Config) -> None:
             raw = backend.read_all(rs)
             max_seq = -1
             for i, rec in enumerate(raw):
-                seq, events, offsets = _parse_record(rec, i)
+                seq, events, offsets, wall_ts = _parse_record(rec, i)
                 max_seq = max(max_seq, seq)
                 if seq <= fold_seq:
                     for e in events:
@@ -478,6 +521,7 @@ def attach_persistence(runner, config: Config) -> None:
                 n_records += 1
                 keep_raw.append(rec)
                 replayed.extend(events)
+                replay_records.append((wall_ts, events))
                 if offsets is not None:
                     if last_offsets is None:
                         last_offsets = dict(offsets)
@@ -516,6 +560,7 @@ def attach_persistence(runner, config: Config) -> None:
                 base_stream, [pickle.dumps((seq, compacted, last_offsets))]
             )
             replayed = compacted
+            replay_records = [(None, compacted)]
         _wrap_source_with_persistence(
             source, backend, write_stream, replayed, last_offsets,
             owns_event=owns_event if nprocs > 1 else None,
@@ -523,6 +568,8 @@ def attach_persistence(runner, config: Config) -> None:
             seq_holder=journal_seqs,
             folded_counts=fold_counts,
             min_time=snap["frontier"] if snap is not None else None,
+            mode=mode,
+            replay_records=replay_records,
         )
         if getattr(source, "supports_object_cache", False) and getattr(
             config, "cache_objects", True
@@ -545,12 +592,15 @@ def attach_persistence(runner, config: Config) -> None:
 
 
 def _parse_record(rec: bytes, position: int):
-    """(seq, events, offsets) — legacy 2-tuple records get positional seqs."""
+    """(seq, events, offsets, wall_ts) — 3-tuple records (pre wall-clock
+    stamp) get wall_ts=None; legacy 2-tuples also get positional seqs."""
     data = pickle.loads(rec)
-    if len(data) == 3:
+    if len(data) == 4:
         return data
+    if len(data) == 3:
+        return (*data, None)
     events, offsets = data
-    return position, events, offsets
+    return position, events, offsets, None
 
 
 def _stream_name(idx: int, source) -> str:
@@ -559,7 +609,11 @@ def _stream_name(idx: int, source) -> str:
     process-global counter and MUST NOT leak into stream names.)"""
     import re
 
-    desc = getattr(source, "path", None) or type(source).__name__
+    desc = (
+        getattr(source, "persistent_id", None)
+        or getattr(source, "path", None)
+        or type(source).__name__
+    )
     safe = re.sub(r"[^A-Za-z0-9_.-]", "_", str(desc))[-80:]
     return f"input_{idx}_{safe}"
 
@@ -593,7 +647,9 @@ def _wrap_source_with_persistence(source, backend: Backend, stream: str,
                                   is_replay_injector: bool = True,
                                   seq_holder: dict | None = None,
                                   folded_counts=None,
-                                  min_time=None) -> None:
+                                  min_time=None,
+                                  mode: str = "persisting",
+                                  replay_records: list | None = None) -> None:
     """`owns_event` (cluster mode) filters what THIS process journals, so the
     union of all processes' streams is exactly one copy of the input.
     `is_replay_injector` gates live-source replay to a single process —
@@ -611,11 +667,21 @@ def _wrap_source_with_persistence(source, backend: Backend, stream: str,
     if seq_holder is None:
         seq_holder = {}
     seq_holder.setdefault(stream, -1)
+    if mode == "batch" and replayed:
+        # batch replay collapses history onto one logical time (reference:
+        # PersistenceMode::Batch AdvanceTime-at-start); max keeps the
+        # replayed frontier so fresh live events still land after it
+        t_new = max(e[0] for e in replayed)
+        replayed = [(t_new, k, row, d) for (_t0, k, row, d) in replayed]
 
     def _append(events, offsets):
+        import time as _t
+
         seq_holder[stream] += 1
+        # wall-clock stamp: realtime_replay paces a later restart by the
+        # recorded inter-record gaps
         backend.append(
-            stream, pickle.dumps((seq_holder[stream], events, offsets))
+            stream, pickle.dumps((seq_holder[stream], events, offsets, _t.time()))
         )
 
     # restore the reader's offset frontier so already-consumed rows are not
@@ -682,13 +748,58 @@ def _wrap_source_with_persistence(source, backend: Backend, stream: str,
 
     source.static_events = static_events
     if source.is_live():
-        pending = (
-            [list(replayed)] if replayed and is_replay_injector else []
-        )
+        if mode == "realtime_replay" and replayed and is_replay_injector:
+            # pace the backfill by the recorded wall-clock gaps between
+            # journal records (reference: PersistenceMode::RealtimeReplay);
+            # live reads resume once the queue drains
+            import time as _tm
 
-        def poll_with_replay():
-            if pending:
-                return pending.pop()
-            return journaling_poll()
+            batches = [(w, _retime(ev)) for (w, ev) in (replay_records or [])
+                       if ev]
+            batches.sort(key=lambda b: (b[0] is not None, b[0] or 0.0))
+            if not batches:
+                batches = [(None, _retime(list(replayed)))]
+            first_wall = next((w for w, _ in batches if w is not None), None)
+            queue = [
+                (0.0 if (w is None or first_wall is None)
+                 else max(0.0, w - first_wall), ev)
+                for w, ev in batches
+            ]
+            started = []  # monotonic clock anchored at the first poll
+            source.replay_backfill_pending = True
+
+            def poll_with_replay():
+                if queue:
+                    if not started:
+                        started.append(_tm.monotonic())
+                    rel, ev = queue[0]
+                    if _tm.monotonic() - started[0] >= rel:
+                        queue.pop(0)
+                        if not queue:
+                            source.replay_backfill_pending = False
+                        return ev
+                    return []
+                source.replay_backfill_pending = False
+                return journaling_poll()
+        else:
+            pending: list = []
+            if replayed and is_replay_injector:
+                if mode == "speedrun_replay" and replay_records:
+                    # one poll batch per journal record: each record was one
+                    # original poll commit, and the streaming loop stamps
+                    # each batch with its own logical time — so every
+                    # recorded commit replays as a distinct commit
+                    # (reference: SpeedrunReplay forwards AdvanceTime
+                    # entries; Persisting collapses them)
+                    pending = [
+                        _retime(ev) for _w, ev in reversed(replay_records) if ev
+                    ]
+                else:
+                    pending = [_retime(list(replayed))]
+
+            def poll_with_replay():
+                if pending:
+                    return pending.pop()
+                return journaling_poll()
 
         source.poll = poll_with_replay

@@ -254,8 +254,7 @@ def test_persistence_no_rejournal_of_net_zero(tmp_path):
 
     replayed = []
     for rec in backend.read_all("s"):
-        _seq, evs, _off = pickle.loads(rec)
-        replayed.extend(evs)
+        replayed.extend(pickle.loads(rec)[1])
     src2 = FakeSource(live)
     _wrap_source_with_persistence(src2, backend, "s", replayed, None)
     events = src2.static_events()
