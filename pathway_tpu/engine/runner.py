@@ -366,6 +366,7 @@ class GraphRunner:
                     source.static_batches(), key=lambda tb: tb[0]
                 ):
                     sched.push_input(op, t, batch)
+                op.finished = True
             else:
                 events = source.static_events()
                 if events:
@@ -374,6 +375,7 @@ class GraphRunner:
                         by_t[t].append((key, row, diff))
                     for t in sorted(by_t):
                         sched.push_input(op, t, by_t[t])
+                op.finished = True
         sched.run_until_idle()
         last_event = _time.monotonic()
         finished: set[int] = set()
@@ -449,6 +451,7 @@ class GraphRunner:
                 events = source.poll()
                 if events is None:
                     finished.add(op.id)
+                    op.finished = True  # dashboard "finished" column
                     got_any = True  # a flush tick delivers buffered output
                     continue
                 if events:

@@ -142,3 +142,47 @@ def test_viz_plot_renders_png(tmp_path):
     pw.run(monitoring_level=pw.MonitoringLevel.NONE)
     assert out_png.exists() and out_png.stat().st_size > 1000
     pg.G.clear()
+
+
+def test_dashboard_connector_and_logs_sections():
+    """Reference-dashboard depth: per-connector minibatch/minute/total
+    columns, busy ms/s operator column, and a logs panel carrying error-log
+    entries (reference: internals/monitoring.py:56-249)."""
+    import logging
+
+    from pathway_tpu.debug import table_from_rows
+    from pathway_tpu.engine.runner import GraphRunner
+    from pathway_tpu.engine.telemetry import global_error_log
+    from pathway_tpu.internals.monitoring import (
+        MonitoringDashboard, MonitoringLevel,
+    )
+
+    class S(pw.Schema):
+        w: str
+
+    pg.G.clear()
+    global_error_log.clear()
+    t = table_from_rows(S, [("a",), ("b",)])
+    out = t.groupby(t.w).reduce(t.w, c=pw.reducers.count())
+    runner = GraphRunner([out._materialize_capture()])
+    buf = io.StringIO()
+    dash = MonitoringDashboard(
+        runner.lg.scheduler, MonitoringLevel.ALL, interval_s=0.05, file=buf
+    )
+    dash.start()
+    runner.run_batch()
+    global_error_log.record("boom happened", operator="select")
+    logging.getLogger("pathway_tpu.test").warning("disk almost full")
+    time.sleep(0.15)
+    dash.stop()
+    text = buf.getvalue()
+    assert "connectors" in text
+    assert "last minibatch" in text
+    assert "last minute" in text
+    assert "since start" in text
+    assert "busy ms/s" in text
+    assert "logs" in text
+    assert "boom happened" in text
+    assert "disk almost full" in text
+    global_error_log.clear()
+    pg.G.clear()
