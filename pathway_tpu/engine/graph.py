@@ -131,34 +131,77 @@ class Scheduler:
         self._times_set: set[Time] = set()
         self.current_time: Time | None = None
         self.frontier: Time = -1
+        # cross-operator overlap (pipeline parallelism): operators in the
+        # same topological level run on a thread pool; emissions are
+        # captured per-op and routed in topo order afterwards, so results
+        # are bit-identical to the sequential walk.  Real overlap comes
+        # from GIL-releasing work (XLA dispatch, BLAS, IO) — exactly the
+        # heavy paths.  Off by default (PATHWAY_PIPELINE_THREADS=1).
+        import os as _os
+
+        self.pipeline_threads = max(
+            1, int(_os.environ.get("PATHWAY_PIPELINE_THREADS", "1") or "1")
+        )
+        self._pool = None
+        self._levels_cache: list[list[Operator]] | None = None
+        self._capture: dict[int, list] | None = None
 
     def register(self, op: Operator) -> Operator:
         op.scheduler = self
         self.operators.append(op)
         self._topo = None
+        self._levels_cache = None
         return op
 
     # -- graph order -------------------------------------------------------
     def topo_order(self) -> list[Operator]:
+        """Canonical LEVEL-ORDERED topological order: sorted by
+        (depth, registration index) where depth(op) = 1 + max depth of its
+        inputs.  Level-ordering (rather than raw Kahn output, which may
+        interleave depths) makes the sequential walk and the level-parallel
+        walk process-and-route in exactly the same order — the modes are
+        bit-identical by construction, including which error surfaces
+        first."""
         if self._topo is None:
+            # Kahn pass for cycle detection + a valid propagation order
             indeg: dict[int, int] = {op.id: 0 for op in self.operators}
             for op in self.operators:
                 for down, _ in op.downstream:
                     indeg[down.id] += 1
             ready = [op for op in self.operators if indeg[op.id] == 0]
-            order: list[Operator] = []
+            kahn: list[Operator] = []
             while ready:
                 op = ready.pop()
-                order.append(op)
+                kahn.append(op)
                 for down, _ in op.downstream:
                     indeg[down.id] -= 1
                     if indeg[down.id] == 0:
                         ready.append(down)
-            if len(order) != len(self.operators):
+            if len(kahn) != len(self.operators):
                 raise RuntimeError("cycle in engine graph (use iterate for loops)")
+            depth: dict[int, int] = {}
+            for op in kahn:
+                depth[op.id] = 1 + max(
+                    (depth[u.id] for u in op.inputs), default=-1
+                )
+            reg_pos = {op.id: i for i, op in enumerate(self.operators)}
+            order = sorted(kahn, key=lambda op: (depth[op.id], reg_pos[op.id]))
             self._topo = order
             self._topo_pos = {op.id: i for i, op in enumerate(order)}
+            by_depth: dict[int, list[Operator]] = defaultdict(list)
+            for op in order:
+                by_depth[depth[op.id]].append(op)
+            self._levels_cache = [by_depth[d] for d in sorted(by_depth)]
         return self._topo
+
+    def levels(self) -> list[list[Operator]]:
+        """Topological antichains: level(op) = 1 + max(level(upstream)).
+        Operators within a level have no dependency path between them, so
+        at one logical time they may execute concurrently.  Concatenated in
+        depth order these ARE topo_order() (level-ordered canonical form)."""
+        if self._levels_cache is None:
+            self.topo_order()
+        return self._levels_cache
 
     # -- data movement -----------------------------------------------------
     def _note_time(self, time: Time) -> None:
@@ -180,6 +223,13 @@ class Scheduler:
             raise RuntimeError(
                 f"operator {source.name} emitted at past time {time} < {self.current_time}"
             )
+        cap = self._capture
+        if cap is not None and source.id in cap:
+            # level-parallel execution: worker threads never touch the
+            # shared pending/heap structures — emissions buffer per-op and
+            # are routed in topo order after the level joins
+            cap[source.id].append((time, updates))
+            return
         for down, port in source.downstream:
             self.pending[time][down.id].append((port, updates))
         self._note_time(time)
@@ -218,6 +268,9 @@ class Scheduler:
         return False
 
     def _run_time(self, t: Time) -> None:
+        if self.pipeline_threads > 1 and len(self.operators) > 1:
+            self._run_time_parallel(t)
+            return
         self.current_time = t
         order = self.topo_order()
         bucket = self.pending.get(t)
@@ -236,9 +289,82 @@ class Scheduler:
         self.frontier = t
         self.current_time = None
 
+    def _run_one(self, op: Operator, batches, t: Time) -> None:
+        if batches:
+            for port, updates in batches:
+                op.rows_in += len(updates)
+                self._invoke(op, op.process, port, updates, t)
+        self._invoke(op, op.flush, t)
+
+    def _run_time_parallel(self, t: Time) -> None:
+        """Level-parallel variant of _run_time: each topological antichain
+        runs on a thread pool.  Dependencies are respected (an op's inputs
+        at time t all come from strictly lower levels), and emission routing
+        is deferred + replayed in topo order — which IS level order, since
+        topo_order() is canonically level-ordered — so the observable
+        behavior is identical to the sequential walk, including which error
+        surfaces first (lowest topo position of the failing level).  One
+        caveat: same-level operators AFTER a failing one have already run
+        when the error surfaces, so their in-memory state may be ahead of a
+        sequential run's; errors abort the run before any snapshot, so no
+        divergent state persists.  Overlap is real wherever the work
+        releases the GIL (XLA dispatch, BLAS, IO, native code)."""
+        from concurrent.futures import ThreadPoolExecutor
+
+        if self._pool is None:
+            self._pool = ThreadPoolExecutor(
+                max_workers=self.pipeline_threads,
+                thread_name_prefix="pw-pipeline",
+            )
+        self.current_time = t
+        try:
+            for level in self.levels():
+                bucket = self.pending.get(t)
+                work = [
+                    (op, bucket.pop(op.id, None) if bucket else None)
+                    for op in level
+                ]
+                if len(work) == 1:
+                    self._run_one(work[0][0], work[0][1], t)
+                    continue
+                capture: dict[int, list] = {op.id: [] for op, _ in work}
+                self._capture = capture
+                try:
+                    futures = [
+                        (op, self._pool.submit(self._run_one, op, batches, t))
+                        for op, batches in work
+                    ]
+                    errors = []
+                    for op, fut in futures:
+                        exc = fut.exception()
+                        if exc is not None:
+                            errors.append((self._topo_pos[op.id], exc))
+                finally:
+                    self._capture = None
+                if errors:
+                    # surface the same error the sequential walk would have
+                    # hit first (lowest topo position)
+                    raise min(errors)[1]
+                # deterministic routing: emitting ops in topo order
+                for op, _ in work:
+                    for time_, updates in capture[op.id]:
+                        self.route(op, time_, updates)
+        finally:
+            self._capture = None
+            self.current_time = None
+        self.pending.pop(t, None)
+        self.frontier = t
+
     def run_until_idle(self) -> None:
         while self.step():
             pass
+
+    def close_pool(self) -> None:
+        """Release pipeline-parallel worker threads (safe to call any time;
+        a later parallel step lazily recreates the pool)."""
+        if self._pool is not None:
+            self._pool.shutdown(wait=False)
+            self._pool = None
 
     def finish(self) -> None:
         self.run_until_idle()
@@ -256,6 +382,7 @@ class Scheduler:
         for op in sinks:
             op.on_end()
         self.run_until_idle()
+        self.close_pool()
 
 
 # ---------------------------------------------------------------------------

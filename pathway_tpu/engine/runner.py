@@ -515,6 +515,7 @@ class GraphRunner:
         for op in self.lg.scheduler.topo_order():
             op.on_end()
         sched.run_until_idle()
+        sched.close_pool()
         if rescale_code is not None:
             import sys as _sys
 

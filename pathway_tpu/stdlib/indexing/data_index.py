@@ -81,9 +81,30 @@ class ExternalIndexOperator(DiffOutputOperator):
             if port == 1:
                 self._dirty.update(self.state[0].keys())
             return
-        # as-of-now: answer query inserts immediately, never revise.
-        # Inserts are answered in arrival order (batched per consecutive run)
-        # so a same-batch insert+delete cancels correctly.
+        # as-of-now: data updates apply immediately; query batches buffer
+        # until flush so EVERY data update at this logical time is visible
+        # to queries at this time, independent of intra-time arrival order
+        # (the canonical level-ordered walk delivers all of an op's input
+        # batches for a time before its flush)
+        if port == 1:
+            for key, row, diff in updates:
+                self.pre_apply(1, key, row, diff)
+                self.state[1].apply(key, row, diff)
+            return
+        self._pending.append(list(updates))
+
+    def flush(self, time):
+        if not self.as_of_now:
+            super().flush(time)
+            return
+        for updates in self._pending:
+            self._answer_query_batch(updates, time)
+        self._pending.clear()
+
+    def _answer_query_batch(self, updates, time):
+        # answer query inserts, never revise.  Inserts are answered in
+        # arrival order (batched per consecutive run) so a same-batch
+        # insert+delete cancels correctly.
         out = []
         pending_inserts: list = []
 
@@ -100,10 +121,6 @@ class ExternalIndexOperator(DiffOutputOperator):
             pending_inserts.clear()
 
         for key, row, diff in updates:
-            if port == 1:
-                self.pre_apply(1, key, row, diff)
-                self.state[1].apply(key, row, diff)
-                continue
             if diff > 0:
                 self.state[0].apply(key, row, diff)
                 pending_inserts.append((key, row))

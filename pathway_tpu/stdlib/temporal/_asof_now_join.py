@@ -39,6 +39,7 @@ class AsofNowJoinOperator(Operator):
         self.left_ncols, self.right_ncols = left_ncols, right_ncols
         self.right_by_jk: dict[Any, dict] = defaultdict(dict)
         self.emitted: dict[Any, list] = defaultdict(list)  # left key -> emitted rows
+        self._lbuf: list[list] = []  # left batches deferred to flush
 
     def _jk(self, side, key, row):
         env = (self.left_env if side == "l" else self.right_env).build(key, row)
@@ -51,9 +52,8 @@ class AsofNowJoinOperator(Operator):
             return ("#h", hash_values(vals))
 
     def process(self, port, updates, time):
-        out = []
-        for key, row, diff in updates:
-            if port == 1:
+        if port == 1:
+            for key, row, diff in updates:
                 jk = self._jk("r", key, row)
                 side = self.right_by_jk[jk]
                 cur = side.get(key)
@@ -62,7 +62,24 @@ class AsofNowJoinOperator(Operator):
                     side.pop(key, None)
                 else:
                     side[key] = (row if diff > 0 else (cur[0] if cur else row), c)
-                continue
+            return
+        # left (query) batches buffer until flush: every right-side update
+        # at this logical time must be visible to queries at this time,
+        # independent of intra-time arrival order
+        self._lbuf.append(list(updates))
+
+    def flush(self, time):
+        if not self._lbuf:
+            return
+        batches, self._lbuf = self._lbuf, []
+        out = []
+        for updates in batches:
+            self._answer(updates, out)
+        if out:
+            self.emit(time, consolidate(out))
+
+    def _answer(self, updates, out):
+        for key, row, diff in updates:
             jk = self._jk("l", key, row)
             if diff > 0:
                 matches = [
@@ -94,8 +111,6 @@ class AsofNowJoinOperator(Operator):
                 # query retracted (forget) — retract its answers
                 for okey, orow in self.emitted.pop(key, []):
                     out.append((okey, orow, -1))
-        if out:
-            self.emit(time, consolidate(out))
 
 
 @register_lowering("asof_now_join")
