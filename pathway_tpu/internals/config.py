@@ -1,7 +1,9 @@
 """Env-var backed configuration (reference: internals/config.py:199).
 
-All knobs also settable programmatically; licensing is a no-op acceptance
-layer kept for API parity (reference: src/engine/license.rs).
+All knobs also settable programmatically.  Licensing gates the same ~25
+features the reference gates (internals/licensing.py; reference:
+src/engine/license.rs + _check_entitlements call sites) — a free demo key
+or offline signed key unlocks them.
 """
 
 from __future__ import annotations
@@ -39,7 +41,21 @@ def get_pathway_config() -> PathwayConfig:
 
 
 def set_license_key(key: str | None) -> None:
+    """Set (or clear) the license key.  Malformed offline keys surface
+    immediately (reference: set_license_key + License::new)."""
+    if key is not None:
+        from .licensing import parse_license
+
+        parse_license(key)  # validate eagerly; raises LicenseError
     pathway_config.license_key = key
+
+
+def _check_entitlements(*entitlements: str) -> None:
+    """Gate a feature on the configured license (reference:
+    internals/config.py _check_entitlements -> api.check_entitlements)."""
+    from .licensing import check_entitlements
+
+    check_entitlements(*entitlements)
 
 
 def set_monitoring_config(*, server_endpoint: str | None = None) -> None:

@@ -19,6 +19,7 @@ from ..engine.types import unwrap_row
 from ..internals import parse_graph as pg
 from ..internals.table import Table
 from ._utils import plain_scalar
+from ..internals.config import _check_entitlements
 
 _SCOPE = "https://www.googleapis.com/auth/bigquery.insertdata"
 
@@ -124,6 +125,7 @@ def write(table: Table, dataset: str, table_name: str, *,
           service_user_credentials_file: str | None = None,
           **kwargs) -> None:
     """Reference: pw.io.bigquery.write."""
+    _check_entitlements("bigquery")
     pg.new_output_node(
         "output", [table], colnames=table.column_names(),
         writer=_BigQueryWriter(

@@ -27,6 +27,7 @@ from ..internals.schema import SchemaMetaclass
 from ..internals.table import Table
 from ..internals.value import ref_scalar
 from ._utils import coerce_value, make_input_table, plain_scalar
+from ..internals.config import _check_entitlements
 
 _log = logging.getLogger("pathway_tpu.io.clickhouse")
 
@@ -160,6 +161,7 @@ def read(settings: ClickHouseSettings, table_name: str,
          schema: SchemaMetaclass, *, mode: str = "streaming",
          poll_interval_s: float | None = None,
          autocommit_duration_ms: int = 500, **kwargs) -> Table:
+    _check_entitlements("clickhouse")
     if poll_interval_s is None:
         poll_interval_s = autocommit_duration_ms / 1000.0
     source = ClickHouseSource(settings, table_name, schema,

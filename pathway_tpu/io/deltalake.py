@@ -40,6 +40,7 @@ from ..internals.table import Table
 from ..internals.value import ref_scalar
 from ..engine.types import unwrap_row
 from ._utils import coerce_value, make_input_table, plain_scalar
+from ..internals.config import _check_entitlements
 
 _LOG_DIR = "_delta_log"
 
@@ -182,6 +183,7 @@ def write(table: Table, uri: str, *,
           output_table_type: str = "stream_of_changes", **kwargs) -> None:
     """Reference: pw.io.deltalake.write (io/deltalake/__init__.py over
     delta.rs)."""
+    _check_entitlements("deltalake")
     part_names = [getattr(c, "_name", c) for c in (partition_columns or [])]
     writer = DeltaWriter(
         uri, table.column_names(), dict(table._dtypes),
@@ -328,6 +330,7 @@ def read(
     **kwargs,
 ) -> Table:
     """Reference: pw.io.deltalake.read."""
+    _check_entitlements("deltalake")
     if poll_interval_s is None:
         poll_interval_s = autocommit_duration_ms / 1000.0
     source = DeltaSource(

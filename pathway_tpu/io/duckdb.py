@@ -24,6 +24,7 @@ from ..internals import dtype as dt
 from ..internals.expression import ColumnReference
 from ..internals.table import Table
 from ._utils import add_output_node, plain_scalar
+from ..internals.config import _check_entitlements
 
 
 def _connect(database, injected=None):
@@ -209,6 +210,7 @@ def write(table: Table, *, table_name: str, database,
           sort_by: Iterable[ColumnReference] | None = None,
           _connection=None) -> None:
     """Write `table` into a table of a DuckDB database file."""
+    _check_entitlements("duckdb")
     colnames = table.column_names()
     dtypes = table.schema.dtypes()
     snapshot = output_table_type == "snapshot"

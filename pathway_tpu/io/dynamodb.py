@@ -15,6 +15,7 @@ from ..engine.types import unwrap_row
 from ..internals import parse_graph as pg
 from ..internals.table import Table
 from ._aws import AwsCredentials, aws_call
+from ..internals.config import _check_entitlements
 
 _T = "DynamoDB_20120810"
 
@@ -80,6 +81,7 @@ def write(table: Table, table_name: str, partition_key: Any,
           session_token: str | None = None, endpoint: str | None = None,
           **kwargs) -> None:
     """Reference: pw.io.dynamodb.write."""
+    _check_entitlements("dynamodb")
     creds = AwsCredentials(access_key, secret_key, region, session_token)
     pk = getattr(partition_key, "_name", partition_key)
     sk = getattr(sort_key, "_name", sort_key) if sort_key is not None else None

@@ -12,6 +12,7 @@ from typing import Any
 
 from ..internals.table import Table
 from ._utils import add_output_node
+from ..internals.config import _check_entitlements
 
 
 class ElasticSearchAuth:
@@ -101,4 +102,5 @@ class _EsWriter:
 
 def write(table: Table, host: str, auth: ElasticSearchAuth | None,
           index_name: str, **kwargs) -> None:
+    _check_entitlements("elasticsearch")
     add_output_node(table, _EsWriter(host, auth, index_name))

@@ -34,6 +34,7 @@ from ..internals.table import Table
 from ..internals.value import ref_scalar
 from . import _avro
 from ._utils import coerce_value, make_input_table, plain_scalar
+from ..internals.config import _check_entitlements
 
 _log = logging.getLogger("pathway_tpu.io.iceberg")
 
@@ -243,6 +244,7 @@ def write(table: Table, catalog_uri_or_path: str, *, namespace=None,
           table_name: str | None = None, **kwargs) -> None:
     """Reference: pw.io.iceberg.write (filesystem-catalog tables; REST
     catalogs need a catalog service and are out of scope)."""
+    _check_entitlements("iceberg")
     path = catalog_uri_or_path
     if table_name:
         parts = list(namespace or []) + [table_name]
@@ -390,6 +392,7 @@ def read(catalog_uri_or_path: str, *, namespace=None,
          mode: str = "streaming", autocommit_duration_ms: int = 500,
          poll_interval_s: float | None = None, **kwargs) -> Table:
     """Reference: pw.io.iceberg.read."""
+    _check_entitlements("iceberg")
     path = catalog_uri_or_path
     if table_name:
         parts = list(namespace or []) + [table_name]

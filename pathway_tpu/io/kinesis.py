@@ -23,6 +23,7 @@ from ..internals.schema import ColumnDefinition, SchemaMetaclass
 from ..internals.table import Table
 from ._aws import AwsCredentials, aws_call
 from ._utils import coerce_value, make_input_table, plain_scalar
+from ..internals.config import _check_entitlements
 
 _log = logging.getLogger("pathway_tpu.io.kinesis")
 _T = "Kinesis_20131202"
@@ -170,6 +171,7 @@ def read(stream_name: str, *, schema: SchemaMetaclass | None = None,
          region: str = "us-east-1", session_token: str | None = None,
          start_position: str = "TRIM_HORIZON", endpoint: str | None = None,
          poll_interval_s: float = 0.5, **kwargs) -> Table:
+    _check_entitlements("kinesis")
     creds = AwsCredentials(access_key, secret_key, region, session_token)
     src = KinesisSource(
         creds, stream_name, schema, format, mode, poll_interval_s,

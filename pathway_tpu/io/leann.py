@@ -24,6 +24,7 @@ from ..internals import dtype as dt
 from ..internals import parse_graph as pg
 from ..internals.expression import ColumnReference
 from ..internals.table import Table
+from ..internals.config import _check_entitlements
 
 _log = logging.getLogger("pathway_tpu.io.leann")
 
@@ -122,6 +123,7 @@ def write(table: Table, index_path, text_column: ColumnReference, *,
           embedding_options: dict | None = None,
           name: str | None = None) -> None:
     """Write the table to a LEANN index rebuilt on every minibatch."""
+    _check_entitlements("leann")
     dtypes = table.schema.dtypes()
 
     def _check_str(ref, role):

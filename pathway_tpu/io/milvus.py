@@ -17,6 +17,7 @@ from ..internals import parse_graph as pg
 from ..internals.expression import ColumnReference
 from ..internals.table import Table
 from .vector_writers import _default_http, _plain, _vec_list
+from ..internals.config import _check_entitlements
 
 
 class _MilvusWriter:
@@ -96,6 +97,7 @@ def write(table: Table, uri: str, collection_name: str, *,
           sort_by: Iterable[ColumnReference] | None = None,
           _http=None) -> None:
     """Keep a Milvus collection in sync with `table`."""
+    _check_entitlements("milvusdb")
     if not isinstance(primary_key, ColumnReference):
         raise ValueError("primary_key must be a column reference")
     if primary_key._name not in table.column_names():

@@ -16,6 +16,7 @@ from ..internals.schema import SchemaMetaclass
 from ..internals.table import Table
 from ..internals.value import ref_scalar
 from ._utils import add_output_node, coerce_value, make_input_table
+from ..internals.config import _check_entitlements
 
 
 def _make_client(connection_string: str, injected=None):
@@ -139,6 +140,7 @@ class MongoSource(DataSource):
 def read(connection_string: str, database: str, collection: str, *,
          schema: SchemaMetaclass, mode: str = "streaming",
          poll_interval_s: float = 1.0, **kwargs) -> Table:
+    _check_entitlements("mongodb-oplog-reader")
     src = MongoSource(
         connection_string, database, collection, schema,
         poll_interval_s=poll_interval_s, live=(mode == "streaming"),

@@ -31,6 +31,7 @@ from ..internals.schema import SchemaMetaclass
 from ..internals.table import Table
 from ..internals.value import ref_scalar
 from ._utils import coerce_value, make_input_table
+from ..internals.config import _check_entitlements
 
 _log = logging.getLogger("pathway_tpu.io.mssql")
 
@@ -275,6 +276,7 @@ def read(connection_string, table_name: str, schema: SchemaMetaclass, *,
          name: str | None = None, max_backlog_size: int | None = None,
          debug_data: Any = None, **kwargs) -> Table:
     """Read a SQL Server table (static SELECT or CDC streaming)."""
+    _check_entitlements("mssql")
     _validate_identifier("table_name", table_name)
     _validate_identifier("schema_name", schema_name)
     if mode == "streaming" and not schema.primary_key_columns():

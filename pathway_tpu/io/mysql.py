@@ -22,6 +22,7 @@ from ..internals.schema import SchemaMetaclass
 from ..internals.table import Table
 from ..internals.value import ref_scalar
 from ._utils import coerce_value, make_input_table
+from ..internals.config import _check_entitlements
 
 _log = logging.getLogger("pathway_tpu.io.mysql")
 
@@ -161,6 +162,7 @@ def read(
     autocommit_duration_ms: int = 500,
     **kwargs,
 ) -> Table:
+    _check_entitlements("mysql")
     if poll_interval_s is None:
         poll_interval_s = autocommit_duration_ms / 1000.0
     source = MysqlSnapshotSource(

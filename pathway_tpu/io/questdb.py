@@ -23,6 +23,7 @@ from ..internals.schema import SchemaMetaclass
 from ..internals.table import Table
 from ..internals.value import ref_scalar
 from ._utils import coerce_value, make_input_table
+from ..internals.config import _check_entitlements
 
 _log = logging.getLogger("pathway_tpu.io.questdb")
 
@@ -83,6 +84,7 @@ class _QuestDbWriter:
 
 def write(table: Table, connection_string_or_host, *, table_name: str,
           port: int = 9009, **kwargs) -> None:
+    _check_entitlements("questdb")
     host = connection_string_or_host
     if "://" in str(host):
         hostport = str(host).split("://", 1)[-1]

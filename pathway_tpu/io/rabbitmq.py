@@ -24,6 +24,7 @@ from ..internals.datasource import SubjectDataSource
 from ..internals.schema import ColumnDefinition, SchemaMetaclass
 from ..internals.table import Table
 from ._utils import coerce_value, make_input_table, plain_scalar
+from ..internals.config import _check_entitlements
 
 _log = logging.getLogger("pathway_tpu.io.rabbitmq")
 
@@ -254,6 +255,7 @@ class _RabbitSubject:
 def read(uri: str, *, queue_name: str, schema: SchemaMetaclass | None = None,
          format: str = "json",  # noqa: A002
          **kwargs) -> Table:
+    _check_entitlements("rabbitmq")
     if format == "json" and schema is None:
         raise ValueError(
             "pw.io.rabbitmq.read with format='json' needs a schema"
@@ -299,6 +301,7 @@ class _RabbitWriter:
 
 def write(table: Table, uri: str, *, routing_key: str,
           exchange_name: str = "", **kwargs) -> None:
+    _check_entitlements("rabbitmq")
     pg.new_output_node(
         "output", [table], colnames=table.column_names(),
         writer=_RabbitWriter(uri, routing_key, exchange_name),

@@ -26,6 +26,7 @@ from ..internals.schema import ColumnDefinition
 from ..internals.table import Table
 from ..internals.value import Json, ref_scalar
 from ._utils import make_input_table
+from ..internals.config import _check_entitlements
 
 _log = logging.getLogger("pathway_tpu.io.sharepoint")
 _GRAPH = "https://graph.microsoft.com/v1.0"
@@ -226,6 +227,7 @@ def read(url: str = "", *, tenant: str = "", client_id: str = "",
          file_name_pattern=None, with_metadata: bool = True,
          _client=None, **kwargs) -> Table:
     """Reference: pw.xpacks.connectors.sharepoint.read."""
+    _check_entitlements("xpack-sharepoint")
     client = _client or SharePointClient(tenant, client_id, client_secret, url)
     source = SharePointSource(
         client, root_path, mode, float(refresh_interval),

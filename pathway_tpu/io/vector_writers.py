@@ -181,11 +181,14 @@ class ChromaWriter(_VectorWriterBase):
         )
 
 
-def _make_write(writer_cls):
+def _make_write(writer_cls, entitlement: str):
     def write(table: Table, *, vector_column: str = "vector",
               id_column: str | None = None,
               metadata_columns: Iterable[str] | None = None,
               **settings) -> None:
+        from ..internals.config import _check_entitlements
+
+        _check_entitlements(entitlement)
         writer = writer_cls(
             vector_column=vector_column, id_column=id_column,
             metadata_columns=metadata_columns,
@@ -198,6 +201,6 @@ def _make_write(writer_cls):
     return write
 
 
-write_pinecone = _make_write(PineconeWriter)
-write_qdrant = _make_write(QdrantWriter)
-write_chroma = _make_write(ChromaWriter)
+write_pinecone = _make_write(PineconeWriter, "pinecone")
+write_qdrant = _make_write(QdrantWriter, "qdrant")
+write_chroma = _make_write(ChromaWriter, "chromadb")

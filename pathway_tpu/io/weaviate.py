@@ -22,6 +22,7 @@ from ..internals import parse_graph as pg
 from ..internals.expression import ColumnReference
 from ..internals.table import Table
 from .vector_writers import _default_http, _plain, _vec_list
+from ..internals.config import _check_entitlements
 
 _NS = uuid.UUID("8a6e1f44-20c1-4b7e-9a08-7f31bb44a1ce")
 
@@ -122,6 +123,7 @@ def write(table: Table, collection_name: str, *,
           sort_by: Iterable[ColumnReference] | None = None,
           _http=None) -> None:
     """Keep a Weaviate collection in sync with `table`."""
+    _check_entitlements("weaviate")
     scheme = "https" if http_secure else "http"
     writer = _WeaviateWriter(
         collection_name,

@@ -31,6 +31,9 @@ class McpServer:
     _instances: dict[tuple[str, int], "McpServer"] = {}
 
     def __init__(self, config: McpConfig):
+        from ...internals.config import _check_entitlements
+
+        _check_entitlements("xpack-llm-mcp")
         self.config = config
         self.tools: dict[str, tuple[Callable, dict]] = {}
         self.webserver = PathwayWebserver(config.host, config.port)

@@ -127,7 +127,9 @@ class UnstructuredParser(ParserBase):
 
 class DoclingParser(ParserBase):
     def __init__(self, **kwargs):
-        pass
+        from ...internals.config import _check_entitlements
+
+        _check_entitlements("advanced-parser")
 
     def _parse(self, contents):
         raise ImportError("DoclingParser requires the docling package")
@@ -261,6 +263,9 @@ class PaddleOCRParser(ParserBase):
     captures) with zero dependencies beyond pillow."""
 
     def __init__(self, **kwargs):
+        from ...internals.config import _check_entitlements
+
+        _check_entitlements("advanced-parser")
         self.kwargs = kwargs
         self._paddle = None
         try:

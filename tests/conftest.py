@@ -2,6 +2,9 @@ import os
 
 # virtual 8-device CPU mesh for sharding tests; keep TPU free for bench
 os.environ["JAX_PLATFORMS"] = "cpu"
+# gated connectors (reference parity: ~25 features need a free key) run
+# under the demo license, exactly like the reference's own test setup
+os.environ.setdefault("PATHWAY_LICENSE_KEY", "demo-license-key-no-telemetry")
 if "xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
     os.environ["XLA_FLAGS"] = (
         os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"

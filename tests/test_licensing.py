@@ -1,0 +1,85 @@
+"""License / entitlements gating (reference: src/engine/license.rs +
+internals/config.py _check_entitlements — 25 gated call sites)."""
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.internals.config import pathway_config
+from pathway_tpu.internals.licensing import (
+    InsufficientLicenseError,
+    MissingLicenseError,
+    check_entitlements,
+    parse_license,
+    sign_offline_key,
+)
+
+
+@pytest.fixture
+def no_license():
+    saved = pathway_config.license_key
+    pathway_config.license_key = None
+    yield
+    pathway_config.license_key = saved
+
+
+def test_gated_feature_requires_key(no_license):
+    with pytest.raises(MissingLicenseError, match="free"):
+        check_entitlements("deltalake")
+    # a gated connector entry point raises the same way
+    with pytest.raises(MissingLicenseError):
+        pw.io.dynamodb.write(None, "t", "pk")
+
+
+def test_demo_key_grants_standard_tier(no_license):
+    pw.set_license_key("demo-license-key-no-telemetry")
+    check_entitlements("deltalake", "xpack-sharepoint", "advanced-parser")
+    lic = parse_license(pathway_config.license_key)
+    assert lic.telemetry_required is False
+    pw.set_license_key("demo-license-key-with-telemetry")
+    assert parse_license(pathway_config.license_key).telemetry_required
+
+
+def test_offline_key_entitlement_list(no_license):
+    pw.set_license_key("pathway-tpu:v1:deltalake,iceberg")
+    check_entitlements("deltalake")
+    with pytest.raises(InsufficientLicenseError, match="insufficient"):
+        check_entitlements("xpack-sharepoint")
+
+
+def test_offline_key_star_is_enterprise(no_license):
+    pw.set_license_key("pathway-tpu:v1:*")
+    check_entitlements("deltalake", "anything-at-all")
+    assert parse_license(pathway_config.license_key).tier == "enterprise"
+
+
+def test_signed_offline_key(no_license, monkeypatch):
+    monkeypatch.setenv("PATHWAY_LICENSE_SIGNING_KEY", "sekrit")
+    good = sign_offline_key("deltalake", "sekrit")
+    pw.set_license_key(good)
+    check_entitlements("deltalake")
+    with pytest.raises(InsufficientLicenseError, match="signature"):
+        pw.set_license_key("pathway-tpu:v1:deltalake:badmac")
+    with pytest.raises(InsufficientLicenseError, match="unsigned"):
+        pw.set_license_key("pathway-tpu:v1:deltalake")
+    # the signing requirement cannot be bypassed via other key shapes
+    with pytest.raises(InsufficientLicenseError, match="signed offline"):
+        pw.set_license_key("demo-license-key-no-telemetry")
+    with pytest.raises(InsufficientLicenseError, match="signed offline"):
+        pw.set_license_key("anything-else")
+    # a valid mac cannot carry unverified trailing segments
+    with pytest.raises(InsufficientLicenseError, match="signature"):
+        pw.set_license_key(good + ":extra")
+    with pytest.raises(ValueError, match="':'"):
+        sign_offline_key("a:b", "sekrit")
+
+
+def test_ungated_vector_writers_are_gated(no_license):
+    with pytest.raises(MissingLicenseError):
+        pw.io.vector_writers.write_pinecone(None)
+
+
+def test_clearing_key(no_license):
+    pw.set_license_key("demo-license-key-no-telemetry")
+    pw.set_license_key(None)
+    with pytest.raises(MissingLicenseError):
+        check_entitlements("deltalake")
