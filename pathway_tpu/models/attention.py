@@ -107,7 +107,100 @@ def make_ring_attention(mesh: Mesh, axis_name: str = "sp", causal: bool = False)
     def fn(q, k, v):
         return ring_attention(q, k, v, axis_name, causal=causal)
 
+    fn.strategy = "ring"
     return fn
+
+
+def ulysses_attention(q, k, v, axis_name: str, causal: bool = False):
+    """DeepSpeed-Ulysses-style all-to-all sequence parallelism inside
+    shard_map: inputs arrive sequence-sharded (B, T/n, H, D); an all-to-all
+    re-shards them head-sharded (B, T, H/n, D), each device computes FULL
+    exact attention for its head slice, and a second all-to-all restores
+    sequence sharding.  Two collectives total vs the ring's n ppermutes —
+    the better trade when H >= n and per-device memory fits O(T * T/...)
+    score blocks; ring wins at extreme T where full-T scores don't fit.
+    Both ride ICI on a TPU mesh.
+    """
+    def seq_to_heads(x):
+        # (B, Tl, H, D) -> n blocks of heads gathered over the seq axis:
+        # all_to_all splits axis `split_axis` into n and concatenates the
+        # incoming blocks along `concat_axis`
+        return jax.lax.all_to_all(
+            x, axis_name, split_axis=2, concat_axis=1, tiled=True
+        )  # (B, Tl*n, H/n, D)
+
+    def heads_to_seq(x):
+        return jax.lax.all_to_all(
+            x, axis_name, split_axis=1, concat_axis=2, tiled=True
+        )  # (B, Tl, H, D)
+
+    qh, kh, vh = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
+    d = qh.shape[-1]
+    s = jnp.einsum("bqhd,bkhd->bhqk", qh, kh) / np.sqrt(d)
+    if causal:
+        T = qh.shape[1]
+        mask = jnp.tril(jnp.ones((T, T), bool))
+        s = jnp.where(mask[None, None], s, -1e30)
+    p = jax.nn.softmax(s.astype(jnp.float32), axis=-1).astype(qh.dtype)
+    oh = jnp.einsum("bhqk,bkhd->bqhd", p, vh)
+    return heads_to_seq(oh)
+
+
+def make_ulysses_attention(mesh: Mesh, axis_name: str = "sp",
+                           causal: bool = False):
+    """shard_map-wrapped Ulysses attention: same contract as
+    make_ring_attention — global (B,T,H,D) sharded on T in and out.
+    Requires H % n_devices == 0 (checked with a readable error)."""
+    spec = P(None, axis_name, None, None)
+    n = mesh.shape[axis_name]
+
+    @functools.partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+    )
+    def _sharded(q, k, v):
+        return ulysses_attention(q, k, v, axis_name, causal=causal)
+
+    def fn(q, k, v):
+        if q.shape[2] % n != 0:
+            raise ValueError(
+                f"ulysses attention needs n_heads % axis size == 0, got "
+                f"{q.shape[2]} % {n} (use ring attention instead)"
+            )
+        return _sharded(q, k, v)
+
+    fn.strategy = "ulysses"
+    return fn
+
+
+def make_sequence_parallel_attention(mesh: Mesh, axis_name: str = "sp", *,
+                                     causal: bool = False, n_heads: int,
+                                     seq_len: int | None = None,
+                                     strategy: str = "auto"):
+    """Pick the sequence-parallel strategy (reference-scale long-context
+    support: ring OR all-to-all, SURVEY §5).
+
+    - "ring": n ppermute steps, O(T/n x T/n) score blocks — extreme T
+    - "ulysses": 2 all-to-alls, full-T scores per head slice — fewer
+      collectives when heads divide across the axis and scores fit
+    - "auto": ulysses when H is divisible by the axis size and the full
+      score block is modest (T <= 8192), else ring
+    """
+    n = mesh.shape[axis_name]
+    if strategy == "auto":
+        fits = seq_len is None or seq_len <= 8192
+        strategy = "ulysses" if (n_heads % n == 0 and fits) else "ring"
+    if strategy == "ulysses":
+        if n_heads % n != 0:
+            raise ValueError(
+                f"ulysses needs n_heads % axis size == 0, got {n_heads} % {n}"
+            )
+        return make_ulysses_attention(mesh, axis_name, causal=causal)
+    if strategy != "ring":
+        raise ValueError(f"unknown sequence-parallel strategy {strategy!r}")
+    return make_ring_attention(mesh, axis_name, causal=causal)
 
 
 def reference_attention(q, k, v, causal: bool = False):
