@@ -129,6 +129,44 @@ def main() -> None:
     }
     _emit(final=False)
 
+    # ---- Pallas flash attention (fused online softmax) vs XLA attention
+    _PARTIAL["stage"] = "flash_attention"
+    from pathway_tpu.models.attention import reference_attention
+    from pathway_tpu.ops.attention_pallas import flash_attention
+
+    Bf, Tf, Hf, Df = 1, 4096, 8, 64
+    rngf = np.random.default_rng(5)
+    qf = jnp.asarray(rngf.normal(size=(Bf, Tf, Hf, Df)), jnp.bfloat16)
+    kf = jnp.asarray(rngf.normal(size=(Bf, Tf, Hf, Df)), jnp.bfloat16)
+    vf = jnp.asarray(rngf.normal(size=(Bf, Tf, Hf, Df)), jnp.bfloat16)
+    flash = jax.jit(lambda a, b, c: flash_attention(
+        a, b, c, causal=True, use_pallas=True, interpret=False))
+    xla_attn = jax.jit(lambda a, b, c: reference_attention(a, b, c,
+                                                           causal=True))
+    of = flash(qf, kf, vf).block_until_ready()
+    ox = xla_attn(qf, kf, vf).block_until_ready()
+    assert np.allclose(np.asarray(of, np.float32),
+                       np.asarray(ox, np.float32), atol=2e-2)
+    t0 = time.perf_counter()
+    for _ in range(10):
+        of = flash(qf, kf, vf)
+    of.block_until_ready()
+    t_flash = (time.perf_counter() - t0) / 10
+    t0 = time.perf_counter()
+    for _ in range(10):
+        ox = xla_attn(qf, kf, vf)
+    ox.block_until_ready()
+    t_xa = (time.perf_counter() - t0) / 10
+    # qk^T + pv = 4*B*H*T*T*D flops; causal masking halves the useful work
+    gf_attn = 4.0 * Bf * Hf * Tf * Tf * Df / 2.0 / 1e9
+    _PARTIAL["flash_attention"] = {
+        "gflops_per_sec": round(gf_attn / t_flash, 1),
+        "xla_gflops_per_sec": round(gf_attn / t_xa, 1),
+        "vs_xla": round(t_xa / t_flash, 2),
+        "shape": f"B{Bf} T{Tf} H{Hf} D{Df} causal bf16",
+    }
+    _emit(final=False)
+
     # ---- fused generation: prefill + whole greedy loop in ONE program
     _PARTIAL["stage"] = "generation"
     from pathway_tpu.models.decoder import DecoderConfig, JaxDecoderLM

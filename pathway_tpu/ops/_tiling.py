@@ -1,0 +1,17 @@
+"""Shared tile-padding helper for Pallas kernels."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def pad_to(x: jax.Array, axis: int, multiple: int) -> jax.Array:
+    """Zero-pad `axis` up to the next multiple (no-op when aligned)."""
+    n = x.shape[axis]
+    rem = (-n) % multiple
+    if rem == 0:
+        return x
+    pad = [(0, 0)] * x.ndim
+    pad[axis] = (0, rem)
+    return jnp.pad(x, pad)

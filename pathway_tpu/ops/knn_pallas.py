@@ -21,14 +21,7 @@ TILE_Q = 128
 TILE_N = 256
 
 
-def _pad_to(x: jax.Array, axis: int, multiple: int) -> jax.Array:
-    n = x.shape[axis]
-    rem = (-n) % multiple
-    if rem == 0:
-        return x
-    pad = [(0, 0)] * x.ndim
-    pad[axis] = (0, rem)
-    return jnp.pad(x, pad)
+from ._tiling import pad_to as _pad_to  # noqa: E402
 
 
 def _scores_kernel(q_ref, m_ref, out_ref):
