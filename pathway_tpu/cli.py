@@ -71,7 +71,25 @@ def _spawn_once(program: list[str], threads: int, processes: int, first_port: in
 
 def spawn(program: list[str], *, threads: int = 1, processes: int = 1,
           first_port: int = 10000, record: bool = False) -> int:
-    """Supervise the program; honor elastic-rescale exit codes."""
+    """Supervise the program; honor elastic-rescale exit codes.
+
+    Worker cap (reference: MAX_WORKERS=8, dataflow/config.rs:11-15): total
+    threads x processes above 8 needs the 'unlimited-workers' entitlement;
+    without it the supervisor clamps the process count."""
+    if threads * processes > 8:
+        from .internals.licensing import LicenseError, check_entitlements
+
+        try:
+            check_entitlements("unlimited-workers")
+        except LicenseError:
+            new_procs = max(1, 8 // max(1, threads))
+            print(
+                f"[pathway-tpu] {threads * processes} workers exceeds the "
+                f"8-worker cap without the 'unlimited-workers' entitlement; "
+                f"clamping processes {processes} -> {new_procs}",
+                file=sys.stderr,
+            )
+            processes = new_procs
     while True:
         code = _spawn_once(program, threads, processes, first_port)
         if code == EXIT_CODE_DOWNSCALE and processes > 1:

@@ -42,6 +42,38 @@ def run(
 
     n_shards = max(1, pathway_config.threads)
     n_procs = max(1, pathway_config.processes)
+    # worker cap without the unlimited-workers entitlement (reference:
+    # MAX_WORKERS=8, dataflow/config.rs:11-15,149-151 — warn and reduce)
+    MAX_WORKERS = 8
+    if n_shards * n_procs > MAX_WORKERS:
+        from .licensing import LicenseError, check_entitlements
+
+        try:
+            check_entitlements("unlimited-workers")
+        except LicenseError:
+            import logging
+
+            log = logging.getLogger("pathway_tpu")
+            new_shards = max(1, MAX_WORKERS // n_procs)
+            if n_procs > MAX_WORKERS:
+                # a single process cannot shrink the cluster it was spawned
+                # into — the supervisor (cli.spawn) clamps processes; here
+                # we can only floor threads and say so honestly
+                log.warning(
+                    "%d processes exceeds the %d-worker cap and cannot be "
+                    "reduced from inside a worker; the spawn supervisor "
+                    "clamps process counts — 'unlimited-workers' "
+                    "entitlement required for this size",
+                    n_procs, MAX_WORKERS,
+                )
+            if new_shards != n_shards:
+                log.warning(
+                    "%d workers exceeds the maximum allowed (%d) without "
+                    "the 'unlimited-workers' entitlement; reducing threads "
+                    "%d -> %d",
+                    n_shards * n_procs, MAX_WORKERS, n_shards, new_shards,
+                )
+            n_shards = new_shards
     streaming = has_live_sources(sinks)
 
     from ..engine.telemetry import global_tracer

@@ -83,3 +83,70 @@ def test_clearing_key(no_license):
     pw.set_license_key(None)
     with pytest.raises(MissingLicenseError):
         check_entitlements("deltalake")
+
+
+def test_worker_cap_without_unlimited_workers(no_license, caplog):
+    """Reference: MAX_WORKERS=8 without the unlimited-workers entitlement —
+    warn and reduce threads (dataflow/config.rs:11-15,149-151)."""
+    import logging
+
+    from pathway_tpu.internals import parse_graph as pg
+
+    pw.set_license_key("demo-license-key-no-telemetry")  # lacks the ent
+    saved_threads = pathway_config.threads
+    pathway_config.threads = 16
+    try:
+        pg.G.clear()
+        t = pw.debug.table_from_markdown(
+            """
+            a
+            1
+            """
+        )
+        got = []
+        pw.io.subscribe(t, on_change=lambda key, row, time, is_addition:
+                        got.append(row["a"]))
+        with caplog.at_level(logging.WARNING, logger="pathway_tpu"):
+            pw.run(monitoring_level=pw.MonitoringLevel.NONE)
+        assert got == [1]
+        assert any("unlimited-workers" in r.message for r in caplog.records)
+        # enterprise key lifts the cap: no warning
+        pw.set_license_key("pathway-tpu:v1:*")
+        pg.G.clear()
+        t2 = pw.debug.table_from_markdown(
+            """
+            a
+            2
+            """
+        )
+        pw.io.subscribe(t2, on_change=lambda *a, **k: None)
+        caplog.clear()
+        with caplog.at_level(logging.WARNING, logger="pathway_tpu"):
+            pw.run(monitoring_level=pw.MonitoringLevel.NONE)
+        assert not any("unlimited-workers" in r.message for r in caplog.records)
+    finally:
+        pathway_config.threads = saved_threads
+
+
+def test_spawn_supervisor_clamps_processes(no_license, capsys, monkeypatch):
+    """The supervisor is the only place that can shrink a cluster: without
+    the entitlement it clamps processes so threads x processes <= 8."""
+    import pathway_tpu.cli as cli
+
+    pw.set_license_key("demo-license-key-no-telemetry")  # lacks the ent
+    calls = []
+
+    def fake_spawn_once(program, threads, processes, first_port):
+        calls.append((threads, processes))
+        return 0
+
+    monkeypatch.setattr(cli, "_spawn_once", fake_spawn_once)
+    cli.spawn(["true"], threads=2, processes=16)
+    assert calls == [(2, 4)]  # 2 threads x 4 procs = 8 workers
+    err = capsys.readouterr().err
+    assert "unlimited-workers" in err
+    # with the entitlement the requested size goes through untouched
+    pw.set_license_key("pathway-tpu:v1:unlimited-workers")
+    calls.clear()
+    cli.spawn(["true"], threads=2, processes=16)
+    assert calls == [(2, 16)]
