@@ -531,6 +531,182 @@ class _RestSubject:
             ev.set()
 
 
+class RetryPolicy:
+    """Delay policy for stream-read retries (reference: io/http
+    RetryPolicy)."""
+
+    def __init__(self, first_delay_ms: int = 1000, backoff_factor: float = 2.0,
+                 jitter_ms: int = 0):
+        self.first_delay_ms = first_delay_ms
+        self.backoff_factor = backoff_factor
+        self.jitter_ms = jitter_ms
+
+    @classmethod
+    def default(cls) -> "RetryPolicy":
+        return cls()
+
+    def delay_s(self, attempt: int) -> float:
+        import random
+
+        base = self.first_delay_ms * (self.backoff_factor ** attempt)
+        return (base + random.uniform(0, self.jitter_ms)) / 1000.0
+
+
+def read(
+    url: str,
+    *,
+    schema: SchemaMetaclass | None = None,
+    method: str = "GET",
+    payload: Any | None = None,
+    headers: dict[str, str] | None = None,
+    response_mapper=None,
+    format: str = "json",  # noqa: A002
+    delimiter: str | bytes | None = None,
+    n_retries: int = 0,
+    retry_policy: RetryPolicy | None = None,
+    connect_timeout_ms: int | None = None,
+    request_timeout_ms: int | None = None,
+    allow_redirects: bool = True,
+    retry_codes: tuple | None = (429, 500, 502, 503, 504),
+    autocommit_duration_ms: int = 10000,
+    **kwargs,
+):
+    """Read a table from a streaming HTTP endpoint (reference: io/http
+    read).  The response body splits into messages on `delimiter`
+    (default newline); "json" format parses each message into schema
+    columns, "raw" binds it to a single `data` column.
+    """
+    from ..internals.schema import schema_from_types
+    from . import python as io_python
+
+    if format == "raw":
+        if schema is not None:
+            raise ValueError(
+                "format='raw' produces a single `data` column; a custom "
+                "schema cannot be honored — drop one of the two"
+            )
+        schema = schema_from_types(data=bytes)
+    elif schema is None:
+        schema = schema_from_types(data=str)
+    delim = delimiter if delimiter is not None else b"\n"
+    if isinstance(delim, str):
+        delim = delim.encode()
+    policy = retry_policy or RetryPolicy.default()
+
+    class _HttpStreamSubject(io_python.ConnectorSubject):
+        def run(self) -> None:
+            import http.client as _http_client
+            import urllib.error
+            import urllib.request
+
+            attempt = 0
+            delivered = 0  # survives reconnects: re-read msgs are skipped
+            while True:
+                hdrs = dict(headers or {})
+                if payload is not None and not any(
+                    h.lower() == "content-type" for h in hdrs
+                ):
+                    hdrs["Content-Type"] = "application/json"
+                req = urllib.request.Request(
+                    url,
+                    data=(json.dumps(payload).encode()
+                          if payload is not None else None),
+                    headers=hdrs, method=method,
+                )
+                try:
+                    connect_s = (connect_timeout_ms or 0) / 1000 or None
+                    # whole-request wall-clock cap, enforced between chunks
+                    # (urllib has no separate read-phase timeout)
+                    deadline = (
+                        time.monotonic() + request_timeout_ms / 1000
+                        if request_timeout_ms else None
+                    )
+                    opener = urllib.request.build_opener() if allow_redirects \
+                        else urllib.request.build_opener(_NoRedirect())
+                    seen = 0
+                    with opener.open(req, timeout=connect_s) as resp:
+                        expected = resp.headers.get("Content-Length")
+                        expected = int(expected) if expected else None
+                        received = 0
+                        buf = bytearray()
+                        while True:
+                            if deadline is not None and \
+                                    time.monotonic() > deadline:
+                                raise TimeoutError(
+                                    f"http.read exceeded request timeout "
+                                    f"{request_timeout_ms}ms"
+                                )
+                            chunk = resp.read(8192)
+                            if not chunk:
+                                if expected is not None and received < expected:
+                                    # premature close: http.client returns
+                                    # EOF instead of raising — surface it so
+                                    # the retry path resumes the stream
+                                    raise OSError(
+                                        f"connection closed after {received}"
+                                        f"/{expected} bytes"
+                                    )
+                                break
+                            received += len(chunk)
+                            buf.extend(chunk)
+                            # consume complete messages; one prefix-del per
+                            # chunk keeps this linear in stream size
+                            start = 0
+                            while True:
+                                pos = buf.find(delim, start)
+                                if pos < 0:
+                                    break
+                                seen += 1
+                                if seen > delivered:
+                                    self._deliver(bytes(buf[start:pos]))
+                                    delivered = seen
+                                start = pos + len(delim)
+                            if start:
+                                del buf[:start]
+                        if bytes(buf).strip():
+                            seen += 1
+                            if seen > delivered:
+                                self._deliver(bytes(buf))
+                                delivered = seen
+                    return  # stream finished cleanly
+                except urllib.error.HTTPError as exc:
+                    if (retry_codes and exc.code in retry_codes
+                            and attempt < n_retries):
+                        time.sleep(policy.delay_s(attempt))
+                        attempt += 1
+                        continue
+                    raise
+                except (OSError, TimeoutError, _http_client.HTTPException):
+                    if attempt < n_retries:
+                        time.sleep(policy.delay_s(attempt))
+                        attempt += 1
+                        continue
+                    raise
+
+        def _deliver(self, msg: bytes) -> None:
+            if response_mapper is not None:
+                msg = response_mapper(msg)
+            if format == "raw":
+                self.next_bytes(msg)
+            else:
+                self.next_json(json.loads(msg))
+
+    return io_python.read(
+        _HttpStreamSubject(), schema=schema,
+        autocommit_duration_ms=autocommit_duration_ms,
+        name=f"http:{url}",
+        persistent_id=kwargs.get("persistent_id"),
+    )
+
+
+import urllib.request as _urlreq  # noqa: E402
+
+
+class _NoRedirect(_urlreq.HTTPRedirectHandler):
+    def redirect_request(self, *args, **kwargs):
+        return None
+
+
 def rest_connector(
     host: str = "0.0.0.0",
     port: int = 8080,

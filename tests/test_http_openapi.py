@@ -213,3 +213,142 @@ def test_concurrency_bound_rejects_excess_with_503():
     t1.join(timeout=5)
     ws.shutdown()
     assert sorted(codes) == [200, 503]
+
+
+def test_http_read_streaming_source():
+    """pw.io.http.read: messages stream in over a delimited HTTP body
+    (reference: io/http read)."""
+    import http.server
+
+    pg.G.clear()
+    rows = [{"a": 1, "b": "x"}, {"a": 2, "b": "y"}, {"a": 3, "b": "z"}]
+
+    class Handler(http.server.BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_GET(self):
+            body = b"".join(json.dumps(r).encode() + b"\n" for r in rows)
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            # dribble the body to exercise incremental splitting
+            for i in range(0, len(body), 7):
+                self.wfile.write(body[i:i + 7])
+                self.wfile.flush()
+                time.sleep(0.01)
+
+    srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    port = srv.server_address[1]
+
+    class S(pw.Schema):
+        a: int
+        b: str
+
+    t = pw.io.http.read(f"http://127.0.0.1:{port}/stream", schema=S,
+                        autocommit_duration_ms=20)
+    got = []
+    pw.io.subscribe(t, on_change=lambda key, row, time, is_addition:
+                    got.append((row["a"], row["b"])))
+    pw.run(idle_stop_s=1.5, monitoring_level=pw.MonitoringLevel.NONE)
+    srv.shutdown()
+    assert sorted(got) == [(1, "x"), (2, "y"), (3, "z")]
+
+
+def test_http_read_raw_with_mapper_and_retry():
+    import http.server
+
+    pg.G.clear()
+    fails = {"n": 0}
+
+    class Handler(http.server.BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_GET(self):
+            if fails["n"] < 2:
+                fails["n"] += 1
+                self.send_response(503)
+                self.send_header("Content-Length", "0")
+                self.end_headers()
+                return
+            body = b"alpha|beta"
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+    srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    port = srv.server_address[1]
+
+    from pathway_tpu.io.http import RetryPolicy
+
+    t = pw.io.http.read(
+        f"http://127.0.0.1:{port}/", format="raw", delimiter=b"|",
+        n_retries=3, retry_policy=RetryPolicy(first_delay_ms=20),
+        response_mapper=lambda b: b.upper(), autocommit_duration_ms=20,
+    )
+    got = []
+    pw.io.subscribe(t, on_change=lambda key, row, time, is_addition:
+                    got.append(row["data"]))
+    pw.run(idle_stop_s=1.5, monitoring_level=pw.MonitoringLevel.NONE)
+    srv.shutdown()
+    assert sorted(got) == [b"ALPHA", b"BETA"]
+    assert fails["n"] == 2  # two 503s were retried through
+
+
+def test_http_read_mid_stream_reconnect_no_duplicates():
+    """A connection dropped mid-stream retries and must NOT re-deliver the
+    rows already pushed (delivered-count skip)."""
+    import http.server
+
+    pg.G.clear()
+    msgs = [b"m1", b"m2", b"m3", b"m4"]
+    state = {"attempt": 0}
+
+    class Handler(http.server.BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_GET(self):
+            state["attempt"] += 1
+            body = b"".join(m + b"\n" for m in msgs)
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            if state["attempt"] == 1:
+                # deliver only the first two messages, then die mid-stream
+                self.wfile.write(msgs[0] + b"\n" + msgs[1] + b"\n")
+                self.wfile.flush()
+                self.connection.close()
+                return
+            self.wfile.write(body)
+
+    srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    port = srv.server_address[1]
+
+    from pathway_tpu.io.http import RetryPolicy
+
+    t = pw.io.http.read(
+        f"http://127.0.0.1:{port}/", format="raw", n_retries=3,
+        retry_policy=RetryPolicy(first_delay_ms=20),
+        autocommit_duration_ms=20,
+    )
+    got = []
+    pw.io.subscribe(t, on_change=lambda key, row, time, is_addition:
+                    got.append(row["data"]))
+    pw.run(idle_stop_s=1.5, monitoring_level=pw.MonitoringLevel.NONE)
+    srv.shutdown()
+    assert sorted(got) == msgs, got  # each message exactly once
+    assert state["attempt"] >= 2
+
+
+def test_http_read_raw_rejects_custom_schema():
+    class S(pw.Schema):
+        a: int
+
+    with pytest.raises(ValueError, match="raw"):
+        pw.io.http.read("http://x/", schema=S, format="raw")
