@@ -53,6 +53,9 @@ def _causal_attention(layer, x, n_heads: int):
     q = _proj(layer, x, "wq", "bq").reshape(B, T, H, hd)
     k = _proj(layer, x, "wk", "bk").reshape(B, T, H, hd)
     v = _proj(layer, x, "wv", "bv").reshape(B, T, H, hd)
+    # NOTE: this path is differentiated (lm_loss/make_train_step) — the
+    # Pallas flash kernel has no VJP, so training stays on the einsum path
+    # (XLA fuses it well); inference prefill() routes through flash.
     scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(hd)
     causal = jnp.tril(jnp.ones((T, T), bool))
     scores = jnp.where(causal[None, None, :, :], scores, -1e9)
@@ -85,19 +88,26 @@ def forward_logits(params: dict, cfg: DecoderConfig, token_ids: jax.Array) -> ja
 
 
 def prefill(params: dict, cfg: DecoderConfig, token_ids: jax.Array,
-            n_valid: jax.Array):
+            n_valid: jax.Array, *, flash: bool | None = None):
     """Full-context forward over the (padded) prompt, emitting the KV cache
     and the logits at position n_valid-1 (the next-token distribution).
 
     One O(T^2) pass at prompt time; every generated token after it is O(T)
     against the cache (reference serving path: xpacks/llm/llms.py calls an
-    external API per completion — here the whole loop is on-device)."""
+    external API per completion — here the whole loop is on-device).
+
+    `flash` routes attention through the fused Pallas kernel
+    (ops/attention_pallas.py) so scores stay in VMEM instead of a
+    (B,H,T,T) HBM tensor; default: on TPU for T >= 256.  Inference-only —
+    prefill is never differentiated, so the kernel's missing VJP is moot."""
     from .encoder import _proj
 
     dtype = _resolve_dtype(cfg.dtype)
     B, T = token_ids.shape
     H = cfg.n_heads
     hd = cfg.d_model // H
+    if flash is None:
+        flash = jax.default_backend() == "tpu" and T >= 256
     x = params["embed"].astype(dtype)[token_ids]
     x = x + params["pos_embed"].astype(dtype)[:T][None, :, :]
     eps = cfg.ln_eps
@@ -110,10 +120,21 @@ def prefill(params: dict, cfg: DecoderConfig, token_ids: jax.Array,
         k = _proj(layer, h, "wk", "bk").reshape(B, T, H, hd)
         v = _proj(layer, h, "wv", "bv").reshape(B, T, H, hd)
         cache.append({"k": k, "v": v})
-        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(hd)
-        scores = jnp.where(causal[None, None, :, :], scores, -1e9)
-        probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(h.dtype)
-        a = jnp.einsum("bhqk,bkhd->bqhd", probs, v).reshape(B, T, cfg.d_model)
+        if flash:
+            from ..ops.attention_pallas import flash_attention
+
+            a = flash_attention(q, k, v, causal=True).reshape(
+                B, T, cfg.d_model
+            )
+        else:
+            scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(hd)
+            scores = jnp.where(causal[None, None, :, :], scores, -1e9)
+            probs = jax.nn.softmax(
+                scores.astype(jnp.float32), axis=-1
+            ).astype(h.dtype)
+            a = jnp.einsum("bhqk,bkhd->bqhd", probs, v).reshape(
+                B, T, cfg.d_model
+            )
         x = x + _proj(layer, a, "wo", "bo")
         h = _layer_norm(x, layer["ln2_scale"], layer["ln2_bias"], eps)
         ff = act(_proj(layer, h, "w_up", "b_up"))

@@ -35,3 +35,26 @@ def test_flash_fallback_without_pallas():
     out = flash_attention(q, q, q, use_pallas=False)
     ref = reference_attention(q, q, q)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref))
+
+
+def test_decoder_prefill_flash_wiring():
+    """prefill(flash=True) routes the serving prefill through
+    flash_attention (XLA fallback on CPU) — logits and KV cache must match
+    the einsum path."""
+    from pathway_tpu.models.decoder import (
+        DecoderConfig, init_decoder_params, prefill,
+    )
+
+    cfg = DecoderConfig(vocab_size=128, d_model=32, n_layers=2, n_heads=2,
+                        d_ff=64, max_len=64, dtype="float32")
+    params = init_decoder_params(cfg, jax.random.PRNGKey(0))
+    rng = np.random.default_rng(0)
+    ids = jnp.asarray(rng.integers(0, 128, (2, 24)), jnp.int32)
+    n_valid = jnp.asarray([24, 20], jnp.int32)
+
+    logits_b, cache_b = prefill(params, cfg, ids, n_valid, flash=False)
+    logits_f, cache_f = prefill(params, cfg, ids, n_valid, flash=True)
+    np.testing.assert_allclose(np.asarray(logits_f), np.asarray(logits_b),
+                               rtol=1e-5, atol=1e-5)
+    for cb, cf in zip(cache_b, cache_f):
+        np.testing.assert_allclose(np.asarray(cf["k"]), np.asarray(cb["k"]))
