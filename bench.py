@@ -93,9 +93,13 @@ def _ensure_healthy_backend() -> None:
     mid-run by _late_tpu_attempt()."""
     if os.environ.get("PW_BENCH_BACKEND_CHECKED"):
         return
+    # default ladder: 3 minutes of patience (vs r3's 3x5s) — generous for a
+    # slow-but-alive tunnel while leaving the driver's budget room for the
+    # full CPU-fallback sections if the tunnel is truly wedged; raise via
+    # env when a longer wait is known to be affordable
     timeouts = [
         int(x) for x in os.environ.get(
-            "PW_BENCH_PROBE_TIMEOUTS", "60,120,300"
+            "PW_BENCH_PROBE_TIMEOUTS", "60,120"
         ).split(",")
     ]
     log = _probe_log()

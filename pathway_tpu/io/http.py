@@ -730,6 +730,10 @@ def rest_connector(
     endpoint's request schema is published in OpenAPI form at ``/_schema``
     (reference: io/http/_server.py rest_connector).
     """
+    if keep_queries:
+        # reference alias: keep_queries=True retains query rows (the
+        # inverse of delete_completed_queries)
+        delete_completed_queries = False
     if schema is None:
         from ..internals.schema import schema_from_types
 
