@@ -231,12 +231,13 @@ def test_streaming_with_pipeline_threads():
 def test_fuzzed_random_graphs_match_sequential():
     """Randomized multi-branch pipelines: level-parallel execution must be
     bit-identical to sequential across shapes the targeted tests miss
-    (diamonds with uneven depths, chained joins, filters, unions)."""
+    (diamonds with uneven depths, joins, filters, groupbys, unions)."""
     import random
 
     from pathway_tpu.engine.runner import run_tables
 
     def build_and_run(seed: int, threads: int):
+        saved = os.environ.get("PATHWAY_PIPELINE_THREADS")
         os.environ["PATHWAY_PIPELINE_THREADS"] = str(threads)
         try:
             pg.G.clear()
@@ -252,17 +253,23 @@ def test_fuzzed_random_graphs_match_sequential():
             branches = [t]
             for i in range(rng.randrange(2, 5)):
                 b = rng.choice(branches)
-                op = rng.randrange(3)
+                op = rng.randrange(4)
                 if op == 0:
                     branches.append(b.select(b.k, a=b.a + i))
                 elif op == 1:
                     branches.append(b.filter(b.a % (i + 2) != 0))
-                else:
+                elif op == 2:
                     branches.append(
                         b.groupby(b.k).reduce(
                             b.k, a=pw.reducers.sum(b.a)
                         )
                     )
+                else:
+                    # two-port operator: joins exercise cross-level
+                    # dependencies and multi-port delivery order
+                    other = rng.choice(branches)
+                    j = b.join(other, b.k == other.k)
+                    branches.append(j.select(b.k, a=b.a + other.a))
             # merge everything: concat pairs then a final groupby
             merged = branches[0].select(branches[0].k, a=branches[0].a)
             for b in branches[1:]:
@@ -274,7 +281,10 @@ def test_fuzzed_random_graphs_match_sequential():
             [cap] = run_tables(out)
             return sorted(tuple(r) for r in cap.squash().values())
         finally:
-            del os.environ["PATHWAY_PIPELINE_THREADS"]
+            if saved is None:
+                del os.environ["PATHWAY_PIPELINE_THREADS"]
+            else:
+                os.environ["PATHWAY_PIPELINE_THREADS"] = saved
 
     for seed in range(8):
         seq = build_and_run(seed, 1)
