@@ -268,6 +268,8 @@ def test_fuzzed_random_graphs_match_sequential():
                     # two-port operator: joins exercise cross-level
                     # dependencies and multi-port delivery order
                     other = rng.choice(branches)
+                    if other is b:
+                        other = other.copy()  # self-join needs a copy
                     j = b.join(other, b.k == other.k)
                     branches.append(j.select(b.k, a=b.a + other.a))
             # merge everything: concat pairs then a final groupby
