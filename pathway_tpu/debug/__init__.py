@@ -22,6 +22,8 @@ __all__ = [
     "table_from_markdown",
     "table_from_rows",
     "table_from_pandas",
+    "table_from_parquet",
+    "table_to_parquet",
     "table_to_pandas",
     "table_to_dicts",
     "compute_and_print",
@@ -248,6 +250,22 @@ def table_to_dicts(table: Table):
         for i, name in enumerate(cap.column_names)
     }
     return keys, columns
+
+
+def table_from_parquet(path, id_from: list[str] | None = None,
+                       schema=None) -> Table:
+    """Static table from a parquet file (reference: debug/table_from_parquet)."""
+    import pandas as pd
+
+    return table_from_pandas(pd.read_parquet(path), id_from=id_from,
+                             schema=schema)
+
+
+def table_to_parquet(table: Table, filename) -> None:
+    """Run the graph and write the table's final state to parquet
+    (reference: debug/table_to_parquet)."""
+    df = table_to_pandas(table, include_id=False)
+    df.to_parquet(filename)
 
 
 def table_to_pandas(table: Table, include_id: bool = True):

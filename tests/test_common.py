@@ -436,3 +436,24 @@ def test_sql_groupby():
     out = pw.sql("SELECT g, SUM(v) AS s FROM tab GROUP BY g", tab=t)
     state = run_and_squash(out)
     assert sorted(state.values()) == [("a", 3), ("b", 5)]
+
+
+def test_parquet_roundtrip(tmp_path):
+    """debug.table_to_parquet / table_from_parquet (reference parity)."""
+    import pathway_tpu as pw
+    from pathway_tpu.internals import parse_graph as pg
+
+    pg.G.clear()
+    t = pw.debug.table_from_markdown(
+        """
+        a | b
+        1 | x
+        2 | y
+        """
+    )
+    path = tmp_path / "t.parquet"
+    pw.debug.table_to_parquet(t, path)
+    pg.G.clear()
+    t2 = pw.debug.table_from_parquet(path)
+    df = pw.debug.table_to_pandas(t2, include_id=False)
+    assert sorted(zip(df["a"], df["b"])) == [(1, "x"), (2, "y")]
