@@ -168,3 +168,18 @@ class SubjectDataSource(DataSource):
         fn = getattr(self.subject, "seek", None)
         if fn is not None:
             fn(offsets)
+
+    @property
+    def replays_from_scratch(self) -> bool:
+        """True when a restart re-emits already-consumed events: the
+        persistence wrapper must skip the re-read prefix or journal replay
+        double-ingests.  Opt-in via the subject's `deterministic_rerun`
+        flag — broker-push subjects (mqtt/nats/rabbitmq/rest) only deliver
+        NEW events after a restart, so skipping would eat real data; only
+        subjects whose run() deterministically re-emits the same stream
+        (python generators, demo streams, http stream re-reads) qualify,
+        and a subject with real seek support never needs the skip."""
+        return (
+            getattr(self.subject, "seek", None) is None
+            and bool(getattr(self.subject, "deterministic_rerun", False))
+        )

@@ -15,7 +15,16 @@ from ._utils import coerce_value, make_input_table
 
 class ConnectorSubject:
     """Subclass and implement run(); call self.next(**values) / next_json /
-    next_str / next_bytes; close() ends the stream."""
+    next_str / next_bytes; close() ends the stream.
+
+    Persistence contract: run() is assumed to deterministically re-emit the
+    same event stream when the process restarts (`deterministic_rerun`),
+    so the persistence layer skips the already-journaled prefix instead of
+    double-ingesting.  A subject that only delivers NEW events after a
+    restart (broker subscription style) must set deterministic_rerun =
+    False — or implement seek()/get_offsets() for real offset support."""
+
+    deterministic_rerun = True
 
     _source: SubjectDataSource | None = None
     _colnames: list[str] = []

@@ -15,6 +15,7 @@ import dataclasses
 import json
 import os
 import pickle
+from collections import Counter
 from typing import Any
 
 
@@ -503,8 +504,6 @@ def attach_persistence(runner, config: Config) -> None:
         # per-key counts of events folded into restored operator state: a
         # static source's live events covered by these counts must NOT be
         # re-injected (they are already inside the snapshot)
-        from collections import Counter
-
         fold_counts: Counter = Counter()
         for rs in read_streams:
             fold_seq = folded.get(rs, -1)
@@ -553,6 +552,10 @@ def attach_persistence(runner, config: Config) -> None:
             and nprocs <= 1
             and n_records > 8
             and hasattr(backend, "replace_all")
+            # from-scratch sources re-emit their FULL history incl. net-zero
+            # insert+retract pairs; compaction nets those out of the journal
+            # and would break the prefix-count skip on restart
+            and not getattr(source, "replays_from_scratch", False)
         ):
             compacted = _compact_events(replayed)
             seq = journal_seqs.get(base_stream, n_records - 1)
@@ -589,6 +592,19 @@ def attach_persistence(runner, config: Config) -> None:
         )
         mgr.journal_seqs = journal_seqs
         runner._snapshot_mgr = mgr
+
+
+def _prefix_skip(counts: Counter, events: list) -> list:
+    """Drop the first counts[key] occurrences of each key (MUTATES counts):
+    the already-journaled/folded prefix of a deterministically re-run
+    stream.  Occurrences beyond the prefix are genuinely fresh."""
+    fresh = []
+    for e in events:
+        if counts.get(e[1], 0) > 0:
+            counts[e[1]] -= 1
+        else:
+            fresh.append(e)
+    return fresh
 
 
 def _parse_record(rec: bytes, position: int):
@@ -722,23 +738,29 @@ def _wrap_source_with_persistence(source, backend: Backend, stream: str,
         # folded into a restored operator snapshot count toward the journal
         # prefix but are NOT returned — their effect is already in the
         # restored state.
-        from collections import Counter
-
         jcount = Counter(e[1] for e in replayed)
         if folded_counts:
             jcount.update(folded_counts)
-        seen_now: Counter = Counter()
-        fresh = []
-        for e in live:
-            seen_now[e[1]] += 1
-            if seen_now[e[1]] > jcount.get(e[1], 0):
-                fresh.append(e)
+        fresh = _prefix_skip(jcount, live)
         if fresh:
             _journal(fresh)
         return _retime(replayed + fresh)
 
+    # deterministic-rerun live sources (python/demo/http-stream subjects
+    # without seek) re-emit the whole stream on restart: skip the first
+    # count(key) occurrences of each replayed/folded key, same prefix-count
+    # idiom as static sources — otherwise journal replay + the re-run
+    # subject double-ingests
+    skip_counts = Counter()
+    if getattr(source, "replays_from_scratch", False):
+        skip_counts = Counter(e[1] for e in replayed)
+        if folded_counts:
+            skip_counts.update(folded_counts)
+
     def journaling_poll():
         events = orig_poll()
+        if events and skip_counts:
+            events = _prefix_skip(skip_counts, events)
         if events:
             offsets = source.get_offsets() if hasattr(source, "get_offsets") else None
             # the exclusive reader journals everything it read (no ownership

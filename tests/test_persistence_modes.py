@@ -248,3 +248,69 @@ def test_persistence_mode_enum_accepted(tmp_path):
         persistence_mode=pw.PersistenceMode.SPEEDRUN_REPLAY,
     )
     assert cfg.persistence_mode == "speedrun_replay"
+
+
+def test_offsetless_subject_source_exactly_once_on_restart(tmp_path):
+    """A python ConnectorSubject (no seek support) re-emits its whole
+    stream on restart; the persistence wrapper must skip the re-read
+    prefix so journal replay + the re-run subject never double-ingests —
+    while genuinely NEW events past the prefix still arrive."""
+    backend = pw.persistence.Backend.filesystem(str(tmp_path / "p"))
+
+    class VS(pw.Schema):
+        v: int
+
+    def run_once(n_events):
+        class Sub(pw.io.python.ConnectorSubject):
+            def run(self):
+                for i in range(n_events):
+                    self.next(v=i)
+
+        pg.G.clear()
+        t = pw.io.python.read(Sub(), schema=VS)
+        got = []
+        pw.io.subscribe(t, on_change=lambda key, row, time, is_addition:
+                        got.append(row["v"]))
+        pw.run(idle_stop_s=1.0, autocommit_duration_ms=20,
+               persistence_config=pw.persistence.Config(backend),
+               monitoring_level=pw.MonitoringLevel.NONE)
+        return sorted(got)
+
+    assert run_once(3) == [0, 1, 2]
+    assert run_once(3) == [0, 1, 2]  # restart: no duplicates
+    # upstream grew: the new event lands exactly once on top of the replay
+    assert run_once(4) == [0, 1, 2, 3]
+    assert run_once(4) == [0, 1, 2, 3]
+
+
+def test_broker_style_subject_not_prefix_skipped(tmp_path):
+    """A subject that only delivers NEW events after restart (broker
+    subscription: deterministic_rerun=False) must never have its fresh
+    events eaten by the prefix skip, even though auto-keys restart at 0."""
+    backend = pw.persistence.Backend.filesystem(str(tmp_path / "p"))
+
+    class VS(pw.Schema):
+        v: int
+
+    def run_once(values):
+        class Sub(pw.io.python.ConnectorSubject):
+            deterministic_rerun = False  # broker: replays nothing
+
+            def run(self):
+                for i in values:
+                    self.next(v=i)
+
+        pg.G.clear()
+        t = pw.io.python.read(Sub(), schema=VS)
+        got = []
+        pw.io.subscribe(t, on_change=lambda key, row, time, is_addition:
+                        got.append(row["v"]))
+        pw.run(idle_stop_s=1.0, autocommit_duration_ms=20,
+               persistence_config=pw.persistence.Config(backend),
+               monitoring_level=pw.MonitoringLevel.NONE)
+        return sorted(got)
+
+    assert run_once([0, 1, 2]) == [0, 1, 2]
+    # restart: the broker delivers only NEW events; replay brings back the
+    # journaled history and the new events all land
+    assert run_once([3, 4]) == [0, 1, 2, 3, 4]
