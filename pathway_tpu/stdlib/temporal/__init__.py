@@ -40,7 +40,7 @@ from .temporal_behavior import (
     exactly_once_behavior,
 )
 from ._sort import sort
-from .time_utils import inactivity_detection, utc_now
+from .time_utils import add_update_timestamp_utc, inactivity_detection, utc_now
 
 __all__ = [
     "windowby", "tumbling", "sliding", "session", "intervals_over", "Window",
@@ -51,5 +51,6 @@ __all__ = [
     "asof_now_join", "asof_now_join_inner", "asof_now_join_left",
     "common_behavior", "exactly_once_behavior", "Behavior", "CommonBehavior",
     "ExactlyOnceBehavior", "sort", "inactivity_detection", "utc_now",
+    "add_update_timestamp_utc",
     "AsofJoinResult",
 ]

@@ -17,8 +17,16 @@ def utc_now(refresh_rate=None):
     )
 
 
-def add_update_timestamp_utc(table: Table, column_name: str = "updated_at") -> Table:
-    return table.with_columns(**{column_name: utc_now()})
+def add_update_timestamp_utc(
+    table: Table, refresh_rate=None,
+    update_timestamp_column_name: str = "updated_timestamp_utc",
+    column_name: str | None = None,
+) -> Table:
+    """Adds a column with the UTC timestamp of the last row update
+    (reference: stdlib/temporal/time_utils.py:191; `column_name` kept as a
+    short alias for the reference's update_timestamp_column_name)."""
+    name = column_name or update_timestamp_column_name
+    return table.with_columns(**{name: utc_now(refresh_rate)})
 
 
 def inactivity_detection(
