@@ -221,6 +221,18 @@ class UDF:
         self._return_type = return_type if return_type is not None else dt.ANY
         self._deterministic = deterministic
         self._propagate_none = propagate_none
+        if isinstance(executor, AutoExecutor):
+            # resolve here (not only in @udf) so direct UDF construction
+            # and __wrapped__ subclasses get the deduced executor too
+            executor = (
+                async_executor() if asyncio.iscoroutinefunction(fun)
+                else sync_executor()
+            )
+        elif asyncio.iscoroutinefunction(fun) and not isinstance(
+            executor, AsyncExecutor
+        ):
+            # a coroutine can only run on an async executor
+            executor = async_executor()
         self._executor = executor or SyncExecutor()
         self._cache_strategy = cache_strategy
         self._max_batch_size = max_batch_size
@@ -308,10 +320,10 @@ def udf(
     """Decorator: turn a Python function into a column-expression UDF."""
 
     def make(f):
-        if asyncio.iscoroutinefunction(f) and not isinstance(executor, AsyncExecutor):
-            ex = async_executor()
-        else:
-            ex = executor
+        # AutoExecutor / coroutine deduction happens in UDF.__init__ so
+        # every construction path (decorator, direct, __wrapped__ subclass)
+        # resolves identically
+        ex = executor
         return UDF(
             f,
             return_type=return_type,
@@ -334,4 +346,74 @@ def async_apply_expression(fun, args, kwargs):
 
 # compat names mirrored from the reference udfs module
 async_options = async_executor
-coerce_async = lambda f: f
+
+
+def coerce_async(func):
+    """Wrap a regular function as a coroutine (reference: udfs/utils.py
+    coerce_async); coroutine functions pass through unchanged."""
+    if asyncio.iscoroutinefunction(func):
+        return func
+
+    @functools.wraps(func)
+    async def wrapper(*args, **kwargs):
+        return func(*args, **kwargs)
+
+    return wrapper
+
+
+def auto_executor() -> Executor:
+    """Deduce sync vs async from the function signature at wrap time
+    (reference: udfs/executors.py auto_executor)."""
+    return AutoExecutor()
+
+
+class AutoExecutor(Executor):
+    """Marker resolved by @udf: coroutine functions get the async executor,
+    plain functions the sync one."""
+
+
+def with_capacity(func, capacity: int):
+    """Bound concurrent invocations of an async (or auto-coerced) function
+    with a semaphore (reference: udfs/executors.py:328).  The engine runs
+    each micro-batch on a fresh event loop (async_ops.run_coroutine_batch),
+    and asyncio primitives bind to the loop they first block on — so the
+    semaphore is keyed per running loop."""
+    import weakref
+
+    func = coerce_async(func)
+    per_loop: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
+
+    @functools.wraps(func)
+    async def wrapper(*args, **kwargs):
+        loop = asyncio.get_running_loop()
+        sem = per_loop.get(loop)
+        if sem is None:
+            sem = per_loop[loop] = asyncio.Semaphore(capacity)
+        async with sem:
+            return await func(*args, **kwargs)
+
+    return wrapper
+
+
+def with_timeout(func, timeout: float):
+    """Cancel the call and raise after `timeout` seconds
+    (reference: udfs/executors.py:354)."""
+    func = coerce_async(func)
+
+    @functools.wraps(func)
+    async def wrapper(*args, **kwargs):
+        return await asyncio.wait_for(func(*args, **kwargs), timeout=timeout)
+
+    return wrapper
+
+
+def with_retry_strategy(func, retry_strategy: "AsyncRetryStrategy"):
+    """Apply a retry strategy to an async (or auto-coerced) function
+    (reference: udfs/retries.py:20)."""
+    func = coerce_async(func)
+
+    @functools.wraps(func)
+    async def wrapper(*args, **kwargs):
+        return await retry_strategy.invoke(func, *args, **kwargs)
+
+    return wrapper

@@ -132,3 +132,71 @@ def test_nondeterministic_sync_udf_stateful_path():
     out = t.select(r=seq(t.a))
     [cap] = run_tables(out)
     assert cap.squash() == {}
+
+
+def test_udf_composition_helpers():
+    """auto_executor / with_capacity / with_timeout / with_retry_strategy
+    (reference: udfs/executors.py:48,328,354, udfs/retries.py:20)."""
+    import asyncio
+
+    import pathway_tpu as pw
+    from pathway_tpu.internals import parse_graph as pg
+    from pathway_tpu.internals.udfs import AsyncExecutor, SyncExecutor
+
+    pg.G.clear()
+    t = pw.debug.table_from_markdown(
+        """
+        a
+        1
+        2
+        """
+    )
+
+    @pw.udf(executor=pw.udfs.auto_executor())
+    def double(x: int) -> int:
+        return x * 2
+
+    @pw.udf(executor=pw.udfs.auto_executor())
+    async def triple(x: int) -> int:
+        return x * 3
+
+    assert isinstance(double._executor, SyncExecutor)
+    assert isinstance(triple._executor, AsyncExecutor)
+
+    calls = []
+
+    async def flaky(x):
+        calls.append(x)
+        if len(calls) < 2:
+            raise RuntimeError("transient")
+        return x + 100
+
+    wrapped = pw.udfs.with_retry_strategy(
+        pw.udfs.with_timeout(pw.udfs.with_capacity(flaky, 2), 5.0),
+        pw.udfs.ExponentialBackoffRetryStrategy(max_retries=3,
+                                                initial_delay=10),
+    )
+
+    @pw.udf
+    async def resilient(x: int) -> int:
+        return await wrapped(x)
+
+    out = t.select(d=double(t.a), tr=triple(t.a), r=resilient(t.a))
+    df = pw.debug.table_to_pandas(out, include_id=False)
+    assert sorted(df["d"]) == [2, 4]
+    assert sorted(df["tr"]) == [3, 6]
+    assert sorted(df["r"]) == [101, 102]
+
+    # with_timeout cancels a hung call with the specific timeout error
+    import pytest
+
+    async def hang(x):
+        await asyncio.sleep(30)
+
+    timed = pw.udfs.with_timeout(hang, 0.05)
+    loop = asyncio.new_event_loop()
+    try:
+        with pytest.raises(asyncio.TimeoutError):
+            loop.run_until_complete(timed(1))
+    finally:
+        loop.close()
