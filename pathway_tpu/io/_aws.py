@@ -84,3 +84,76 @@ def aws_call(creds: AwsCredentials, service: str, target: str,
     with urllib.request.urlopen(req, timeout=30) as resp:
         out = resp.read()
     return json.loads(out) if out.strip() else {}
+
+
+def sign_rest_request(creds: AwsCredentials, service: str, host: str,
+                      path: str, body: bytes,
+                      content_type: str = "application/json",
+                      amz_date: str | None = None) -> dict:
+    """Headers for a signed REST-style POST {path} (e.g. Bedrock Converse:
+    POST /model/{modelId}/converse).  Canonical URI is the URI-encoded
+    path; otherwise identical SigV4 flow to sign_request."""
+    import urllib.parse
+
+    now = amz_date or datetime.datetime.now(
+        datetime.timezone.utc
+    ).strftime("%Y%m%dT%H%M%SZ")
+    datestamp = now[:8]
+    payload_hash = hashlib.sha256(body).hexdigest()
+    headers = {
+        "content-type": content_type,
+        "host": host,
+        "x-amz-date": now,
+    }
+    if creds.session_token:
+        headers["x-amz-security-token"] = creds.session_token
+    signed_headers = ";".join(sorted(headers))
+    # SigV4 for non-S3 services canonicalizes the DOUBLE-encoded path (the
+    # wire URL carries single encoding; AWS re-encodes it server-side when
+    # building its own canonical request — botocore does the same)
+    canonical_uri = urllib.parse.quote(
+        urllib.parse.quote(path, safe="/"), safe="/"
+    )
+    canonical = "\n".join([
+        "POST", canonical_uri, "",
+        "".join(f"{k}:{headers[k]}\n" for k in sorted(headers)),
+        signed_headers, payload_hash,
+    ])
+    scope = f"{datestamp}/{creds.region}/{service}/aws4_request"
+    to_sign = "\n".join([
+        "AWS4-HMAC-SHA256", now, scope,
+        hashlib.sha256(canonical.encode()).hexdigest(),
+    ])
+    k = _hmac(f"AWS4{creds.secret_key}".encode(), datestamp)
+    k = _hmac(k, creds.region)
+    k = _hmac(k, service)
+    k = _hmac(k, "aws4_request")
+    signature = hmac.new(k, to_sign.encode(), hashlib.sha256).hexdigest()
+    headers["authorization"] = (
+        f"AWS4-HMAC-SHA256 Credential={creds.access_key}/{scope}, "
+        f"SignedHeaders={signed_headers}, Signature={signature}"
+    )
+    return headers
+
+
+def aws_rest_call(creds: AwsCredentials, service: str, path: str,
+                  payload: dict, *, endpoint: str | None = None,
+                  _http=None) -> dict:
+    """One signed REST POST (e.g. bedrock-runtime /model/{id}/converse)."""
+    import urllib.parse
+
+    host = (
+        endpoint.split("://", 1)[-1].split("/")[0]
+        if endpoint else f"{service}.{creds.region}.amazonaws.com"
+    )
+    url = (endpoint or f"https://{host}").rstrip("/") + urllib.parse.quote(
+        path, safe="/"
+    )
+    body = json.dumps(payload).encode()
+    headers = sign_rest_request(creds, service, host, path, body)
+    if _http is not None:  # test seam
+        return _http(url, path, payload, headers)
+    req = urllib.request.Request(url, data=body, headers=headers,
+                                 method="POST")
+    with urllib.request.urlopen(req, timeout=60) as resp:
+        return json.loads(resp.read())

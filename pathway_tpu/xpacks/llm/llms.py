@@ -119,6 +119,69 @@ class HFPipelineChat(BaseChat):
         return out[0]["generated_text"]
 
 
+class BedrockChat(BaseChat):
+    """AWS Bedrock chat via the Converse REST API, spoken natively with
+    SigV4 (reference: xpacks/llm/llms.py:771 — boto3 wrapper; here the
+    wire protocol is implemented directly like the kinesis/dynamodb
+    connectors, with an injectable `_http` test seam).
+
+    Credentials: explicit args or AWS_ACCESS_KEY_ID / AWS_SECRET_ACCESS_KEY
+    / AWS_SESSION_TOKEN / AWS_REGION environment variables."""
+
+    def __init__(self, model_id: str = "anthropic.claude-3-haiku-20240307-v1:0",
+                 *, region: str | None = None, access_key: str | None = None,
+                 secret_key: str | None = None, session_token: str | None = None,
+                 endpoint: str | None = None, max_tokens: int = 512,
+                 temperature: float | None = None, capacity=None,
+                 cache_strategy=None, retry_strategy=None, _http=None,
+                 **kwargs):
+        import os
+
+        self.model_id = model_id
+        self.region = region or os.environ.get("AWS_REGION", "us-east-1")
+        self.access_key = access_key or os.environ.get("AWS_ACCESS_KEY_ID", "")
+        self.secret_key = secret_key or os.environ.get("AWS_SECRET_ACCESS_KEY", "")
+        self.session_token = session_token or os.environ.get("AWS_SESSION_TOKEN")
+        self.endpoint = endpoint
+        self.max_tokens = max_tokens
+        self.temperature = temperature
+        self._http = _http
+        self.kwargs = kwargs
+
+    def _call_llm(self, messages, **kwargs) -> str:
+        from ...io._aws import AwsCredentials, aws_rest_call
+
+        creds = AwsCredentials(self.access_key, self.secret_key, self.region,
+                               self.session_token)
+        system = [
+            {"text": m.get("content", "")}
+            for m in messages if m.get("role") == "system"
+        ]
+        conv = [
+            {"role": m.get("role", "user"),
+             "content": [{"text": m.get("content", "")}]}
+            for m in messages if m.get("role") != "system"
+        ]
+        inference: dict = {"maxTokens": kwargs.get("max_tokens",
+                                                   self.max_tokens)}
+        temp = kwargs.get("temperature", self.temperature)
+        if temp is not None:
+            inference["temperature"] = temp
+        # extra Converse inference params (topP, stopSequences, ...) pass
+        # through, constructor kwargs overridden by per-call kwargs
+        for k, v in {**self.kwargs, **kwargs}.items():
+            if k not in ("max_tokens", "temperature") and v is not None:
+                inference[k] = v
+        payload: dict = {"messages": conv, "inferenceConfig": inference}
+        if system:
+            payload["system"] = system
+        out = aws_rest_call(
+            creds, "bedrock-runtime", f"/model/{self.model_id}/converse",
+            payload, endpoint=self.endpoint, _http=self._http,
+        )
+        return out["output"]["message"]["content"][0]["text"]
+
+
 class CohereChat(BaseChat):
     def __init__(self, model: str = "command", **kwargs):
         self.model = model

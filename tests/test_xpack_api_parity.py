@@ -1,0 +1,153 @@
+"""Late API-parity additions: BedrockChat (native SigV4 Converse),
+AudioParser (Whisper REST), TwelveLabsVideoParser, ParseUnstructured,
+default_vision_llm, indexing default factories + metric enums."""
+
+import json
+
+import pytest
+
+import pathway_tpu as pw
+
+
+def test_bedrock_chat_converse_wire():
+    from pathway_tpu.xpacks.llm.llms import BedrockChat
+
+    seen = {}
+
+    def fake_http(url, path, payload, headers):
+        seen.update(url=url, path=path, payload=payload, headers=headers)
+        return {"output": {"message": {"content": [{"text": "hi there"}]}}}
+
+    chat = BedrockChat(model_id="anthropic.claude-3-haiku-20240307-v1:0",
+                       region="us-east-1", access_key="AK", secret_key="SK",
+                       _http=fake_http)
+    out = chat([{"role": "system", "content": "be brief"},
+                {"role": "user", "content": "hello"}])
+    assert out == "hi there"
+    assert "/model/anthropic.claude-3-haiku-20240307-v1%3A0/converse" in seen["url"]
+    assert seen["payload"]["messages"][0]["content"][0]["text"] == "hello"
+    assert seen["payload"]["system"] == [{"text": "be brief"}]
+    assert seen["headers"]["authorization"].startswith("AWS4-HMAC-SHA256")
+    assert "bedrock-runtime" in seen["headers"]["authorization"]
+    # extra inference params pass through to the Converse payload
+    chat2 = BedrockChat(region="us-east-1", access_key="AK", secret_key="SK",
+                        topP=0.9, _http=fake_http)
+    chat2("hello")
+    assert seen["payload"]["inferenceConfig"]["topP"] == 0.9
+
+
+def test_sigv4_rest_double_encodes_canonical_uri():
+    from pathway_tpu.io._aws import AwsCredentials, sign_rest_request
+
+    creds = AwsCredentials("AK", "SK", "us-east-1")
+    path = "/model/anthropic.claude-3-haiku-20240307-v1:0/converse"
+    h1 = sign_rest_request(creds, "bedrock-runtime", "h", path, b"{}",
+                           amz_date="20260101T000000Z")
+    # signing the SINGLE-encoded path must give a DIFFERENT signature:
+    # AWS canonicalizes the double-encoded form (botocore non-S3 rule)
+    h2 = sign_rest_request(creds, "bedrock-runtime", "h",
+                           path.replace(":", "%3A"), b"{}",
+                           amz_date="20260101T000000Z")
+    assert h1["authorization"] != h2["authorization"]
+
+
+def test_audio_parser_whisper_wire():
+    from pathway_tpu.xpacks.llm.parsers import AudioParser
+
+    seen = {}
+
+    def fake_http(url, body, headers):
+        seen.update(url=url, body=body, headers=headers)
+        return {"text": "transcribed words"}
+
+    p = AudioParser(api_key="sk-x", _http=fake_http)
+    [(text, meta)] = p._parse(b"RIFFfakeaudio")
+    assert text == "transcribed words"
+    assert meta["model"] == "whisper-1"
+    assert seen["url"].endswith("/audio/transcriptions")
+    assert b"RIFFfakeaudio" in seen["body"]
+    assert b'name="model"' in seen["body"]
+    # format is inferred from the filename extension: sniffed from magic
+    assert b'filename="audio.wav"' in seen["body"]
+    assert seen["headers"]["Authorization"] == "Bearer sk-x"
+
+
+def test_twelvelabs_video_parser_flow():
+    from pathway_tpu.xpacks.llm.parsers import TwelveLabsVideoParser
+
+    calls = []
+
+    def fake_http(method, url, payload, headers):
+        calls.append((method, url.rsplit("/", 1)[-1]))
+        if url.endswith("/tasks") and method == "POST":
+            return {"_id": "t1", "status": "pending", "video_id": "v9"}
+        if "/tasks/" in url:
+            return {"_id": "t1", "status": "ready", "video_id": "v9"}
+        if url.endswith("/generate"):
+            assert payload == {"video_id": "v9",
+                               "prompt": "Describe this video in detail."}
+            return {"data": "a cat jumps"}
+        raise AssertionError(url)
+
+    p = TwelveLabsVideoParser(api_key="tl-x", index_id="idx",
+                              poll_interval_s=0.01, _http=fake_http)
+    [(text, meta)] = p._parse(b"\x00video")
+    assert text == "a cat jumps"
+    assert meta["video_id"] == "v9"
+    assert [c[0] for c in calls] == ["POST", "GET", "POST"]
+
+
+def test_parse_unstructured_alias_and_vision_llm():
+    from pathway_tpu.xpacks.llm.llms import BaseChat
+    from pathway_tpu.xpacks.llm.parsers import (
+        ParseUnstructured, UnstructuredParser, default_vision_llm,
+    )
+
+    assert isinstance(ParseUnstructured(), UnstructuredParser)
+    assert isinstance(default_vision_llm(), BaseChat)
+
+
+def test_indexing_defaults_and_metric_enums():
+    import numpy as np
+
+    from pathway_tpu.internals import parse_graph as pg
+    from pathway_tpu.stdlib.indexing import (
+        BruteForceKnnFactory,
+        BruteForceKnnMetricKind,
+        DefaultKnnFactory,
+        USearchMetricKind,
+        default_brute_force_knn_document_index,
+        default_lsh_knn_document_index,
+        default_usearch_knn_document_index,
+    )
+
+    assert str(BruteForceKnnMetricKind.COS) == "cos"
+    assert str(USearchMetricKind.IP) == "dot"
+    assert issubclass(DefaultKnnFactory, BruteForceKnnFactory)
+
+    pg.G.clear()
+    from pathway_tpu.debug import table_from_rows
+    from pathway_tpu.engine.runner import run_tables
+
+    class D(pw.Schema):
+        vec: list
+
+    data = table_from_rows(D, [((1.0, 0.0),), ((0.0, 1.0),)])
+
+    class Q(pw.Schema):
+        qv: list
+
+    queries = table_from_rows(Q, [((1.0, 0.1),)])
+    for builder in (default_brute_force_knn_document_index,
+                    default_usearch_knn_document_index,
+                    default_lsh_knn_document_index):
+        idx = builder(
+            data.vec, data, dimensions=2,
+            metric=BruteForceKnnMetricKind.COS,
+        ) if builder is not default_lsh_knn_document_index else builder(
+            data.vec, data, dimensions=2,
+        )
+        res = idx.query_as_of_now(queries.qv, number_of_matches=1)
+        [cap] = run_tables(res.select(ids=res._pw_index_reply_id))
+        rows = list(cap.squash().values())
+        assert rows, builder.__name__
