@@ -336,6 +336,7 @@ class Table:
         node = pg.new_node("difference", [self, other])
         return Table(node, self._colnames, self._dtypes, Universe(parent=self._universe))
 
+
     def intersect(self, *others: "Table") -> "Table":
         node = pg.new_node("intersect", [self, *others])
         u = Universe(parent=self._universe)

@@ -192,3 +192,59 @@ def louvain_communities(vertices: Table, edges: Table, *, levels: int = 2,
         lifted = super_assign.ix(total.community)
         total = total.select(community=lifted.community)
     return total
+
+
+class Vertex:
+    """Vertex schema marker (reference: stdlib/graphs/common.py:10)."""
+
+
+class Edge:
+    """Edge schema marker: columns u, v point at the endpoint vertices
+    (reference: stdlib/graphs/common.py:14)."""
+
+
+@dataclasses.dataclass
+class WeightedGraph(Graph):
+    """Graph whose edges carry weights (reference: graphs/graph.py:121)."""
+
+    WE: Table | None = None
+
+    @staticmethod
+    def from_vertices_and_weighted_edges(V: Table, WE: Table) -> "WeightedGraph":
+        return WeightedGraph(V, WE, WE)
+
+
+def pagerank(edges: Table, steps: int = 5) -> Table:
+    """Integer-arithmetic PageRank over an edge table with columns u, v
+    (reference: stdlib/graphs/pagerank/impl.py:18 — same fixed-point
+    scheme: rank starts at 6000 per vertex, each step flows
+    rank*5/(6*degree) along edges plus a 1000 base; incremental by
+    construction, so edge updates revise ranks)."""
+    from ... import if_else
+    from ...internals.table import Table as _Table
+
+    # vertex tables keyed by the vertex pointer itself
+    inv0 = edges.groupby(edges.v).reduce(edges.v)
+    inv = inv0.with_id(inv0.v)
+    inv = inv.select(degree=0)
+    outv0 = edges.groupby(edges.u).reduce(edges.u, degree=R.count())
+    outv = outv0.with_id(outv0.u)
+    outv = outv.select(degree=outv.degree)
+    degrees = _Table.update_rows(inv, outv)
+    base = outv.difference(inv).select(rank=1_000)  # pure sources
+    ranks = degrees.select(rank=6_000)
+    for _ in range(steps):
+        outflow = degrees.select(
+            flow=if_else(
+                degrees.degree == 0, 0,
+                (ranks.rank * 5) // (degrees.degree * 6),
+            ),
+        )
+        per_edge = edges.select(edges.v, f=outflow.ix(edges.u).flow)
+        inflows0 = per_edge.groupby(per_edge.v).reduce(
+            per_edge.v, rank0=R.sum(per_edge.f)
+        )
+        inflows = inflows0.with_id(inflows0.v)
+        inflows = inflows.select(rank=inflows.rank0 + 1_000)
+        ranks = _Table.concat(base, inflows).with_universe_of(degrees)
+    return ranks

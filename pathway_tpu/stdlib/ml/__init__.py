@@ -3,7 +3,8 @@
 Reference: python/pathway/stdlib/ml/.
 """
 
-from . import classifiers, datasets, hmm, index, smart_table_ops
+from . import classifiers, datasets, hmm, index, smart_table_ops, utils
 from .index import KNNIndex
 
-__all__ = ["KNNIndex", "index", "classifiers", "smart_table_ops", "hmm", "datasets"]
+__all__ = ["KNNIndex", "index", "classifiers", "smart_table_ops", "hmm",
+           "datasets", "utils"]

@@ -21,6 +21,16 @@ from .parsers import Utf8Parser
 from .splitters import NullSplitter
 
 
+import enum
+
+
+class IndexingStatus(str, enum.Enum):
+    """Document indexing lifecycle (reference: document_store.py:49)."""
+
+    INDEXED = "INDEXED"
+    INGESTED = "INGESTED"
+
+
 class DocumentStore:
     """docs: table(s) with `data` (bytes|str) and optional `_metadata`."""
 

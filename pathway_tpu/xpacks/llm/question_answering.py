@@ -201,3 +201,145 @@ class DeckRetriever(BaseRAGQuestionAnswerer):
                 dt.JSON, reply.text, reply.metadata,
             )
         )
+
+
+# ---------------------------------------------------------------------------
+# ABCs + context processors + client (reference: question_answering.py
+# BaseQuestionAnswerer:388, SummaryQuestionAnswerer:427,
+# BaseContextProcessor:39, SimpleContextProcessor:75, RAGClient:1070)
+# ---------------------------------------------------------------------------
+
+
+class BaseContextProcessor:
+    """Formats retrieved documents into LLM context; subclasses implement
+    docs_to_context(list[dict]) -> str."""
+
+    def maybe_unwrap_docs(self, docs):
+        if isinstance(docs, Json):
+            docs = docs.value
+        return [d.value if isinstance(d, Json) else d for d in (docs or ())]
+
+    def docs_to_context(self, docs) -> str:
+        raise NotImplementedError
+
+    def __call__(self, docs) -> str:
+        return self.docs_to_context(self.maybe_unwrap_docs(docs))
+
+
+class SimpleContextProcessor(BaseContextProcessor):
+    """Keeps the chosen metadata keys and joins document texts."""
+
+    def __init__(self, context_metadata_keys=("path",),
+                 context_joiner: str = "\n\n"):
+        self.context_metadata_keys = list(context_metadata_keys)
+        self.context_joiner = context_joiner
+
+    def docs_to_context(self, docs) -> str:
+        out = []
+        for d in docs:
+            if not isinstance(d, dict):
+                out.append(str(d))
+                continue
+            text = d.get("text", "")
+            meta = d.get("metadata", {}) or {}
+            if isinstance(meta, Json):
+                meta = meta.value
+            kept = {k: meta.get(k) for k in self.context_metadata_keys
+                    if isinstance(meta, dict) and meta.get(k) is not None}
+            out.append(f"{text} {kept}" if kept else text)
+        return self.context_joiner.join(out)
+
+
+class BaseQuestionAnswerer:
+    """Serving ABC: answer_query/retrieve/statistics/inputs over tables
+    (reference: question_answering.py:388)."""
+
+    def answer_query(self, pw_ai_queries: Table) -> Table:
+        raise NotImplementedError
+
+    def retrieve(self, queries: Table) -> Table:
+        raise NotImplementedError
+
+    def statistics(self, queries: Table) -> Table:
+        raise NotImplementedError
+
+    def list_documents(self, queries: Table) -> Table:
+        raise NotImplementedError
+
+
+class SummaryQuestionAnswerer(BaseQuestionAnswerer):
+    """Adds summarize_query (reference: question_answering.py:427)."""
+
+    def summarize_query(self, summarize_queries: Table) -> Table:
+        raise NotImplementedError
+
+
+def send_post_request(url: str, data: dict, headers: dict | None = None,
+                      timeout: float | None = None):
+    """POST JSON, raise on HTTP errors, return the parsed response
+    (reference: question_answering.py:1062)."""
+    import urllib.request
+
+    req = urllib.request.Request(
+        url, json.dumps(data).encode(),
+        headers={"Content-Type": "application/json", **(headers or {})},
+        method="POST",
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return json.loads(resp.read())
+
+
+class RAGClient:
+    """Client for a served RAG app (reference: question_answering.py:1070).
+    Either (host and port) or url."""
+
+    def __init__(self, host: str | None = None, port: int | None = None,
+                 url: str | None = None, timeout: float | None = 90,
+                 additional_headers: dict | None = None):
+        err = "Either (`host` and `port`) or `url` must be provided, but not both."
+        if url is not None:
+            if host is not None or port is not None:
+                raise ValueError(err)
+            self.url = url
+        else:
+            if host is None:
+                raise ValueError(err)
+            port = port or 80
+            protocol = "https" if port == 443 else "http"
+            self.url = f"{protocol}://{host}:{port}"
+        self.timeout = timeout
+        self.additional_headers = additional_headers or {}
+
+    def _post(self, route: str, payload: dict):
+        return send_post_request(self.url + route, payload,
+                                 self.additional_headers, self.timeout)
+
+    def retrieve(self, query: str, k: int = 3,
+                 metadata_filter: str | None = None,
+                 filepath_globpattern: str | None = None):
+        payload = {"query": query, "k": k, "metadata_filter": metadata_filter}
+        if filepath_globpattern is not None:
+            payload["filepath_globpattern"] = filepath_globpattern
+        return self._post("/v1/retrieve", payload)
+
+    def statistics(self):
+        return self._post("/v1/statistics", {})
+
+    def pw_list_documents(self, filters: str | None = None):
+        payload = {"metadata_filter": filters} if filters else {}
+        return self._post("/v1/inputs", payload)
+
+    list_documents = pw_list_documents
+
+    def answer(self, prompt: str, filters: str | None = None,
+               model: str | None = None, return_context_docs=None) -> dict:
+        payload: dict = {"prompt": prompt}
+        if filters:
+            payload["filters"] = filters
+        if model:
+            payload["model"] = model
+        if return_context_docs is not None:
+            payload["return_context_docs"] = return_context_docs
+        return self._post("/v2/answer", payload)
+
+    pw_ai_answer = answer

@@ -151,3 +151,108 @@ def test_indexing_defaults_and_metric_enums():
         [cap] = run_tables(res.select(ids=res._pw_index_reply_id))
         rows = list(cap.squash().values())
         assert rows, builder.__name__
+
+
+def test_qa_context_processors_and_client_surface():
+    from pathway_tpu.xpacks.llm.question_answering import (
+        BaseQuestionAnswerer, RAGClient, SimpleContextProcessor,
+        SummaryQuestionAnswerer,
+    )
+
+    proc = SimpleContextProcessor(context_metadata_keys=["path"])
+    docs = [{"text": "alpha", "metadata": {"path": "/a", "junk": 1}},
+            {"text": "beta", "metadata": {}}]
+    ctx = proc(docs)
+    assert "alpha" in ctx and "beta" in ctx
+    assert "/a" in ctx and "junk" not in ctx
+
+    assert issubclass(SummaryQuestionAnswerer, BaseQuestionAnswerer)
+    c = RAGClient(host="h", port=443)
+    assert c.url == "https://h:443"
+    with __import__("pytest").raises(ValueError):
+        RAGClient(host="h", url="http://x")
+    with __import__("pytest").raises(ValueError):
+        RAGClient()
+
+
+def test_rag_client_against_live_server():
+    """RAGClient drives a real served RAG app end-to-end."""
+    import socket
+    import threading
+    import time
+
+    import pathway_tpu as pw
+    from pathway_tpu.internals import parse_graph as pg
+    from pathway_tpu.xpacks.llm.question_answering import RAGClient
+
+    pg.G.clear()
+    sock = socket.socket()
+    sock.bind(("127.0.0.1", 0))
+    port = sock.getsockname()[1]
+    sock.close()
+
+    queries, writer = pw.io.http.rest_connector(
+        host="127.0.0.1", port=port, route="/v2/answer",
+        schema=pw.schema_from_types(prompt=str),
+    )
+    writer(queries.select(result=queries.prompt.str.upper()))
+    out = {}
+
+    def client():
+        time.sleep(0.8)
+        c = RAGClient(url=f"http://127.0.0.1:{port}", timeout=10)
+        out["ans"] = c.answer("hello rag")
+
+    th = threading.Thread(target=client, daemon=True)
+    th.start()
+    pw.run(timeout_s=6.0, autocommit_duration_ms=20,
+           monitoring_level=pw.MonitoringLevel.NONE)
+    th.join(timeout=1)
+    assert out["ans"] == "HELLO RAG"
+
+
+def test_pagerank_and_graph_classes():
+    import pathway_tpu as pw
+    from pathway_tpu.internals import parse_graph as pg
+    from pathway_tpu.stdlib.graphs import WeightedGraph, pagerank
+
+    pg.G.clear()
+    edges0 = pw.debug.table_from_markdown(
+        """
+        un | vn
+        a | b
+        b | c
+        c | a
+        d | a
+        """
+    )
+    edges = edges0.select(u=edges0.pointer_from(edges0.un),
+                          v=edges0.pointer_from(edges0.vn))
+    ranks = pagerank(edges, steps=8)
+    df = pw.debug.table_to_pandas(ranks)
+    assert len(df) == 4
+    assert df["rank"].min() == 1000  # the pure source d
+    assert df["rank"].max() > 8000   # a collects two in-edges
+    assert hasattr(WeightedGraph, "from_vertices_and_weighted_edges")
+
+
+def test_classifier_accuracy_and_predict_asof_now():
+    import pathway_tpu as pw
+    from pathway_tpu.internals import parse_graph as pg
+    from pathway_tpu.stdlib.ml.utils import classifier_accuracy
+
+    pg.G.clear()
+    exact = pw.debug.table_from_markdown(
+        """
+          | label
+        1 | x
+        2 | y
+        3 | x
+        """
+    )
+    predicted = exact.select(predicted_label=pw.if_else(
+        exact.label == "x", "x", "z"))
+    acc = classifier_accuracy(predicted, exact)
+    df = pw.debug.table_to_pandas(acc, include_id=False)
+    by_match = dict(zip(df["value"], df["cnt"]))
+    assert by_match == {True: 2, False: 1}
