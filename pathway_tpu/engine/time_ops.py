@@ -256,9 +256,33 @@ def install_table_methods() -> None:
     def ignore_late(self, threshold_column, time_column):
         return _forget(self, threshold_column, time_column, mark_forgetting_records=False)
 
+    def forget(self, time_column, threshold, mark_forgetting_records=False):
+        """Retract entries once time_column <= max(time_column) - threshold
+        (reference: Table.forget, internals/table.py:671).  The engine op
+        expires a row when its threshold column reaches the observed max
+        time, so the public (time, interval) form maps onto
+        threshold_column = time_column + threshold."""
+        return _forget(self, time_column + threshold, time_column,
+                       mark_forgetting_records=mark_forgetting_records)
+
+    def buffer(self, time_column, threshold):
+        """Hold entries until time_column <= max(time_column) - threshold
+        (reference: Table.buffer, internals/table.py:921)."""
+        return _buffer(self, time_column + threshold, time_column)
+
+    def filter_out_results_of_forgetting(self, ensure_consistency: bool = False):
+        """Public alias (reference: Table.filter_out_results_of_forgetting);
+        deletions stamped at forgetting times are dropped.
+        ensure_consistency is accepted for signature parity — this engine's
+        forgetting marks are per-update, so no extra tracking is needed."""
+        return _filter_out_results_of_forgetting(self)
+
     Table._forget = _forget
     Table._buffer = _buffer
     Table._freeze = _freeze
     Table._forget_immediately = _forget_immediately
     Table._filter_out_results_of_forgetting = _filter_out_results_of_forgetting
     Table.ignore_late = ignore_late
+    Table.forget = forget
+    Table.buffer = buffer
+    Table.filter_out_results_of_forgetting = filter_out_results_of_forgetting

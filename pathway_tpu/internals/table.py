@@ -344,6 +344,25 @@ class Table:
             u.declare_subset_of(o._universe)
         return Table(node, self._colnames, self._dtypes, u)
 
+    def eval_type(self, expression) -> dt.DType:
+        """Infer the dtype of an expression over this table (reference:
+        Table.eval_type)."""
+        return infer_dtype(self._desugar(expression))
+
+    def debug(self, name: str) -> "Table":
+        """Print every update passing through, tagged `name`, and pass the
+        table on unchanged (reference: Table.debug / DebugOperator)."""
+        from ..io import subscribe as _subscribe
+
+        _subscribe(
+            self,
+            on_change=lambda key, row, time, is_addition: print(
+                f"[debug:{name}] {'+' if is_addition else '-'} "
+                f"key={key} time={time} {row}"
+            ),
+        )
+        return self
+
     def restrict(self, other: "Table") -> "Table":
         return self.with_universe_of(other)
 

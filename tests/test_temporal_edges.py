@@ -188,3 +188,63 @@ def test_windowby_sliding_late_data_consistency():
     final = {r[0]: r[1] for r in run_and_squash(out).values()}
     # windows: [-2,2):{0}, [0,4):{0,2}, [2,6):{4,2}, [4,8):{4}
     assert final == {-2: 1, 0: 2, 2: 2, 4: 1}
+
+
+def test_public_forget_buffer_and_eval_type():
+    """Public Table.forget/buffer/filter_out_results_of_forgetting aliases
+    with the reference's (time_column, threshold) signature, plus
+    Table.eval_type (reference: internals/table.py:671,921,793)."""
+    import pathway_tpu as pw
+    from pathway_tpu.engine.runner import run_tables
+    from pathway_tpu.internals import dtype as dt
+    from pathway_tpu.internals import parse_graph as pg
+
+    pg.G.clear()
+    t = pw.debug.table_from_markdown(
+        """
+            | v | ts | __time__
+        1   | a | 0  | 2
+        2   | b | 10 | 4
+        """
+    )
+    # forget: ts=0 expires once max(ts)=10 passes 0+2
+    [cap] = run_tables(t.forget(t.ts, 2))
+    assert sorted(r[0] for r in cap.squash().values()) == ["b"]
+
+    pg.G.clear()
+    t2 = pw.debug.table_from_markdown(
+        """
+            | v | ts | __time__
+        1   | a | 0  | 2
+        2   | b | 10 | 4
+        """
+    )
+    # buffer: ts=0 releases once max(ts) passes 0+2, while ts=10 stays
+    # held until the end-of-stream drain — so 'b' lands at a later time
+    [cap2] = run_tables(t2.buffer(t2.ts, 2))
+    assert sorted(r[0] for r in cap2.squash().values()) == ["a", "b"]
+    release_time = {e.row[0]: e.time for e in cap2.entries if e.diff > 0}
+    assert release_time["a"] < release_time["b"], release_time
+
+    pg.G.clear()
+    t3 = pw.debug.table_from_markdown(
+        """
+        a | b
+        1 | 2.5
+        """
+    )
+    assert t3.eval_type(t3.a + 1) == dt.INT
+    assert t3.eval_type(t3.b * 2) == dt.FLOAT
+    # marked forgetting deletions are droppable via the public alias
+    pg.G.clear()
+    t4 = pw.debug.table_from_markdown(
+        """
+            | v | ts | __time__
+        1   | a | 0  | 2
+        2   | b | 10 | 4
+        """
+    )
+    kept = t4.forget(t4.ts, 2, mark_forgetting_records=True) \
+             .filter_out_results_of_forgetting()
+    [cap4] = run_tables(kept)
+    assert sorted(r[0] for r in cap4.squash().values()) == ["a", "b"]
