@@ -159,6 +159,9 @@ from .internals.row_transformer import (  # noqa: E402
 from .engine import time_ops as _time_ops  # noqa: E402
 
 _time_ops.install_table_methods()
+from .engine import stream_ops as _stream_ops  # noqa: E402
+
+_stream_ops.install_table_methods()
 from .internals.sql import sql  # noqa: E402
 from .internals.yaml_loader import load_yaml  # noqa: E402
 from .internals.config import set_license_key, set_monitoring_config  # noqa: E402

@@ -363,6 +363,27 @@ class Table:
         )
         return self
 
+    def is_append_only(self) -> bool:
+        """Whether this table was marked append-only (reference:
+        Table.is_append_only; here a declared property via
+        assert_append_only / append-only sources, not a per-column
+        inference)."""
+        return bool(getattr(self, "_append_only", False))
+
+    def assert_append_only(self) -> "Table":
+        """Declare the table append-only (reference:
+        Table.assert_append_only)."""
+        self._append_only = True
+        return self
+
+    def update_id_type(self, id_type, *, id_append_only: bool | None = None) -> "Table":
+        """Typing-level id re-declaration (reference: Table.update_id_type).
+        Ids are untyped 128-bit pointers in this engine, so values are
+        unchanged; the append-only declaration is honored."""
+        if id_append_only is not None:
+            self._append_only = bool(id_append_only)
+        return self
+
     def restrict(self, other: "Table") -> "Table":
         return self.with_universe_of(other)
 

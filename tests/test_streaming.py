@@ -195,3 +195,75 @@ def test_persistence_journal_replay(tmp_path):
     second = run_once()
     assert sorted(first) == [10, 20]
     assert sorted(second) == [10, 20]
+
+
+def test_to_stream_and_stream_to_table_roundtrip():
+    """Table.to_stream nets per-key changes into upsert/delete events;
+    stream_to_table replays them (reference: internals/table.py to_stream /
+    stream_to_table / from_streams)."""
+    from pathway_tpu.engine.runner import run_tables
+
+    md = """
+    id | age | owner | __time__ | __diff__
+     1 | 10  | Alice |     2    |     1
+     2 | 9   | Bob   |     2    |     1
+     1 | 10  | Alice |     4    |    -1
+     1 | 11  | Alice |     4    |     1
+     2 | 9   | Bob   |     6    |    -1
+    """
+    pg.G.clear()
+    t = table_from_markdown(md)
+    stream = t.to_stream()
+    assert stream.is_append_only()
+    [cap] = run_tables(stream)
+    # (time, data..., flag); the source id rides in _pw_source_id
+    events = sorted((e.time, e.row[:2] + e.row[3:]) for e in cap.entries
+                    if e.diff > 0)
+    assert events == [
+        (2, (9, "Bob", True)),
+        (2, (10, "Alice", True)),
+        (4, (11, "Alice", True)),   # retract+insert nets to one upsert
+        (6, (9, "Bob", False)),     # bare delete -> False event
+    ]
+    assert all(e.diff > 0 for e in cap.entries)  # append-only stream
+    # events have unique ids: squash holds the full event log
+    assert len(cap.squash()) == 4
+
+    pg.G.clear()
+    t2 = table_from_markdown(md)
+    back = t2.to_stream().stream_to_table(is_upsert=pw.this.is_upsert)
+    [cap2] = run_tables(back)
+    assert sorted(cap2.squash().values()) == [(11, "Alice")]
+
+    # from_streams merges multiple streams into one state
+    pg.G.clear()
+    a = table_from_markdown(
+        """
+        id | v | is_upsert
+         1 | x | True
+        """
+    )
+    b = table_from_markdown(
+        """
+        id | v | is_upsert
+         2 | y | True
+        """
+    )
+    merged = pw.Table.from_streams(a, b, is_upsert=pw.this.is_upsert)
+    [cap3] = run_tables(merged)
+    assert sorted(cap3.squash().values()) == [("x",), ("y",)]
+
+
+def test_table_append_only_declarations():
+    pg.G.clear()
+    t = table_from_markdown(
+        """
+        a
+        1
+        """
+    )
+    assert t.is_append_only() is False
+    assert t.assert_append_only() is t
+    assert t.is_append_only() is True
+    t.update_id_type(int, id_append_only=False)
+    assert t.is_append_only() is False
