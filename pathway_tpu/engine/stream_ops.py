@@ -163,9 +163,39 @@ def install_table_methods() -> None:
         return Table(node, out_names, dtypes, Universe(),
                      name="stream_to_table")
 
+    def unpack_snapshots(self: Table) -> Table:
+        """Change stream -> snapshot stream: every changed minibatch emits
+        the full table state as fresh rows (reference:
+        Table.unpack_snapshots — beware output volume on large tables)."""
+        node = pg.new_node("unpack_snapshots", [self])
+        out = Table(node, list(self._colnames), dict(self._dtypes),
+                    Universe(), name="unpack_snapshots")
+        out._append_only = True
+        return out
+
+    def to(self: Table, sink) -> None:
+        """Write the table to a sink (reference: Table.to(DataSink)).
+        Accepts a callable sink (called with the table — the functional
+        io.*.write idiom partially applied) or a writer object with a
+        write_batch method (the engine's output-operator contract)."""
+        if callable(sink) and not hasattr(sink, "write_batch"):
+            sink(self)
+            return
+        if hasattr(sink, "write_batch"):
+            pg.new_output_node(
+                "output", [self], colnames=list(self._colnames), writer=sink
+            )
+            return
+        raise TypeError(
+            f"unsupported sink {sink!r}: expected a callable or an object "
+            "with write_batch"
+        )
+
     Table.to_stream = to_stream
     Table.stream_to_table = stream_to_table
     Table.from_streams = staticmethod(from_streams)
+    Table.unpack_snapshots = unpack_snapshots
+    Table.to = to
 
 
 # lowerings
@@ -186,3 +216,42 @@ def _lower_stream_to_table(node, lg):
         p["drop_positions"],
         p["source_id_pos"],
     )
+
+
+class UnpackSnapshotsOperator(Operator):
+    """At every logical time that changes the table, emit the FULL state as
+    fresh append-only rows (reference: Table.unpack_snapshots — snapshots
+    accumulate; rows repeat per snapshot under unique event ids)."""
+
+    _STATE_ATTRS = ("rows",)
+
+    def __init__(self, name: str = "unpack_snapshots"):
+        super().__init__(name)
+        self.rows: dict[Any, tuple] = {}
+        self._buf: list[Update] = []
+
+    def process(self, port, updates, time):
+        self._buf.extend(updates)
+
+    def flush(self, time):
+        if not self._buf:
+            return
+        batch = consolidate(self._buf)
+        self._buf = []
+        changed = False
+        for key, row, diff in batch:
+            if diff > 0:
+                self.rows[key] = row
+                changed = True
+            elif self.rows.pop(key, None) is not None:
+                changed = True
+        if changed:
+            self.emit(time, [
+                (ref_scalar("snap", k, time), row, 1)
+                for k, row in self.rows.items()
+            ])
+
+
+@register_lowering("unpack_snapshots")
+def _lower_unpack_snapshots(node, lg):
+    return UnpackSnapshotsOperator()

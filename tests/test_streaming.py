@@ -267,3 +267,63 @@ def test_table_append_only_declarations():
     assert t.is_append_only() is True
     t.update_id_type(int, id_append_only=False)
     assert t.is_append_only() is False
+
+
+def test_unpack_snapshots_and_table_to():
+    """unpack_snapshots: each changed minibatch re-emits the full state
+    (reference: Table.unpack_snapshots example); Table.to writes via a
+    writer object or callable."""
+    from pathway_tpu.engine.runner import run_tables
+
+    pg.G.clear()
+    t = table_from_markdown(
+        """
+        id | data | __time__ | __diff__
+         1 | a    |    2     |    1
+         2 | b    |    4     |    1
+         2 | b    |    6     |   -1
+         3 | d    |    6     |    1
+        """
+    )
+    [cap] = run_tables(t.unpack_snapshots())
+    by_time = {}
+    for e in cap.entries:
+        assert e.diff > 0
+        by_time.setdefault(e.time, []).append(e.row[0])
+    assert sorted(by_time[2]) == ["a"]
+    assert sorted(by_time[4]) == ["a", "b"]
+    assert sorted(by_time[6]) == ["a", "d"]  # b replaced by d
+
+    # Table.to with a writer object
+    pg.G.clear()
+    t2 = table_from_markdown(
+        """
+        a
+        1
+        2
+        """
+    )
+    got = []
+
+    class W:
+        def write_batch(self, time_, colnames, updates):
+            got.extend(u for u in updates)
+
+        def close(self):
+            pass
+
+    t2.to(W())
+    pw.run(monitoring_level=pw.MonitoringLevel.NONE)
+    assert len(got) == 2
+
+    # Table.to with a callable sink
+    pg.G.clear()
+    t3 = table_from_markdown(
+        """
+        a
+        5
+        """
+    )
+    seen = []
+    t3.to(lambda table: seen.append(table))
+    assert seen == [t3]
