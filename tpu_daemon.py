@@ -1,0 +1,297 @@
+"""Session-long TPU acquisition daemon (VERDICT r4 #1).
+
+Four rounds of bench runs have recorded ``backend: "cpu"`` because the
+axon PJRT claim wedges at interpreter boot, inside the
+``sitecustomize -> axon.register`` hook — before any user code can log
+where it died.  This daemon runs for the whole build session and turns
+that blind spot into committed evidence:
+
+1. Every cycle it launches ``tpu_claim_stages.py`` under ``python -S``
+   (site hooks off, the claim performed by instrumented user code) with
+   a hard timeout.  Each stage boundary is fsynced to
+   ``TPU_STAGES.jsonl``; on a wedge the parent records the last stage
+   reached (the wedge site) in ``TPU_ACQUISITION_LOG.jsonl``.
+2. One-time at startup it also captures a ``python -X importtime`` boot
+   trace of the *default* (sitecustomize) path, so the boot-hook wedge
+   is documented the same way a human traced it.
+3. On the first successful claim it immediately runs
+   ``bench_tpu_probe.py`` (MFU scan, Pallas KNN vs XLA, flash-attention
+   prefill, fused generation) in the healthy environment and commits
+   ``BENCH_TPU_probe.json``.
+4. The log artifacts are git-committed from here (first attempt, any
+   time the furthest-ever stage advances, on success, and periodically)
+   so even a fully wedged session leaves stage-level wedge evidence in
+   history, not just "probe wedged > Ns".
+
+Run: ``python tpu_daemon.py`` (the build session launches it in the
+background at round start).  Stop: SIGTERM, or it exits on its own at
+``PW_DAEMON_DEADLINE_S`` (default 11h) to stay clear of round teardown.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import site
+import subprocess
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.abspath(__file__))
+_STAGES = os.path.join(_REPO, "TPU_STAGES.jsonl")
+_LOG = os.path.join(_REPO, "TPU_ACQUISITION_LOG.jsonl")
+_PROBE_OUT = os.path.join(_REPO, "BENCH_TPU_probe.json")
+
+_CLAIM_TIMEOUT_S = int(os.environ.get("PW_DAEMON_CLAIM_TIMEOUT_S", "300"))
+_SLEEP_S = int(os.environ.get("PW_DAEMON_SLEEP_S", "240"))
+_SLEEP_AFTER_SUCCESS_S = int(
+    os.environ.get("PW_DAEMON_SLEEP_SUCCESS_S", "1800")
+)
+_DEADLINE_S = float(os.environ.get("PW_DAEMON_DEADLINE_S", "39600"))
+_COMMIT_EVERY = int(os.environ.get("PW_DAEMON_COMMIT_EVERY", "8"))
+
+
+def _run_pg(cmd: list[str], timeout_s: float, env: dict | None = None,
+            cwd: str | None = None) -> tuple[int | None, str, str, bool]:
+    """Run ``cmd`` in its OWN process group and SIGKILL the whole group on
+    timeout.  A wedged axon claim spawns helper processes that inherit the
+    captured pipes; subprocess.run's post-kill drain then blocks forever on
+    the orphans — killing the group instead keeps the daemon alive and
+    releases any half-granted claim.  Returns (rc, stdout, stderr,
+    timed_out); partial output is preserved on timeout."""
+    import signal
+
+    proc = subprocess.Popen(
+        cmd, stdout=subprocess.PIPE, stderr=subprocess.PIPE, env=env,
+        cwd=cwd, start_new_session=True,
+    )
+    try:
+        out, err = proc.communicate(timeout=timeout_s)
+        return (proc.returncode, out.decode(errors="replace"),
+                err.decode(errors="replace"), False)
+    except subprocess.TimeoutExpired:
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            proc.kill()
+        try:
+            out, err = proc.communicate(timeout=15)
+        except subprocess.TimeoutExpired as drain:
+            # an escaped grandchild kept the pipes open: salvage whatever
+            # was buffered and reap the (killed) direct child
+            out = drain.stdout or b""
+            err = drain.stderr or b""
+            proc.kill()
+            try:
+                proc.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                pass
+        return (None, out.decode(errors="replace"),
+                err.decode(errors="replace"), True)
+
+
+def _append_log(rec: dict) -> None:
+    with open(_LOG, "a") as fh:
+        fh.write(json.dumps(rec) + "\n")
+        fh.flush()
+        os.fsync(fh.fileno())
+
+
+def _git_commit(msg: str, paths: list[str]) -> None:
+    existing = [p for p in paths if os.path.exists(p)]
+    if not existing:
+        return
+    # retry once: the build session commits concurrently and index.lock
+    # contention must not silently drop wedge evidence
+    for attempt in range(2):
+        try:
+            add = subprocess.run(["git", "-C", _REPO, "add", "--"] + existing,
+                                 capture_output=True, timeout=60)
+            com = subprocess.run(["git", "-C", _REPO, "commit", "-m", msg,
+                                  "--", *existing],
+                                 capture_output=True, timeout=60)
+            if add.returncode == 0 and com.returncode in (0, 1):
+                # commit rc 1 == "nothing to commit" — fine
+                return
+            _append_log({
+                "ts": round(time.time(), 1), "event": "git_error",
+                "rc": [add.returncode, com.returncode],
+                "stderr": (add.stderr + com.stderr).decode(
+                    errors="replace")[-200:],
+            })
+        except Exception as exc:  # noqa: BLE001 - never kill the daemon
+            _append_log({"ts": round(time.time(), 1), "event": "git_error",
+                         "error": str(exc)[:200]})
+        time.sleep(5)
+
+
+def _stage_records(attempt: str) -> list[dict]:
+    recs = []
+    try:
+        with open(_STAGES) as fh:
+            for line in fh:
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if rec.get("attempt") == attempt:
+                    recs.append(rec)
+    except OSError:
+        pass
+    return recs
+
+
+def _capture_boot_importtime() -> None:
+    """Document the default-path (sitecustomize) boot wedge once: run a
+    trivial command with -X importtime and keep the trace tail, which
+    names the import the interpreter died inside."""
+    t0 = time.time()
+    trace_path = os.path.join(_REPO, "TPU_BOOT_IMPORTTIME.txt")
+    rec: dict = {"ts": round(t0, 1), "event": "boot_importtime",
+                 "timeout_s": 180}
+    rc, out, err, timed_out = _run_pg(
+        [sys.executable, "-X", "importtime", "-c", "print('boot_ok')"], 180)
+    if timed_out:
+        rec["ok"] = False
+        rec["error"] = "boot wedged > 180s (sitecustomize axon.register)"
+    else:
+        rec["ok"] = rc == 0 and "boot_ok" in out
+    tail = err.splitlines()[-25:]
+    rec["elapsed_s"] = round(time.time() - t0, 1)
+    with open(trace_path, "w") as fh:
+        fh.write("\n".join(tail) + "\n")
+    rec["trace_tail"] = trace_path
+    _append_log(rec)
+
+
+def _claim_attempt(attempt_id: str) -> dict:
+    env = dict(os.environ)
+    env["PW_STAGE_LOG"] = _STAGES
+    env["PW_STAGE_ATTEMPT"] = attempt_id
+    env["PW_SITE_DIRS"] = os.pathsep.join(site.getsitepackages())
+    t0 = time.time()
+    rec: dict = {"ts": round(t0, 1), "attempt": attempt_id,
+                 "timeout_s": _CLAIM_TIMEOUT_S}
+    rc, out, err, timed_out = _run_pg(
+        [sys.executable, "-S", os.path.join(_REPO, "tpu_claim_stages.py")],
+        _CLAIM_TIMEOUT_S, env=env,
+    )
+    claim_lines = [ln for ln in out.splitlines() if ln.startswith("CLAIM_")]
+    # CLAIM_OK is only ever printed for a non-cpu platform (the child exits
+    # 4 with CLAIM_FALLBACK otherwise); re-check the platform token here so
+    # a CPU fallback can never be committed as TPU evidence
+    ok_line = claim_lines[-1] if claim_lines else ""
+    parts = ok_line.split()
+    rec["ok"] = (rc == 0 and len(parts) >= 2 and parts[0] == "CLAIM_OK"
+                 and parts[1] != "cpu")
+    if claim_lines:
+        rec["claim_line"] = ok_line
+    if timed_out:
+        rec["error"] = (f"wedged > {_CLAIM_TIMEOUT_S}s; stderr tail: "
+                        + err[-400:])
+    elif not rec["ok"]:
+        rec["error"] = err[-400:]
+    rec["elapsed_s"] = round(time.time() - t0, 1)
+    stages = _stage_records(attempt_id)
+    # marks are COMPLETION markers: the wedge happened in the stage AFTER
+    # the last completed one (e.g. completed=register -> wedged in devices)
+    rec["last_completed_stage"] = stages[-1]["stage"] if stages else "none"
+    rec["stages_completed"] = [s["stage"] for s in stages]
+    if not rec["ok"]:
+        try:
+            idx = _STAGE_ORDER.index(rec["last_completed_stage"])
+            rec["wedge_site"] = (_STAGE_ORDER[idx + 1]
+                                 if idx + 1 < len(_STAGE_ORDER) else "done")
+        except ValueError:
+            rec["wedge_site"] = "unknown"
+    return rec
+
+
+def _capture_tpu_evidence() -> bool:
+    """Tunnel is healthy: run the full TPU probe suite in the default
+    (sitecustomize) environment and commit the artifact."""
+    t0 = time.time()
+    env = dict(os.environ)
+    env["PW_TPU_PROBE_DEADLINE_S"] = "1100"
+    # a stale artifact from an earlier run/bench must not be mistaken for
+    # THIS capture's output
+    try:
+        os.remove(_PROBE_OUT)
+    except OSError:
+        pass
+    rc, out, err, timed_out = _run_pg(
+        [sys.executable, os.path.join(_REPO, "bench_tpu_probe.py")],
+        1200, env=env, cwd=_REPO,
+    )
+    produced = (os.path.exists(_PROBE_OUT)
+                and os.path.getmtime(_PROBE_OUT) >= t0)
+    ok = produced and (rc == 0 or timed_out)  # watchdog emits partials
+    _append_log({
+        "ts": round(time.time(), 1), "event": "tpu_evidence",
+        "ok": ok, "partial": timed_out or rc != 0,
+        "elapsed_s": round(time.time() - t0, 1),
+        "stderr_tail": err[-300:],
+    })
+    _git_commit(
+        "TPU evidence: bench_tpu_probe capture from acquisition daemon",
+        [_PROBE_OUT, _LOG, _STAGES],
+    )
+    return ok
+
+
+_STAGE_ORDER = ["none", "start", "path_setup", "import_jax",
+                "import_axon_register", "register", "devices", "matmul"]
+
+
+def main() -> None:
+    t_start = time.time()
+    _append_log({"ts": round(t_start, 1), "event": "daemon_start",
+                 "pid": os.getpid(), "deadline_s": _DEADLINE_S})
+    _capture_boot_importtime()
+    furthest = 0
+    attempt_n = 0
+    captured = False
+    nonce = f"p{os.getpid() % 100000:05d}"  # ids unique across restarts
+
+    def _left() -> float:
+        return _DEADLINE_S - (time.time() - t_start)
+
+    while _left() > _CLAIM_TIMEOUT_S + 60:
+        attempt_n += 1
+        attempt_id = f"{nonce}-a{attempt_n:03d}"
+        rec = _claim_attempt(attempt_id)
+        _append_log(rec)
+        reached = _STAGE_ORDER.index(rec["last_completed_stage"]) \
+            if rec["last_completed_stage"] in _STAGE_ORDER else 0
+        advanced = reached > furthest
+        furthest = max(furthest, reached)
+        if rec.get("ok"):
+            if not captured and _left() > 1300:
+                # capture once; later healthy claims just log (a ~20min
+                # re-bench every cycle would eat the session)
+                captured = _capture_tpu_evidence()
+            else:
+                _git_commit("TPU acquisition daemon: healthy claim "
+                            "(evidence already captured or near deadline)",
+                            [_LOG, _STAGES])
+            time.sleep(max(0.0, min(_SLEEP_AFTER_SUCCESS_S, _left() - 60)))
+            continue
+        if attempt_n == 1 or advanced or attempt_n % _COMMIT_EVERY == 0:
+            _git_commit(
+                "TPU acquisition daemon: stage-level claim wedge evidence "
+                f"(attempt {attempt_n}, last completed stage "
+                f"{_STAGE_ORDER[furthest]})",
+                [_LOG, _STAGES,
+                 os.path.join(_REPO, "TPU_BOOT_IMPORTTIME.txt")],
+            )
+        time.sleep(max(0.0, min(_SLEEP_S, _left() - 60)))
+    _append_log({"ts": round(time.time(), 1), "event": "daemon_exit",
+                 "attempts": attempt_n, "captured": captured,
+                 "furthest_completed_stage": _STAGE_ORDER[furthest]})
+    _git_commit("TPU acquisition daemon: final session log",
+                [_LOG, _STAGES,
+                 os.path.join(_REPO, "TPU_BOOT_IMPORTTIME.txt")])
+
+
+if __name__ == "__main__":
+    main()
