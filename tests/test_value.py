@@ -1,0 +1,39 @@
+"""Value-model invariants: key hashing stability and the memoized
+auto-row-key fast path (reference: src/engine/value.rs Key::for_values)."""
+
+from pathway_tpu.internals.value import (
+    Pointer,
+    auto_row_keys,
+    hash_values,
+    ref_scalar,
+)
+
+
+def test_auto_row_keys_bit_identical_to_ref_scalar():
+    # the tight fill loop inlines _ser("#row") + _ser(i); any drift would
+    # silently split static/streamed universes over the same ordinals
+    keys = auto_row_keys(300)
+    for i in (0, 1, 2, 127, 128, 255, 256, 299):
+        assert keys[i] == ref_scalar("#row", i)
+    # boundary widths: int serialization width changes at bit_length steps
+    big = auto_row_keys(70000)
+    for i in (65535, 65536, 69999):
+        assert big[i] == ref_scalar("#row", i)
+
+
+def test_auto_row_keys_memo_grows_and_slices():
+    a = auto_row_keys(10)
+    b = auto_row_keys(5)
+    assert b == a[:5]
+    c = auto_row_keys(20)
+    assert c[:10] == a
+    assert all(isinstance(k, Pointer) for k in c)
+
+
+def test_hash_values_type_tagged():
+    # type tags must keep colliding value families apart
+    assert hash_values(1) != hash_values(1.0)
+    assert hash_values("1") != hash_values(1)
+    assert hash_values(True) != hash_values(1)
+    assert hash_values(None) != hash_values("")
+    assert hash_values((1, 2)) != hash_values((1,), (2,))
