@@ -20,8 +20,16 @@ def generate_custom_stream(
     input_rate: float = 1.0,
     autocommit_duration_ms: int = 1000,
     persistent_id: str | None = None,
+    deterministic: bool = False,
 ) -> Table:
+    # deterministic=True (pure index-based generators) opts into the
+    # persistence prefix-skip so restarts stay exactly-once; the default
+    # stays False because caller-supplied generators may be stateful
+    _det = deterministic
+
     class Subject(io_python.ConnectorSubject):
+        deterministic_rerun = _det
+
         def run(self):
             i = 0
             while nb_rows is None or i < nb_rows:
@@ -40,7 +48,7 @@ def range_stream(nb_rows: int | None = None, offset: int = 0,
     schema = schema_from_types(value=int)
     return generate_custom_stream(
         {"value": lambda i: i + offset}, schema=schema, nb_rows=nb_rows,
-        input_rate=input_rate,
+        input_rate=input_rate, deterministic=True,
     )
 
 
@@ -56,6 +64,10 @@ def noisy_linear_stream(nb_rows: int = 10, input_rate: float = 1.0, **kwargs) ->
 
 def replay_csv(path: str, *, schema: SchemaMetaclass, input_rate: float = 1.0) -> Table:
     class Subject(io_python.ConnectorSubject):
+        # re-reading the same file re-emits the same stream, so the
+        # persistence prefix-skip is safe here (opt-in since r5)
+        deterministic_rerun = True
+
         def run(self):
             with open(path, newline="", encoding="utf-8") as f:
                 for row in _csv.DictReader(f):

@@ -173,12 +173,13 @@ class SubjectDataSource(DataSource):
     def replays_from_scratch(self) -> bool:
         """True when a restart re-emits already-consumed events: the
         persistence wrapper must skip the re-read prefix or journal replay
-        double-ingests.  Opt-in via the subject's `deterministic_rerun`
-        flag — broker-push subjects (mqtt/nats/rabbitmq/rest) only deliver
-        NEW events after a restart, so skipping would eat real data; only
-        subjects whose run() deterministically re-emits the same stream
-        (python generators, demo streams, http stream re-reads) qualify,
-        and a subject with real seek support never needs the skip."""
+        double-ingests.  OPT-IN via the subject's `deterministic_rerun`
+        flag (default False since r5, ADVICE r4) — broker-push subjects
+        (mqtt/nats/rabbitmq/rest) only deliver NEW events after a restart,
+        so skipping would eat real data.  Subjects that declare
+        deterministic re-emission opt in (demo.replay_csv,
+        demo.range_stream, io.http.read's default); a subject with real
+        seek support never needs the skip."""
         return (
             getattr(self.subject, "seek", None) is None
             and bool(getattr(self.subject, "deterministic_rerun", False))

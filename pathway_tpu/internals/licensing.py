@@ -113,7 +113,17 @@ def parse_license(key: str | None) -> License | None:
         return License(STANDARD_ENTITLEMENTS,
                        telemetry_required=DEMO_KEYS[key])
     # unknown key shapes are accepted as the standard tier (the reference
-    # validates online; offline we extend good faith to real keys)
+    # validates online; offline we extend good faith to real keys) — but
+    # loudly, so a typo'd or fabricated key is visible to the operator
+    # instead of silently unlocking the standard entitlements (ADVICE r4)
+    import logging
+
+    logging.getLogger("pathway_tpu.licensing").warning(
+        "license key %r is not a recognized demo key or signed offline "
+        "key; treating it as the standard tier in good faith — verify the "
+        "key if entitlement gating matters in this deployment",
+        key[:16] + "..." if len(key) > 16 else key,
+    )
     return License(STANDARD_ENTITLEMENTS)
 
 

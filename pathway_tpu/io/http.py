@@ -569,13 +569,30 @@ def read(
     allow_redirects: bool = True,
     retry_codes: tuple | None = (429, 500, 502, 503, 504),
     autocommit_duration_ms: int = 10000,
+    flush_trailing: bool = False,
+    deterministic_rerun: bool = True,
     **kwargs,
 ):
     """Read a table from a streaming HTTP endpoint (reference: io/http
     read).  The response body splits into messages on `delimiter`
     (default newline); "json" format parses each message into schema
     columns, "raw" binds it to a single `data` column.
-    """
+
+    `flush_trailing`: deliver a final message that was not terminated by
+    `delimiter` when the stream ends.  Off by default — for endpoints
+    without Content-Length (chunked streaming, the usual case) a dropped
+    connection is indistinguishable from a clean EOF, and flushing would
+    emit the truncated tail as a complete record and end the stream; with
+    the flag off such an EOF retries like any other disconnect (ADVICE
+    r4).  Responses WITH Content-Length verify completeness directly, so
+    their delimiter-less tail is always delivered.
+
+    `deterministic_rerun`: under persistence, whether a process restart
+    re-delivers the same byte stream from the start (True — the common
+    case for re-requesting a URL; the journaled prefix is skipped for
+    exactly-once restarts).  Set False for push-style endpoints (SSE,
+    long-poll) that only send NEW events after reconnecting — skipping
+    would silently drop their first fresh messages."""
     from ..internals.schema import schema_from_types
     from . import python as io_python
 
@@ -593,7 +610,11 @@ def read(
         delim = delim.encode()
     policy = retry_policy or RetryPolicy.default()
 
+    _det_rerun = deterministic_rerun
+
     class _HttpStreamSubject(io_python.ConnectorSubject):
+        deterministic_rerun = _det_rerun
+
         def run(self) -> None:
             import http.client as _http_client
             import urllib.error
@@ -664,6 +685,19 @@ def read(
                             if start:
                                 del buf[:start]
                         if bytes(buf).strip():
+                            # a delimiter-less tail at EOF: for a stream
+                            # with verified Content-Length it is the last
+                            # message; without one, a mid-message drop
+                            # looks identical to clean EOF, so treat it as
+                            # a retryable disconnect unless the caller
+                            # opted into flushing (ADVICE r4)
+                            if expected is None and not flush_trailing:
+                                raise OSError(
+                                    "connection ended mid-message (no "
+                                    "Content-Length, trailing partial "
+                                    "buffer); pass flush_trailing=True to "
+                                    "deliver unterminated tails instead"
+                                )
                             seen += 1
                             if seen > delivered:
                                 self._deliver(bytes(buf))

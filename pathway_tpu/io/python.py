@@ -17,14 +17,18 @@ class ConnectorSubject:
     """Subclass and implement run(); call self.next(**values) / next_json /
     next_str / next_bytes; close() ends the stream.
 
-    Persistence contract: run() is assumed to deterministically re-emit the
-    same event stream when the process restarts (`deterministic_rerun`),
-    so the persistence layer skips the already-journaled prefix instead of
-    double-ingesting.  A subject that only delivers NEW events after a
-    restart (broker subscription style) must set deterministic_rerun =
-    False — or implement seek()/get_offsets() for real offset support."""
+    Persistence contract: `deterministic_rerun` is OPT-IN (default False).
+    A subject whose run() deterministically re-emits the same event stream
+    on restart (pure generators, file replays) may set it to True, letting
+    the persistence layer skip the already-journaled prefix instead of
+    double-ingesting.  Broker/push-style subjects that only deliver NEW
+    events after a restart must leave it False — with the old opt-out
+    default, the prefix skip silently ate their first fresh events
+    (unrecoverable loss); duplicates from a False-but-deterministic
+    subject are at least visible.  Subjects with real offset support
+    should implement seek()/get_offsets() instead; seek always wins."""
 
-    deterministic_rerun = True
+    deterministic_rerun = False
 
     _source: SubjectDataSource | None = None
     _colnames: list[str] = []

@@ -746,8 +746,9 @@ def _wrap_source_with_persistence(source, backend: Backend, stream: str,
             _journal(fresh)
         return _retime(replayed + fresh)
 
-    # deterministic-rerun live sources (python/demo/http-stream subjects
-    # without seek) re-emit the whole stream on restart: skip the first
+    # live sources that OPTED INTO deterministic_rerun (replay_csv,
+    # range_stream, http.read by default; user subjects explicitly)
+    # re-emit the whole stream on restart: skip the first
     # count(key) occurrences of each replayed/folded key, same prefix-count
     # idiom as static sources — otherwise journal replay + the re-run
     # subject double-ingests
@@ -757,10 +758,31 @@ def _wrap_source_with_persistence(source, backend: Backend, stream: str,
         if folded_counts:
             skip_counts.update(folded_counts)
 
+    warned = [False]
+
     def journaling_poll():
         events = orig_poll()
         if events and skip_counts:
+            n_before = len(events)
             events = _prefix_skip(skip_counts, events)
+            dropped = n_before - len(events)
+            if dropped and not warned[0]:
+                # visible by design (ADVICE r4): if the subject is NOT
+                # truly deterministic-rerun, these drops are silent data
+                # loss — log ONCE per restart (per-batch would bury the
+                # signal under routine replay noise)
+                warned[0] = True
+                import logging
+
+                logging.getLogger("pathway_tpu.persistence").warning(
+                    "prefix-skip active: dropping up to %d re-emitted "
+                    "event(s) for deterministic_rerun source %r this "
+                    "restart; if this subject does not re-emit its full "
+                    "history on restart, set deterministic_rerun=False "
+                    "or implement seek()",
+                    sum(skip_counts.values()) + dropped,
+                    getattr(source, "name", source),
+                )
         if events:
             offsets = source.get_offsets() if hasattr(source, "get_offsets") else None
             # the exclusive reader journals everything it read (no ownership

@@ -74,10 +74,17 @@ class DashboardServer:
             else:
                 return 404, b'{"error": "no such route"}', "application/json"
             return 200, json.dumps(data).encode(), "application/json"
-        # static frontend
+        # static frontend — containment via commonpath on resolved paths:
+        # a bare startswith(_FRONTEND) also admits sibling dirs sharing
+        # the prefix (frontend_private/) and symlink escapes (ADVICE r4)
         name = "index.html" if path in ("", "/") else path.lstrip("/")
-        fpath = os.path.normpath(os.path.join(_FRONTEND, name))
-        if not fpath.startswith(_FRONTEND) or not os.path.isfile(fpath):
+        root = os.path.realpath(_FRONTEND)
+        fpath = os.path.realpath(os.path.join(root, name))
+        try:
+            contained = os.path.commonpath([root, fpath]) == root
+        except ValueError:
+            contained = False
+        if not contained or not os.path.isfile(fpath):
             return 404, b"not found", "text/plain"
         ctype = "text/html" if fpath.endswith(".html") else (
             "text/javascript" if fpath.endswith(".js") else "text/css"

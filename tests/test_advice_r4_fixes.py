@@ -1,0 +1,170 @@
+"""Regression tests for the round-4 advisor findings (ADVICE.md r4):
+
+1. (med) ConnectorSubject.deterministic_rerun defaults to False: the
+   persistence prefix-skip must be OPT-IN, because a broker/push-style
+   subject delivers only NEW events after restart and the skip would
+   silently eat them (unrecoverable loss).  Opted-in subjects keep the
+   exactly-once restart behavior, and the skip logs loudly when it drops.
+2. (low) dashboard static-file containment: a sibling directory sharing
+   the 'frontend' prefix (frontend_private/) must not be served.
+3. (low) licensing: an unrecognized key is still accepted as standard
+   tier, but now with a visible warning.
+4. (low) pw.io.http.read: a no-Content-Length EOF that leaves a partial
+   trailing buffer is a retryable disconnect by default, not a clean end
+   delivering a truncated record; flush_trailing=True restores delivery.
+"""
+
+from __future__ import annotations
+
+import http.server
+import json
+import logging
+import threading
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.internals import parse_graph as pg
+
+
+# ---------------------------------------------------------------------------
+# 1. deterministic_rerun default
+
+
+def test_deterministic_rerun_defaults_false():
+    class Sub(pw.io.python.ConnectorSubject):
+        def run(self):
+            pass
+
+    assert Sub.deterministic_rerun is False
+    from pathway_tpu.internals.datasource import SubjectDataSource
+
+    src = SubjectDataSource(Sub(), ["v"])
+    assert src.replays_from_scratch is False
+
+
+def test_prefix_skip_logs_when_dropping(tmp_path, caplog):
+    backend = pw.persistence.Backend.filesystem(str(tmp_path / "p"))
+
+    class VS(pw.Schema):
+        v: int
+
+    def run_once():
+        class Sub(pw.io.python.ConnectorSubject):
+            deterministic_rerun = True
+
+            def run(self):
+                for i in range(3):
+                    self.next(v=i)
+
+        pg.G.clear()
+        t = pw.io.python.read(Sub(), schema=VS)
+        got = []
+        pw.io.subscribe(t, on_change=lambda key, row, time, is_addition:
+                        got.append(row["v"]))
+        pw.run(idle_stop_s=1.0, autocommit_duration_ms=20,
+               persistence_config=pw.persistence.Config(backend),
+               monitoring_level=pw.MonitoringLevel.NONE)
+        return sorted(got)
+
+    assert run_once() == [0, 1, 2]
+    with caplog.at_level(logging.WARNING, "pathway_tpu.persistence"):
+        assert run_once() == [0, 1, 2]  # restart: prefix skip, no dupes
+    skip_logs = [r for r in caplog.records if "prefix-skip active" in r.message]
+    assert len(skip_logs) == 1  # once per restart, not per poll batch
+
+
+# ---------------------------------------------------------------------------
+# 2. dashboard containment
+
+
+def test_dashboard_sibling_prefix_dir_not_served(tmp_path, monkeypatch):
+    from pathway_tpu.web_dashboard import dashboard as dmod
+
+    frontend = tmp_path / "frontend"
+    frontend.mkdir()
+    (frontend / "index.html").write_text("<html>ok</html>")
+    sibling = tmp_path / "frontend_private"
+    sibling.mkdir()
+    (sibling / "secret.txt").write_text("s3cret")
+
+    monkeypatch.setattr(dmod, "_FRONTEND", str(frontend))
+    app = dmod.DashboardServer(metrics_dir=str(tmp_path))
+    code, body, _ = app.handle("/index.html")
+    assert code == 200 and b"ok" in body
+    # sibling dir shares the string prefix but must 404
+    code, body, _ = app.handle("/../frontend_private/secret.txt")
+    assert code == 404
+    code, body, _ = app.handle("/%2e%2e/frontend_private/secret.txt")
+    assert code == 404 or b"s3cret" not in body
+
+
+# ---------------------------------------------------------------------------
+# 3. licensing warning
+
+
+def test_unknown_license_key_warns(caplog):
+    from pathway_tpu.internals.licensing import parse_license
+
+    with caplog.at_level(logging.WARNING, "pathway_tpu.licensing"):
+        lic = parse_license("totally-made-up-key-123")
+    assert lic is not None
+    assert any("not a recognized" in r.message for r in caplog.records)
+
+
+# ---------------------------------------------------------------------------
+# 4. http.read trailing-buffer EOF semantics
+
+
+class _StreamHandler(http.server.BaseHTTPRequestHandler):
+    payload: bytes = b""
+
+    def do_GET(self):
+        self.send_response(200)
+        # NO Content-Length: chunked-ish stream, then hard close
+        self.send_header("Connection", "close")
+        self.end_headers()
+        self.wfile.write(self.payload)
+
+    def log_message(self, *args):
+        pass
+
+
+def _serve(payload: bytes):
+    handler = type("H", (_StreamHandler,), {"payload": payload})
+    srv = http.server.HTTPServer(("127.0.0.1", 0), handler)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    return srv, srv.server_address[1]
+
+
+def _collect(url: str, **read_kwargs):
+    class S(pw.Schema):
+        v: int
+
+    pg.G.clear()
+    t = pw.io.http.read(url, schema=S, **read_kwargs)
+    got = []
+    pw.io.subscribe(t, on_change=lambda key, row, time, is_addition:
+                    got.append(row["v"]))
+    pw.run(idle_stop_s=1.5, monitoring_level=pw.MonitoringLevel.NONE)
+    return got
+
+
+def test_http_read_partial_tail_is_disconnect_by_default():
+    srv, port = _serve(b'{"v": 1}\n{"v": 2}\n{"v": 3')  # truncated tail
+    try:
+        got = _collect(f"http://127.0.0.1:{port}/", n_retries=0)
+        # the truncated record must NOT be delivered; the complete prefix
+        # arrived before the failure surfaced
+        assert 3 not in got
+    finally:
+        srv.shutdown()
+
+
+def test_http_read_flush_trailing_opt_in():
+    srv, port = _serve(b'{"v": 1}\n{"v": 2}')  # tail IS a whole message
+    try:
+        got = _collect(f"http://127.0.0.1:{port}/", flush_trailing=True)
+        assert sorted(got) == [1, 2]
+    finally:
+        srv.shutdown()

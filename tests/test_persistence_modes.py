@@ -262,6 +262,8 @@ def test_offsetless_subject_source_exactly_once_on_restart(tmp_path):
 
     def run_once(n_events):
         class Sub(pw.io.python.ConnectorSubject):
+            deterministic_rerun = True  # opt-in since r5 (ADVICE r4)
+
             def run(self):
                 for i in range(n_events):
                     self.next(v=i)
