@@ -516,8 +516,13 @@ def bench_retrieval_quality() -> dict:
     )
     model = BertModel(hf_cfg).eval()
     tok = HashTokenizer(8192)
+    # r5: extended corpus (stdlib + installed scientific stack docstrings,
+    # ~4.7k items) — eval scale set by budget, r4 ran 600/120
+    n_eval = int(os.environ.get("PW_BENCH_EVAL_DOCS", "2000"))
+    n_q = int(os.environ.get("PW_BENCH_EVAL_QUERIES", "300"))
     corpus, queries, qrels, train_pairs = pydoc_retrieval_split(
-        n_eval_docs=600, n_queries=120, n_train=400, seed=0
+        n_eval_docs=n_eval, n_queries=n_q, n_train=1200, seed=0,
+        extended=True,
     )
     doc_ids = list(corpus)
     doc_texts = [corpus[d] for d in doc_ids]
@@ -537,7 +542,7 @@ def bench_retrieval_quality() -> dict:
 
     untrained = ref_eval()
 
-    steps = int(os.environ.get("PW_BENCH_TRAIN_STEPS", "80"))
+    steps = int(os.environ.get("PW_BENCH_TRAIN_STEPS", "120"))
     train_info = train_contrastive_torch(
         model, tok, train_pairs, steps=steps, seed=7
     )
@@ -560,13 +565,112 @@ def bench_retrieval_quality() -> dict:
     # the bench loudly instead of just recording a bigger gap number
     assert abs(ours["recall"] - ref["recall"]) <= 0.02, (ours, ref)
     assert abs(ours["ndcg"] - ref["ndcg"]) <= 0.02, (ours, ref)
+
+    # lexical + hybrid rows (VERDICT r4 #4): the trained encoder must be
+    # judged against the repo's own BM25, and hybrid RRF should sit on top
+    from pathway_tpu.stdlib.indexing.inner_index import (
+        HybridIndex, TantivyBM25,
+    )
+
+    bm25 = TantivyBM25()
+    for i, d in enumerate(doc_ids):
+        bm25.add(i, doc_texts[i])
+
+    def bm25_search(qtext, k):
+        return [doc_ids[i] for i, _s in bm25.search(qtext, k)]
+
+    bm25_eval = evaluate_retrieval(bm25_search, queries, qrels, k=10)
+
+    # hybrid RRF with the dense weight tuned on a held-out validation
+    # split of the queries (test scores reported on the remainder) — with
+    # an in-run-trained encoder the dense side is much weaker than BM25,
+    # and plain RRF would average toward it instead of dominating both
+    q_ids = list(queries)
+    if len(q_ids) >= 20:
+        n_val = min(max(10, len(q_ids) // 4), len(q_ids) // 2)
+    else:
+        n_val = 0  # too few queries to split; tune and test on the full set
+    val_ids = q_ids[:n_val] or q_ids
+    test_ids = q_ids[n_val:] or q_ids
+    val_q = {q: queries[q] for q in val_ids}
+    val_rels = {q: qrels[q] for q in val_ids}
+    test_q = {q: queries[q] for q in test_ids}
+    test_rels = {q: qrels[q] for q in test_ids}
+
+    # weight tuning: sub-index rankings are weight-INDEPENDENT, so embed
+    # and search each validation query once, then fuse the cached ranked
+    # lists in plain python per candidate weight (same RRF math as
+    # HybridIndex, k=60)
+    val_ranked = {}
+    for qid in val_ids:
+        qtext = val_q[qid]
+        if qtext not in val_ranked:
+            val_ranked[qtext] = (
+                [i for i, _s in index.search(enc.embed(qtext), 20)],
+                [i for i, _s in bm25.search(qtext, 20)],
+            )
+
+    def fused_eval(w_dense):
+        def s(qtext, k):
+            dense_r, bm25_r = val_ranked[qtext]
+            fused: dict = {}
+            for w, ranked in ((w_dense, dense_r), (1.0, bm25_r)):
+                if w == 0.0:
+                    continue
+                for rank, i in enumerate(ranked):
+                    fused[i] = fused.get(i, 0.0) + w / (60.0 + rank + 1)
+            top = sorted(fused, key=lambda i: -fused[i])[:k]
+            return [doc_ids[i] for i in top]
+
+        return evaluate_retrieval(s, val_q, val_rels, k=10)["ndcg"]
+
+    weight_grid = (0.0, 0.1, 0.25, 0.5, 1.0)
+    val_scores = {w: fused_eval(w) for w in weight_grid}
+    w_best = max(val_scores, key=val_scores.get)
+
+    # the reported test row exercises the REAL HybridIndex class
+    hybrid = HybridIndex([index, bm25], weights=[w_best, 1.0])
+
+    def hybrid_search(qtext, k):
+        return [doc_ids[i] for i, _s in
+                hybrid.search((enc.embed(qtext), qtext), k)]
+
+    hybrid_eval = evaluate_retrieval(hybrid_search, test_q, test_rels, k=10)
+    # comparable single-retriever rows on the SAME test split
+    ours_test = evaluate_retrieval(jax_search, test_q, test_rels, k=10)
+    bm25_test = evaluate_retrieval(bm25_search, test_q, test_rels, k=10)
+
     return {
-        "dataset": "pydoc-stdlib-title2body(600 docs, 120 queries; real "
-                   "CPython docstring text — offline substitute for BEIR)",
+        "dataset": f"pydoc-extended-title2body({len(doc_ids)} docs, "
+                   f"{len(queries)} queries; real stdlib+numpy/jax/torch/"
+                   "scipy/sklearn docstrings — offline substitute for BEIR)",
         "checkpoint": f"minilm-arch-384d-6L-contrastive-pydoc(steps={steps},"
                       "seed=7; in-run trained — no pretrained weights "
                       "available offline)",
         "train": train_info,
+        "retrievers": {
+            "_note": "rows scored on the held-out test query split; the "
+                     "hybrid dense weight was tuned on a disjoint "
+                     "validation split (full-set single-retriever rows: "
+                     f"dense recall@10={ours['recall']}, "
+                     f"bm25 recall@10={bm25_eval['recall']})",
+            "dense_trained_encoder": {
+                "recall@10": ours_test["recall"],
+                "ndcg@10": ours_test["ndcg"], "mrr": ours_test["mrr"],
+            },
+            "bm25": {
+                "recall@10": bm25_test["recall"],
+                "ndcg@10": bm25_test["ndcg"], "mrr": bm25_test["mrr"],
+            },
+            "hybrid_rrf": {
+                "recall@10": hybrid_eval["recall"],
+                "ndcg@10": hybrid_eval["ndcg"], "mrr": hybrid_eval["mrr"],
+                "dense_weight": w_best,
+                "val_ndcg_by_weight": val_scores,
+            },
+        },
+        "hybrid_beats_dense": hybrid_eval["ndcg"] >= ours_test["ndcg"],
+        "hybrid_beats_bm25": hybrid_eval["ndcg"] >= bm25_test["ndcg"],
         "ours": {"recall@10": ours["recall"], "ndcg@10": ours["ndcg"],
                  "mrr": ours["mrr"]},
         "reference": {"recall@10": ref["recall"], "ndcg@10": ref["ndcg"],
@@ -1107,9 +1211,17 @@ def main() -> None:
     # single queries run on the host CPU mirror (params copied once, index
     # host-mirrored once per version) while bulk ingest stays on TPU
     _stage("serving: latency tier")
-    serve_enc = enc.cpu_mirror() if backend == "tpu" else enc
+    # single-query tier: torch.compile'd bf16 AMX program (sub-10ms,
+    # VERDICT r4 #6); falls back to the eager mirrors when inductor is
+    # unavailable.  Queries never touch the tunnel either way.
+    fastq = enc.compiled_query_encoder()
+    serve_enc = fastq or (enc.cpu_mirror() if backend == "tpu" else enc)
+    tier_name = ("torch-compiled-bf16" if fastq is not None
+                 else ("host-mirror" if backend == "tpu" else "xla-cpu"))
     index.host_matrix()  # one f16 fetch, cached per index version
-    serve_enc.embed(queries[0])  # compile CPU single-query bucket
+    if fastq is not None:
+        fastq.warmup(queries[0])  # block until the bucket's program lands
+    serve_enc.embed(queries[0])
     index.search(serve_enc.embed(queries[0]), k, tier="cpu")
     lat, lat_embed, lat_search = [], [], []
     for q in queries:
@@ -1123,6 +1235,7 @@ def main() -> None:
         lat_search.append((ts - te) * 1000)
     p50 = statistics.median(lat)
     p95 = sorted(lat)[int(0.95 * len(lat)) - 1]
+    stages["query_tier"] = tier_name
     stages["query_embed_ms_p50"] = round(statistics.median(lat_embed), 2)
     stages["query_search_ms_p50"] = round(statistics.median(lat_search), 2)
 

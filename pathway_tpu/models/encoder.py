@@ -369,6 +369,23 @@ class JaxEncoder:
             return self.embed_batch_host(texts)
         return self.embed_batch(texts)
 
+    def compiled_query_encoder(self, mode: str = "compile"):
+        """Sub-10ms single-query serving tier (host_encoder.py
+        CompiledQueryEncoder): one torch.compile'd bf16 program per query
+        bucket.  None when torch is absent.  ``mode="eager"`` skips
+        inductor (tests; same math)."""
+        attr = f"_compiled_query_{mode}"
+        if getattr(self, attr, None) is None:
+            try:
+                from .host_encoder import CompiledQueryEncoder
+
+                setattr(self, attr, CompiledQueryEncoder(
+                    self.cfg, self.params, self.tokenizer, mode=mode
+                ))
+            except ImportError:
+                setattr(self, attr, None)
+        return getattr(self, attr)
+
     def cpu_mirror(self):
         """Host-side mirror — the serving latency tier (single queries).
 

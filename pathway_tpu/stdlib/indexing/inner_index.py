@@ -794,11 +794,20 @@ class TantivyBM25(InnerIndex):
 
 
 class HybridIndex(InnerIndex):
-    """Reciprocal-rank fusion over sub-indexes (reference: hybrid_index.py:14)."""
+    """Reciprocal-rank fusion over sub-indexes (reference: hybrid_index.py:14).
 
-    def __init__(self, inner_indexes: list[InnerIndex], *, k: float = 60.0):
+    `weights` scales each sub-index's RRF contribution (w_i / (k + rank)),
+    letting a caller down-weight a weaker retriever so fusion dominates
+    both components instead of averaging toward the worse one; the default
+    (all 1.0) is the reference's plain RRF."""
+
+    def __init__(self, inner_indexes: list[InnerIndex], *, k: float = 60.0,
+                 weights: list[float] | None = None):
         self.inner = inner_indexes
         self.k = k
+        if weights is not None and len(weights) != len(inner_indexes):
+            raise ValueError("weights must match inner_indexes length")
+        self.weights = weights or [1.0] * len(inner_indexes)
 
     def add(self, key, item, metadata=None):
         # item is a tuple: one entry per sub-index
@@ -811,8 +820,10 @@ class HybridIndex(InnerIndex):
 
     def search(self, query, k, metadata_filter=None):
         fused: dict[int, float] = defaultdict(float)
-        for idx, q in zip(self.inner, query):
+        for idx, q, w in zip(self.inner, query, self.weights):
+            if w == 0.0:
+                continue
             for rank, (key, _score) in enumerate(idx.search(q, k * 2, metadata_filter)):
-                fused[key] += 1.0 / (self.k + rank + 1)
+                fused[key] += w / (self.k + rank + 1)
         out = sorted(fused.items(), key=lambda t: -t[1])
         return out[:k]

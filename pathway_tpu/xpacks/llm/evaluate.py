@@ -123,21 +123,46 @@ _PYDOC_MODULES = (
     "gettext", "optparse", "rlcompleter",
 )
 
+# installed third-party libraries carry thousands more real, documented
+# English docstrings — the corpus scales to 5k+ items without any network
+# (VERDICT r4 #4: grow the retrieval-quality corpus with the bench budget)
+_PYDOC_MODULES_EXTRA = (
+    "numpy", "numpy.linalg", "numpy.fft", "numpy.random", "numpy.ma",
+    "numpy.polynomial", "numpy.testing", "numpy.char", "numpy.lib",
+    "jax.numpy", "jax.lax", "jax.random", "jax.scipy.special",
+    "jax.scipy.linalg", "jax.nn", "jax.tree_util", "jax.scipy.stats.norm",
+    "torch.nn.functional", "torch.linalg", "torch.fft", "torch.special",
+    "torch.optim", "torch.utils.data", "torch.distributions",
+    "pandas", "pandas.api.types", "pandas.tseries.frequencies",
+    "einops", "chex", "optax",
+    "torch.nn", "torch", "flax.linen", "transformers.modeling_utils",
+    "transformers.tokenization_utils_base", "transformers.trainer_utils",
+    "scipy", "scipy.signal", "scipy.stats", "scipy.optimize",
+    "scipy.sparse", "scipy.linalg", "scipy.interpolate", "scipy.ndimage",
+    "scipy.spatial", "scipy.integrate", "sklearn.linear_model",
+    "sklearn.metrics", "sklearn.cluster", "sklearn.preprocessing",
+    "sklearn.decomposition", "sklearn.ensemble",
+)
 
-def pydoc_corpus(min_title_words: int = 4, min_body_words: int = 15):
+
+def pydoc_corpus(min_title_words: int = 4, min_body_words: int = 15,
+                 extended: bool = False):
     """Real-text retrieval corpus from CPython stdlib docstrings (the only
     sizeable body of real, labeled English text available in a zero-egress
     environment): each item is (qualified_name, title, body) where title is
     the docstring's summary line and body is the rest.  Title->body is a
     genuine asymmetric retrieval task — the query paraphrases, but does not
     repeat, most of the document.  Deterministic: fixed module list, sorted
-    member walk, content-hash dedup."""
+    member walk, content-hash dedup.  ``extended=True`` also harvests the
+    installed scientific stack (numpy/jax/torch/pandas), scaling the
+    corpus past 5k items."""
     import importlib
     import inspect as _inspect
 
+    modules = _PYDOC_MODULES + (_PYDOC_MODULES_EXTRA if extended else ())
     items: list[tuple[str, str, str]] = []
     seen: set = set()
-    for m in _PYDOC_MODULES:
+    for m in modules:
         try:
             mod = importlib.import_module(m)
         except Exception:
@@ -175,13 +200,14 @@ def pydoc_corpus(min_title_words: int = 4, min_body_words: int = 15):
 
 
 def pydoc_retrieval_split(n_eval_docs: int = 600, n_queries: int = 120,
-                          n_train: int = 400, seed: int = 0):
+                          n_train: int = 400, seed: int = 0,
+                          extended: bool = False):
     """Split the pydoc corpus into a labeled eval set (corpus/queries/qrels,
     query = title, relevant doc = its own body) and a DISJOINT train set of
     (title, body) pairs for contrastive checkpoint training."""
     import random as _random
 
-    items = pydoc_corpus()
+    items = pydoc_corpus(extended=extended)
     rng = _random.Random(seed)
     rng.shuffle(items)
     eval_items = items[:n_eval_docs]

@@ -1,0 +1,69 @@
+"""CompiledQueryEncoder — the sub-10ms single-query serving tier
+(models/host_encoder.py).  Parity runs in eager mode (identical math, no
+inductor compile); the compiled path is exercised when PW_TEST_COMPILED=1
+(one-time ~20s inductor compile per bucket)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from pathway_tpu.models.encoder import EncoderConfig, JaxEncoder
+
+
+@pytest.fixture(scope="module")
+def enc():
+    return JaxEncoder(EncoderConfig(max_len=64, vocab_size=4096),
+                      seq_buckets=(16, 32), batch_buckets=(1, 8))
+
+
+def test_eager_parity_exact_bucket(enc):
+    cq = enc.compiled_query_encoder(mode="eager")
+    assert cq is not None
+    text = " ".join(f"tok{i}" for i in range(30))
+    n = len(enc.tokenizer.encode(text))
+    a = enc.embed(text)
+    b = cq.embed(text)
+    assert abs(float(np.linalg.norm(b)) - 1.0) < 1e-3
+    assert float(a @ b) > 0.995, (n, float(a @ b))
+
+
+def test_eager_parity_masked_bucket(enc):
+    cq = enc.compiled_query_encoder(mode="eager")
+    # short query pads into the 16 bucket with a mask
+    text = "short query of five words"
+    a = enc.embed(text)
+    b = cq.embed(text)
+    assert float(a @ b) > 0.995
+
+
+def test_masked_vs_exact_same_text(enc):
+    """A text that exactly fills a bucket and one that pads must both match
+    the reference embedding — the additive mask and pooling weights must
+    not leak padding into the result."""
+    cq = enc.compiled_query_encoder(mode="eager")
+    for n_words in (3, 9, 14, 20):
+        text = " ".join(f"w{i}" for i in range(n_words))
+        a = enc.embed(text)
+        b = cq.embed(text)
+        assert float(a @ b) > 0.995, n_words
+
+
+def test_buckets_clamped_to_max_len():
+    small = JaxEncoder(EncoderConfig(max_len=16, vocab_size=4096),
+                       seq_buckets=(16,), batch_buckets=(1,))
+    cq = small.compiled_query_encoder(mode="eager")
+    assert max(cq.buckets) <= 16
+    long_text = " ".join(f"w{i}" for i in range(200))
+    v = cq.embed(long_text)
+    assert v.shape == (small.cfg.d_model,)
+
+
+@pytest.mark.skipif(os.environ.get("PW_TEST_COMPILED") != "1",
+                    reason="inductor compile is ~20s; opt-in")
+def test_compiled_parity(enc):
+    cq = enc.compiled_query_encoder()
+    text = " ".join(f"tok{i}" for i in range(30))
+    a = enc.embed(text)
+    b = cq.embed(text)
+    assert float(a @ b) > 0.995
