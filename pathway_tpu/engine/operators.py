@@ -13,7 +13,7 @@ from __future__ import annotations
 from collections import defaultdict
 from typing import Any, Callable, Iterable
 
-from ..internals.value import ERROR, Error, ref_scalar
+from ..internals.value import ERROR, Error, ref_pair, ref_scalar
 from .graph import DiffOutputOperator, KeyedState, Operator
 from .types import Key, Row, Time, Update, consolidate, rows_equal
 
@@ -421,7 +421,7 @@ class JoinOperator(Operator):
             return lk
         if self.id_policy == "right":
             return rk
-        return ref_scalar(lk, rk)
+        return ref_pair(lk, rk)
 
     def _pad_key_left(self, lk: Key) -> Key:
         return lk if self.id_policy == "left" else ref_scalar(lk, None)

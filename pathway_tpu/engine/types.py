@@ -26,14 +26,30 @@ Update = tuple[Key, Row, int]  # (key, row, diff)
 
 def consolidate(updates: Iterable[Update]) -> list[Update]:
     """Sum diffs per (key, row); drop zeros. Emits the original rows."""
+    if not isinstance(updates, list):
+        updates = list(updates)
     acc: dict[tuple[Key, Row], list] = {}
-    for key, row, diff in updates:
-        k = (key, _hashable_row(row))
-        prev = acc.get(k)
-        if prev is None:
-            acc[k] = [row, diff]
-        else:
-            prev[1] += diff
+    try:
+        # fast path: rows hashable (the overwhelmingly common case) — no
+        # per-row probe-hash try/except, plain dict merge
+        for key, row, diff in updates:
+            k = (key, row)
+            prev = acc.get(k)
+            if prev is None:
+                acc[k] = [row, diff]
+            else:
+                prev[1] += diff
+    except TypeError:
+        # a row held an unhashable value (np array, dict): redo with
+        # wrapping — `updates` is a list, so restarting is safe
+        acc = {}
+        for key, row, diff in updates:
+            k = (key, _hashable_row(row))
+            prev = acc.get(k)
+            if prev is None:
+                acc[k] = [row, diff]
+            else:
+                prev[1] += diff
     out: list[Update] = []
     for (key, _hrow), (row, diff) in acc.items():
         if diff != 0:

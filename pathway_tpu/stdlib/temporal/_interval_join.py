@@ -70,6 +70,53 @@ def _bucket_fns(lo, hi):
     return left_buckets, right_bucket
 
 
+def _apply_join_behavior(tbl, behavior, shift):
+    """freeze/buffer/forget one bucketed join input (see the call site for
+    the threshold math; mirrors _window._apply_behavior's ordering —
+    freeze first so the cutoff clock sees the raw stream)."""
+    if behavior is None:
+        return tbl
+    from .temporal_behavior import CommonBehavior
+
+    if not isinstance(behavior, CommonBehavior):
+        raise NotImplementedError(
+            "interval_join supports common_behavior(...) "
+            f"(got {type(behavior).__name__})"
+        )
+    out = tbl
+    if behavior.cutoff is not None:
+        # late-arrival rejection is UNshifted (reference
+        # temporal_behavior.py: threshold = time + cutoff) — a negative
+        # interval bound must never freeze on-time rows; the shift only
+        # delays FORGETTING until the row provably can't match anymore
+        out = out._freeze(out._pw_time + behavior.cutoff, out._pw_time)
+    if behavior.delay is not None:
+        out = out._buffer(out._pw_time + behavior.delay, out._pw_time)
+    if behavior.cutoff is not None:
+        # always prune the arrangement once cutoff passes usefulness;
+        # keep_results=True marks the retractions (odd times) so the join
+        # OUTPUT filters them out and keeps already-emitted results
+        prune_shift = shift if _is_nonneg(shift) else _zero_like(shift)
+        out = out._forget(
+            out._pw_time + prune_shift + behavior.cutoff, out._pw_time,
+            mark_forgetting_records=behavior.keep_results,
+        )
+    return out
+
+
+def _is_nonneg(x) -> bool:
+    try:
+        return x >= _zero_like(x)
+    except TypeError:  # pragma: no cover - exotic duration types
+        return True
+
+
+def _zero_like(x):
+    import datetime
+
+    return datetime.timedelta(0) if isinstance(x, datetime.timedelta) else 0
+
+
 class IntervalJoinResult:
     def __init__(self, left: Table, right: Table, left_time, right_time,
                  interval: Interval, on: tuple, how: str, behavior=None):
@@ -92,11 +139,22 @@ class IntervalJoinResult:
         # left rows flatten into one row per probed bucket (<= 2); the
         # pre-flatten row id rides along for outer-pad matching
         lb0 = lt.with_columns(_pw_time=left_time)
+        # temporal behavior lowers onto freeze/buffer/forget on each
+        # BUCKETED input, thresholds shifted by the interval bound past
+        # which the row can no longer produce matches: a left row at t
+        # matches right times in [t+lo, t+hi] (useful until frontier >
+        # t+hi), a right row at s matches left times in [s-hi, s-lo]
+        # (useful until frontier > s-lo).  cutoff freezes late arrivals;
+        # keep_results=False also forgets, pruning the join arrangements
+        # to the live horizon (reference: interval joins + common_behavior,
+        # temporal_behavior.py -> time_column.rs)
+        lb0 = _apply_join_behavior(lb0, behavior, shift=hi)
         lb0 = lb0.with_columns(
             _pw_lid=lb0.id, _pw_bs=pw_apply(left_buckets, lb0._pw_time)
         )
         lb = lb0.flatten(lb0._pw_bs)
         rb = rt.with_columns(_pw_time=right_time)
+        rb = _apply_join_behavior(rb, behavior, shift=-lo)
         rb = rb.with_columns(_pw_bs=pw_apply(right_bucket, rb._pw_time))
         self._lb, self._rb = lb, rb
         self._lb0 = lb0
@@ -109,6 +167,7 @@ class IntervalJoinResult:
             (rb._pw_time - lb._pw_time >= lo) & (rb._pw_time - lb._pw_time <= hi)
         )
         self._jr = jr
+        self._behavior = behavior
 
     def select(self, *args, **kwargs) -> Table:
         lt, rt, lb, rb = self._left, self._right, self._lb, self._rb
@@ -132,6 +191,7 @@ class IntervalJoinResult:
             for n, e in exprs.items()
         }
         inner = self._jr.select(**mapped)
+        inner = self._maybe_filter_forgetting(inner)
         if self._how == "inner":
             return inner
 
@@ -175,7 +235,18 @@ class IntervalJoinResult:
             return rewrite(e, leaf)
 
         pads = {n: null_other(mapped[n]) for n in out_names}
-        return unmatched.select(**pads)
+        return self._maybe_filter_forgetting(unmatched.select(**pads))
+
+    def _maybe_filter_forgetting(self, out: Table) -> Table:
+        """keep_results=True with a cutoff: the inputs' forgetting
+        retractions (odd-time marks) must not retract already-delivered
+        results — drop them from the output, reference
+        filter_out_results_of_forgetting idiom."""
+        b = self._behavior
+        if b is not None and getattr(b, "cutoff", None) is not None and \
+                getattr(b, "keep_results", True):
+            return out._filter_out_results_of_forgetting()
+        return out
 
 
 def _sub_sides(e, lt, rt):

@@ -1,0 +1,183 @@
+"""Temporal-operator stress gate with hard arrangement-size bounds
+(VERDICT r4 #7): large interval_join and sliding-window runs must keep
+operator state O(window) / O(rows x bucket-const) — a bucketing or
+forgetting regression to cross-product state fails these asserts, not
+just slows them down.  State is read from the same state_size() probes
+telemetry exports (engine/graph.py, pathway_operator_state_entries).
+
+Scale: PW_STRESS_N rows per side (default 50k -> 100k+ total engine
+rows; raise to 500000 for the 1M-row soak)."""
+
+import os
+import random
+
+import pathway_tpu as pw
+from pathway_tpu.debug import table_from_rows
+from pathway_tpu.engine.operators import GroupbyOperator, JoinOperator
+from pathway_tpu.engine.runner import GraphRunner
+from pathway_tpu.internals import parse_graph as pg
+
+N = int(os.environ.get("PW_STRESS_N", "50000"))
+
+
+class S(pw.Schema):
+    t: int
+    v: int
+
+
+def _run_capture(out):
+    sink = out._materialize_capture()
+    runner = GraphRunner([sink])
+    caps = runner.run_batch()
+    [cap] = list(caps.values())
+    return cap, runner.lg.scheduler.operators
+
+
+def test_interval_join_arrangement_linear_not_quadratic():
+    """Uniform times, interval(-2, 2): each row has ~5 true neighbours.
+    The join arrangement must hold O(N x bucket-const) rows — the
+    pre-bucketing design held every row under ONE key and the pair
+    probing (and retained pre-filter output) exploded quadratically."""
+    rng = random.Random(0)
+    pg.G.clear()
+    L = table_from_rows(S, [(rng.randrange(N), i) for i in range(N)])
+    R = table_from_rows(S, [(rng.randrange(N), i) for i in range(N)])
+    out = L.interval_join(
+        R, L.t, R.t, pw.temporal.interval(-2, 2)
+    ).select(a=L.v, b=R.v)
+    cap, operators = _run_capture(out)
+    n_pairs = len(cap.squash())
+    # ~5 neighbours per row at this density
+    assert n_pairs < 8 * N, n_pairs
+
+    joins = [op for op in operators if isinstance(op, JoinOperator)]
+    assert joins, "no join operator lowered"
+    arr = sum(op.state_size() for op in joins)
+    # interval bucketing replicates each row into <=3 bucket keys per
+    # side; anything O(N^2)-ish (cross-product state) is caught hard
+    assert arr <= 10 * N, f"join arrangement {arr} rows for {N}/side"
+    # emitted volume must track matches, not |L|x|R|
+    emitted = sum(op.rows_out for op in joins)
+    assert emitted <= 25 * N, f"join emitted {emitted} rows (quadratic?)"
+    pg.G.clear()
+
+
+def test_interval_join_forgetting_bounds_state():
+    """With common_behavior(cutoff, keep_results=False), rows behind the
+    event-time frontier are forgotten: after a LONG stream the join
+    arrangement must hold only the live horizon, not the whole history."""
+    n = max(2000, N // 10)
+    pg.G.clear()
+    lrows = [(i, i, 2 * i, 1) for i in range(n)]  # even logical times (odd = forgetting marks)
+    rrows = [(i, 10_000 + i, 2 * i, 1) for i in range(n)]
+    L = table_from_rows(S, lrows, is_stream=True)
+    R = table_from_rows(S, rrows, is_stream=True)
+    out = L.interval_join(
+        R, L.t, R.t, pw.temporal.interval(-2, 2),
+        behavior=pw.temporal.common_behavior(cutoff=16, keep_results=False),
+    ).select(a=L.v, b=R.v)
+    _cap, operators = _run_capture(out)
+    joins = [op for op in operators if isinstance(op, JoinOperator)]
+    assert joins
+    arr = sum(op.state_size() for op in joins)
+    # live horizon: cutoff 16 + interval width 4, x2 sides x3 buckets —
+    # far below n; holding the full history means forgetting broke
+    assert arr <= 600, f"forgetting regressed: {arr} retained of {2 * n}"
+    pg.G.clear()
+
+
+def test_interval_join_keep_results_prunes_state_keeps_output():
+    """cutoff with keep_results=True (the default) must STILL prune the
+    join arrangements — forgetting retractions are marked (odd times) and
+    filtered from the output, so delivered results survive."""
+    n = 3000
+    pg.G.clear()
+    L = table_from_rows(S, [(i, i, 2 * i, 1) for i in range(n)], is_stream=True)
+    R = table_from_rows(S, [(i, 10_000 + i, 2 * i, 1) for i in range(n)],
+                        is_stream=True)
+    out = L.interval_join(
+        R, L.t, R.t, pw.temporal.interval(-2, 2),
+        behavior=pw.temporal.common_behavior(cutoff=16),
+    ).select(a=L.v, b=R.v)
+    cap, operators = _run_capture(out)
+    joins = [op for op in operators if isinstance(op, JoinOperator)]
+    arr = sum(op.state_size() for op in joins)
+    assert arr <= 600, f"keep_results=True retained full history: {arr}"
+    # results were NOT retracted by the forgetting
+    results = cap.squash()
+    assert len(results) >= 5 * (n - 20) * 0.9, len(results)
+    pg.G.clear()
+
+
+def test_interval_join_negative_interval_with_cutoff_keeps_on_time_rows():
+    """interval(-10, -5) + cutoff: on-time rows in a monotone stream must
+    not be frozen by the (negative) interval shift — late-arrival
+    rejection is unshifted; only FORGETTING uses the usefulness horizon."""
+    pg.G.clear()
+    n = 200
+    L = table_from_rows(S, [(i, i, 2 * i, 1) for i in range(n)], is_stream=True)
+    R = table_from_rows(S, [(i, 10_000 + i, 2 * i, 1) for i in range(n)],
+                        is_stream=True)
+    out = L.interval_join(
+        R, L.t, R.t, pw.temporal.interval(-10, -5),
+        behavior=pw.temporal.common_behavior(cutoff=3),
+    ).select(a=L.v, b=R.v)
+    cap, _ops = _run_capture(out)
+    # each left row t matches right times [t-10, t-5]: ~6 matches once
+    # the stream is warm — a shifted freeze would produce ~0
+    assert len(cap.squash()) >= 5 * (n - 30), len(cap.squash())
+    pg.G.clear()
+
+
+def test_interval_join_behavior_cutoff_semantics():
+    """cutoff: a row arriving after the frontier has passed its usefulness
+    horizon + cutoff is ignored; on-time rows still match (the behavior
+    parameter was silently unused before r5)."""
+    pg.G.clear()
+    lrows = [(10, 1, 0, 1), (50, 2, 2, 1), (11, 3, 8, 1)]
+    # right side advances the frontier to 60 at logical time 4; the late
+    # left row t=11 (usefulness 13 + cutoff 5 << 60) must be frozen out
+    rrows = [(11, 100, 2, 1), (60, 200, 4, 1)]
+    L = table_from_rows(S, lrows, is_stream=True)
+    R = table_from_rows(S, rrows, is_stream=True)
+    out = L.interval_join(
+        R, L.t, R.t, pw.temporal.interval(-2, 2),
+        behavior=pw.temporal.common_behavior(cutoff=5),
+    ).select(a=L.v, b=R.v)
+    cap, _operators = _run_capture(out)
+    got = sorted(cap.squash().values())
+    # on-time pair (l t=10, r t=11) survives; the late l t=11 does not
+    assert got == [(1, 100)], got
+    pg.G.clear()
+
+
+def test_sliding_window_state_is_o_window_not_o_stream():
+    """Sliding windows (duration 100, hop 50) over a long stream with
+    cutoff + keep_results=False: the groupby must retain only windows
+    near the frontier — O(window), not one state per historical window."""
+    n = max(20000, N // 2)
+    pg.G.clear()
+    rows = [(i, i % 7, 2 * i, 1) for i in range(n)]
+    t = table_from_rows(S, rows, is_stream=True)
+    out = t.windowby(
+        t.t,
+        window=pw.temporal.sliding(duration=100, hop=50),
+        behavior=pw.temporal.common_behavior(cutoff=100, keep_results=False),
+    ).reduce(
+        start=pw.this._pw_window_start,
+        c=pw.reducers.count(),
+    )
+    cap, operators = _run_capture(out)
+    live = len(cap.squash())
+    total_windows = n // 50
+    assert live <= 10, f"{live} live windows retained (keep_results=False)"
+    gbs = [op for op in operators if isinstance(op, GroupbyOperator)]
+    assert gbs
+    arr = sum(op.state_size() for op in gbs)
+    # each live window holds O(duration) member rows; historical windows
+    # must be gone: bound is hundreds, not total_windows * duration
+    assert arr <= 2000, (
+        f"window state {arr} entries for {total_windows} historical "
+        "windows — forgetting is retaining the whole stream"
+    )
+    pg.G.clear()

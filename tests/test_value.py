@@ -30,6 +30,20 @@ def test_auto_row_keys_memo_grows_and_slices():
     assert all(isinstance(k, Pointer) for k in c)
 
 
+def test_ref_pair_bit_identical_to_ref_scalar():
+    from pathway_tpu.internals.value import ref_pair
+
+    a = ref_scalar("left", 1)
+    b = ref_scalar("right", 2)
+    assert ref_pair(a, b) == ref_scalar(a, b)
+    assert ref_pair(b, a) == ref_scalar(b, a)
+    assert ref_pair(a, a) == ref_scalar(a, a)
+    # non-Pointer / negative keys (plain-int universes) fall back to the
+    # signed "I"-tagged serialization — no crash, no divergence
+    assert ref_pair(-5, a) == ref_scalar(-5, a)
+    assert ref_pair(7, 9) == ref_scalar(7, 9)
+
+
 def test_hash_values_type_tagged():
     # type tags must keep colliding value families apart
     assert hash_values(1) != hash_values(1.0)
