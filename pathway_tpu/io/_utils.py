@@ -128,13 +128,17 @@ def _auto_keys(dicts: list[dict], seed: str, start_index: int = 0) -> list:
 class FilePollingSource(DataSource):
     """Streaming-mode file source: re-scan the path, emit only new rows.
 
-    Reference: src/connectors/scanner/filesystem.rs + polling.rs.  Files are
-    treated as append-only: per-file row offsets track what was already
-    emitted (the reference's OffsetAntichain equivalent), and they persist
-    through the persistence layer for exactly-once resume.
+    Reference: src/connectors/scanner/filesystem.rs + polling.rs.  File
+    CONTENT is treated as append-only: per-file row offsets track what was
+    already emitted (the reference's OffsetAntichain equivalent) and
+    persist for exactly-once resume.  File DELETION retracts the file's
+    emitted rows (the reference scanner's deletion entries) within a run;
+    a file deleted while the process was down is not retracted on restart
+    (its rows replay from the journal — matching cached-object-storage
+    semantics, where vanished origins keep serving).
     """
 
-    append_only = True
+    append_only = True  # per-file content contract; deletions retract whole files
     # set by persistence wiring: raw objects cache (CachedObjectStorage) so
     # parsing survives source disappearance (cached_object_storage.rs)
     object_cache = None
@@ -150,6 +154,16 @@ class FilePollingSource(DataSource):
         self._seen: dict[str, float] = {}
         self._progress: dict[str, int] = {}  # file -> rows already emitted
         self._fails: dict[str, tuple[float, int]] = {}  # file -> (mtime, count)
+        self._emitted: dict[str, list] = {}  # file -> events (for deletion)
+        # deletion tracking duplicates rows in host memory; past this many
+        # TOTAL tracked rows, new files stop being tracked (their deletion
+        # then logs instead of retracting) so a large static corpus never
+        # doubles its footprint for a feature it may not use
+        self._emitted_budget = int(
+            os.environ.get("PATHWAY_FS_DELETION_TRACK_MAX_ROWS", "2000000")
+        )
+        self._emitted_rows = 0
+        self._emitted_over_budget_logged = False
         self._last_poll = 0.0
         import inspect
 
@@ -257,7 +271,35 @@ class FilePollingSource(DataSource):
             return []
         self._last_poll = now
         events = self._cached_events()
-        for f in self._files():
+        listed = self._files()
+        # deleted files: retract everything they emitted this run (the
+        # object cache deliberately overrides this under persistence —
+        # cache-served rows outlive their origin)
+        current = set(listed)
+        for f in [f for f in self._seen if f not in current]:
+            if self._seen.get(f) == -1.0:
+                continue  # cache-served marker, origin already gone
+            if self.object_cache is not None and self._cache_contains(f):
+                # cached origin: rows keep serving; mark so later polls
+                # skip the lookup, and free the retraction bookkeeping
+                self._seen[f] = -1.0
+                self._emitted_rows -= len(self._emitted.pop(f, ()))
+                continue
+            retracted = self._emitted.pop(f, None)
+            if retracted is None and self._progress.get(f, 0) > 0:
+                # rows were journal-replayed before this run tracked them
+                # (restart): we cannot retract what we never emitted —
+                # keep _seen/_progress so a recreated file with the same
+                # name does NOT re-emit duplicate keys over the live
+                # replayed rows
+                continue
+            for (t, key, row, diff) in retracted or ():
+                events.append((t, key, row, -diff))
+            self._emitted_rows -= len(retracted or ())
+            self._seen.pop(f, None)
+            self._progress.pop(f, None)
+            self._fails.pop(f, None)
+        for f in listed:
             try:
                 mtime = os.path.getmtime(f)
             except OSError:
@@ -300,8 +342,30 @@ class FilePollingSource(DataSource):
                 dicts, self.schema, seed=f, start_index=start
             )
             self._progress[f] = len(dicts)
+            if self._emitted_rows + len(new) <= self._emitted_budget:
+                self._emitted.setdefault(f, []).extend(new)
+                self._emitted_rows += len(new)
+            elif not self._emitted_over_budget_logged:
+                self._emitted_over_budget_logged = True
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "fs deletion tracking exceeded %d rows; deletions of "
+                    "files ingested from here on will not retract "
+                    "(raise PATHWAY_FS_DELETION_TRACK_MAX_ROWS to track "
+                    "more)", self._emitted_budget,
+                )
             events.extend(new)
         return events
+
+    def _cache_contains(self, uri: str) -> bool:
+        try:
+            contains = getattr(self.object_cache, "contains", None)
+            if contains is not None:
+                return bool(contains(uri))
+            return self.object_cache.get(uri) is not None
+        except OSError:
+            return False
 
 
 class FileWriter:

@@ -56,12 +56,7 @@ def test_live_rag_serving(tmp_path):
 
     def client():
         def post(route, payload, timeout=15):
-            req = urllib.request.Request(
-                f"http://127.0.0.1:{port}{route}",
-                json.dumps(payload).encode(),
-                headers={"Content-Type": "application/json"},
-            )
-            return json.loads(urllib.request.urlopen(req, timeout=timeout).read())
+            return _post(port, route, payload, timeout=timeout)
 
         time.sleep(1.2)
         results["first"] = post("/v1/retrieve", {"query": "stream framework", "k": 1})
@@ -83,3 +78,235 @@ def test_live_rag_serving(tmp_path):
     assert "mxu" in results["second"][0]["text"]
     assert results["answer"].startswith("A[")
     assert results["stats"]["chunk_count"] == 2
+
+
+def _post(port, route, payload, timeout=20):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{route}",
+        json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    return json.loads(urllib.request.urlopen(req, timeout=timeout).read())
+
+
+def _poll_until(fn, deadline_s=8.0, interval_s=0.4):
+    """Poll fn() until it returns a truthy value or the deadline passes;
+    returns the last value either way (timing-robust under CI load)."""
+    t0 = time.monotonic()
+    val = None
+    while time.monotonic() - t0 < deadline_s:
+        try:
+            val = fn()
+            if val:
+                return val
+        except Exception:  # noqa: BLE001 - server may still be warming
+            val = None
+        time.sleep(interval_s)
+    return val
+
+
+def _mk_store(docs_dir):
+    from pathway_tpu.xpacks.llm.embedders import SentenceTransformerEmbedder
+
+    docs = pw.io.fs.read(str(docs_dir), format="binary", mode="streaming",
+                         with_metadata=True)
+    emb = SentenceTransformerEmbedder(
+        config=EncoderConfig(vocab_size=2048, d_model=48, n_layers=2,
+                             n_heads=4, d_ff=96, max_len=48)
+    )
+    return DocumentStore(
+        docs,
+        retriever_factory=BruteForceKnnFactory(
+            dimensions=emb.get_embedding_dimension(), embedder=emb
+        ),
+    )
+
+
+def test_query_racing_index_update(tmp_path):
+    """Queries fired WHILE documents stream in must always return
+    well-formed results (never crash, never partial rows), and the index
+    must become consistent: the final query sees the final corpus."""
+    docs_dir = tmp_path / "docs"
+    docs_dir.mkdir()
+    (docs_dir / "seed.txt").write_text("seed document about alpha topics")
+    store = _mk_store(docs_dir)
+    rag = BaseRAGQuestionAnswerer(
+        lambda msgs: "ok", store, search_topk=1
+    )
+    port = _free_port()
+    QARestServer("127.0.0.1", port, rag)
+    results = {"responses": [], "errors": []}
+
+    def client():
+        time.sleep(1.0)
+        for i in range(10):
+            # writer and querier race on purpose
+            (docs_dir / f"d{i}.txt").write_text(
+                f"document number {i} mentions topic beta{i}"
+            )
+            try:
+                r = _post(port, "/v1/retrieve",
+                          {"query": f"beta{i} topic", "k": 2}, timeout=10)
+                assert isinstance(r, list)
+                for hit in r:
+                    assert "text" in hit and "dist" in hit
+                results["responses"].append(r)
+            except Exception as exc:  # noqa: BLE001
+                results["errors"].append(repr(exc))
+            time.sleep(0.25)
+        # settle, then the index must contain the final corpus
+        results["final"] = _poll_until(
+            lambda: (r := _post(port, "/v1/retrieve",
+                                {"query": "beta9 topic", "k": 1}))
+            and "beta9" in r[0]["text"] and r,
+            deadline_s=6.0,
+        )
+        results["stats"] = _poll_until(
+            lambda: (s := _post(port, "/v1/statistics", {}))
+            and s.get("chunk_count") == 11 and s,
+            deadline_s=5.0,
+        )
+
+    th = threading.Thread(target=client, daemon=True)
+    th.start()
+    pw.run(timeout_s=16.0, autocommit_duration_ms=40,
+           monitoring_level=pw.MonitoringLevel.NONE)
+    th.join(timeout=3)
+
+    assert not results["errors"], results["errors"]
+    assert len(results["responses"]) == 10
+    assert results["final"] and "beta9" in results["final"][0]["text"]
+    assert results["stats"] and results["stats"]["chunk_count"] == 11
+
+
+def test_restart_mid_serving_with_persistence(tmp_path):
+    """Kill the serving pipeline mid-life, restart it with the same
+    persistence backend: pre-crash documents stay retrievable exactly
+    once, and documents added after the restart join the same index."""
+    docs_dir = tmp_path / "docs"
+    docs_dir.mkdir()
+    pdir = tmp_path / "pstate"
+    backend = pw.persistence.Backend.filesystem(str(pdir))
+    from pathway_tpu.internals import parse_graph as pg
+
+    def serve_once(n_expected, query):
+        pg.G.clear()
+        store = _mk_store(docs_dir)
+        rag = BaseRAGQuestionAnswerer(lambda msgs: "ok", store,
+                                      search_topk=1)
+        port = _free_port()
+        QARestServer("127.0.0.1", port, rag)
+        out = {}
+
+        def client():
+            out["stats"] = _poll_until(
+                lambda: (s := _post(port, "/v1/statistics", {}))
+                and s.get("chunk_count") == n_expected and s,
+                deadline_s=7.0,
+            )
+            out["hit"] = _post(port, "/v1/retrieve", {"query": query, "k": 1})
+
+        th = threading.Thread(target=client, daemon=True)
+        th.start()
+        pw.run(timeout_s=9.0, autocommit_duration_ms=40,
+               monitoring_level=pw.MonitoringLevel.NONE,
+               persistence_config=pw.persistence.Config(backend))
+        th.join(timeout=3)
+        pg.G.clear()
+        assert out["stats"] and out["stats"]["chunk_count"] == n_expected, \
+            out.get("stats")
+        return out["hit"]
+
+    (docs_dir / "a.txt").write_text("gamma handbook for stream engines")
+    hit = serve_once(1, "gamma handbook")
+    assert "gamma" in hit[0]["text"]
+    # crash + restart; pre-crash doc must come back exactly once
+    hit = serve_once(1, "gamma handbook")
+    assert "gamma" in hit[0]["text"]
+    # post-restart growth joins the same index
+    (docs_dir / "b.txt").write_text("delta appendix for batch engines")
+    hit = serve_once(2, "delta appendix")
+    assert "delta" in hit[0]["text"]
+
+
+def test_forget_immediately_under_query_storm(tmp_path):
+    """The request/response idiom deletes completed queries immediately
+    (rest_connector delete_completed_queries=True): a burst of queries
+    must all be answered and the query-side state must not accumulate."""
+    docs_dir = tmp_path / "docs"
+    docs_dir.mkdir()
+    (docs_dir / "a.txt").write_text("epsilon reference card for joins")
+    store = _mk_store(docs_dir)
+    rag = BaseRAGQuestionAnswerer(lambda msgs: "ok", store, search_topk=1)
+    port = _free_port()
+    server = QARestServer("127.0.0.1", port, rag)
+    results = {"hits": 0, "errors": []}
+
+    def client():
+        # wait until serving is warm, then storm
+        _poll_until(
+            lambda: (r := _post(port, "/v1/retrieve",
+                                {"query": "epsilon joins", "k": 1}))
+            and "epsilon" in r[0]["text"] and r,
+            deadline_s=8.0,
+        )
+        for i in range(25):
+            try:
+                r = _post(port, "/v1/retrieve",
+                          {"query": "epsilon joins", "k": 1}, timeout=10)
+                assert r and "epsilon" in r[0]["text"]
+                results["hits"] += 1
+            except Exception as exc:  # noqa: BLE001
+                results["errors"].append(repr(exc))
+
+    th = threading.Thread(target=client, daemon=True)
+    th.start()
+    pw.run(timeout_s=22.0, autocommit_duration_ms=30,
+           monitoring_level=pw.MonitoringLevel.NONE)
+    th.join(timeout=3)
+    assert not results["errors"], results["errors"][:3]
+    assert results["hits"] == 25
+    _ = server  # storm answered through one connector
+
+
+def test_document_deletion_mid_serving(tmp_path):
+    """Deleting a source file mid-run retracts its chunks: retrieval must
+    stop returning it (live index maintenance handles deletions, not just
+    additions)."""
+    docs_dir = tmp_path / "docs"
+    docs_dir.mkdir()
+    (docs_dir / "keep.txt").write_text("omega article about keeping data")
+    (docs_dir / "drop.txt").write_text("eta article that will disappear")
+    store = _mk_store(docs_dir)
+    rag = BaseRAGQuestionAnswerer(lambda msgs: "ok", store, search_topk=1)
+    port = _free_port()
+    QARestServer("127.0.0.1", port, rag)
+    results = {}
+
+    def client():
+        results["before"] = _poll_until(
+            lambda: (r := _post(port, "/v1/retrieve",
+                                {"query": "eta disappear", "k": 1}))
+            and "eta" in r[0]["text"] and r,
+            deadline_s=7.0,
+        )
+        (docs_dir / "drop.txt").unlink()
+        results["after"] = _poll_until(
+            lambda: (r := _post(port, "/v1/retrieve",
+                                {"query": "eta disappear", "k": 2}))
+            and all("eta" not in h["text"] for h in r) and r,
+            deadline_s=14.0,
+        )
+        results["stats"] = _post(port, "/v1/statistics", {})
+
+    th = threading.Thread(target=client, daemon=True)
+    th.start()
+    pw.run(timeout_s=24.0, autocommit_duration_ms=40,
+           monitoring_level=pw.MonitoringLevel.NONE)
+    th.join(timeout=3)
+
+    assert results["before"] and "eta" in results["before"][0]["text"]
+    assert results["after"] and all(
+        "eta" not in h["text"] for h in results["after"]
+    ), results["after"]
+    assert results["stats"]["chunk_count"] == 1
