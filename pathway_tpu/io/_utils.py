@@ -164,6 +164,10 @@ class FilePollingSource(DataSource):
         )
         self._emitted_rows = 0
         self._emitted_over_budget_logged = False
+        # files whose live rows are NOT fully covered by _emitted (journal
+        # replay predates tracking, or budget skips): deletion keeps them
+        self._partial: set[str] = set()
+        self._partial_logged = False
         self._last_poll = 0.0
         import inspect
 
@@ -285,17 +289,30 @@ class FilePollingSource(DataSource):
                 self._seen[f] = -1.0
                 self._emitted_rows -= len(self._emitted.pop(f, ()))
                 continue
-            retracted = self._emitted.pop(f, None)
-            if retracted is None and self._progress.get(f, 0) > 0:
-                # rows were journal-replayed before this run tracked them
-                # (restart): we cannot retract what we never emitted —
-                # keep _seen/_progress so a recreated file with the same
-                # name does NOT re-emit duplicate keys over the live
-                # replayed rows
+            if f in self._partial or (
+                f not in self._emitted and self._progress.get(f, 0) > 0
+            ):
+                # we do not hold EVERY live row of this file (journal
+                # replay before tracking started, or the tracking budget
+                # skipped a batch): retracting a subset would leave stale
+                # rows while popping offsets would let a recreated file
+                # double-emit keys over them — keep all bookkeeping
+                self._emitted_rows -= len(self._emitted.pop(f, ()))
+                self._partial.add(f)
+                if not self._partial_logged:
+                    self._partial_logged = True
+                    import logging
+
+                    logging.getLogger(__name__).warning(
+                        "deleted file %s had partially-tracked rows; its "
+                        "previously ingested rows are retained (deletion "
+                        "retraction covers fully-tracked files only)", f,
+                    )
                 continue
-            for (t, key, row, diff) in retracted or ():
+            retracted = self._emitted.pop(f, ())
+            for (t, key, row, diff) in retracted:
                 events.append((t, key, row, -diff))
-            self._emitted_rows -= len(retracted or ())
+            self._emitted_rows -= len(retracted)
             self._seen.pop(f, None)
             self._progress.pop(f, None)
             self._fails.pop(f, None)
@@ -342,19 +359,36 @@ class FilePollingSource(DataSource):
                 dicts, self.schema, seed=f, start_index=start
             )
             self._progress[f] = len(dicts)
-            if self._emitted_rows + len(new) <= self._emitted_budget:
+            if self.object_cache is not None and self._parse_takes_data:
+                # the file was just stored in the object cache, so its
+                # deletion will always take the cache-keeps-serving branch
+                # — tracking rows here would duplicate the corpus in host
+                # memory for a structurally dead retraction path
+                pass
+            elif f in self._partial:
+                pass  # once partial, always partial (never retractable)
+            elif start > 0 and f not in self._emitted:
+                # rows [0, start) predate tracking (journal replay):
+                # retraction could never cover them
+                self._partial.add(f)
+            elif self._emitted_rows + len(new) <= self._emitted_budget:
                 self._emitted.setdefault(f, []).extend(new)
                 self._emitted_rows += len(new)
-            elif not self._emitted_over_budget_logged:
-                self._emitted_over_budget_logged = True
-                import logging
+            else:
+                # budget hit: a partial track is worse than none (see the
+                # deletion branch) — drop what we hold for this file
+                self._partial.add(f)
+                self._emitted_rows -= len(self._emitted.pop(f, ()))
+                if not self._emitted_over_budget_logged:
+                    self._emitted_over_budget_logged = True
+                    import logging
 
-                logging.getLogger(__name__).warning(
-                    "fs deletion tracking exceeded %d rows; deletions of "
-                    "files ingested from here on will not retract "
-                    "(raise PATHWAY_FS_DELETION_TRACK_MAX_ROWS to track "
-                    "more)", self._emitted_budget,
-                )
+                    logging.getLogger(__name__).warning(
+                        "fs deletion tracking exceeded %d rows; deletions "
+                        "of files ingested from here on will not retract "
+                        "(raise PATHWAY_FS_DELETION_TRACK_MAX_ROWS to "
+                        "track more)", self._emitted_budget,
+                    )
             events.extend(new)
         return events
 

@@ -2,12 +2,14 @@
 + src/persistence/): checkpoint input streams & operator state, resume after
 restart with exactly-once output.
 
-Round-1 implementation: input-event-log persistence — every input operator's
-update batches are journaled per logical time to the backend; on restart the
-journal replays before new events, and connector offsets resume.  Operator
-snapshots (reference operator_snapshot.rs) are a planned upgrade keyed on the
-same Backend trait.
-"""
+What this module provides today: input-event journaling with offset
+frontiers (connector resume), operator snapshots (snapshots.py — the
+reference operator_snapshot.rs equivalent, restored ahead of journal
+replay), CachedObjectStorage for vanished origins, the full
+PersistenceMode matrix (realtime/batch/speedrun replay, UDF caching,
+selective persisting) and deterministic-rerun prefix skipping for
+opt-in from-scratch sources.  All keyed on the same Backend trait
+(filesystem / mock / s3)."""
 
 from __future__ import annotations
 

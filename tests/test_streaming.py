@@ -327,3 +327,42 @@ def test_unpack_snapshots_and_table_to():
     seen = []
     t3.to(lambda table: seen.append(table))
     assert seen == [t3]
+
+
+def test_demo_replay_csv_with_time_paces_by_timestamps(tmp_path):
+    """replay_csv_with_time honors inter-row gaps from the time column
+    (reference demo/__init__.py:257) — not replay_csv's fixed rate."""
+    import time as _time
+
+    p = tmp_path / "t.csv"
+    p.write_text("ts,v\n0,a\n0,b\n4,c\n")  # 4-unit gap before the last row
+    class S(pw.Schema):
+        ts: int
+        v: str
+
+    pg.G.clear()
+    t = pw.demo.replay_csv_with_time(str(p), schema=S, time_column="ts",
+                                     unit="s", speedup=8)
+    arrivals = []
+    t0 = _time.monotonic()
+    pw.io.subscribe(t, on_change=lambda key, row, time, is_addition:
+                    arrivals.append((row["v"], _time.monotonic() - t0)))
+    pw.run(idle_stop_s=1.2, autocommit_duration_ms=20,
+           monitoring_level=pw.MonitoringLevel.NONE)
+    got = dict(arrivals)
+    assert set(got) == {"a", "b", "c"}
+    # rows a,b share a timestamp (no wait); c lags by ~4/8 = 0.5s.
+    # Compare against a (committed no later than b) so a slow commit tick
+    # on a loaded runner cannot shrink the measured gap below the bound.
+    assert got["c"] - got["a"] >= 0.3, got
+
+
+def test_demo_generate_custom_stream_validates_nb_rows():
+    import pytest as _pytest
+
+    class S(pw.Schema):
+        v: int
+
+    with _pytest.raises(ValueError):
+        pw.demo.generate_custom_stream({"v": lambda i: i}, schema=S,
+                                       nb_rows=-3)
