@@ -29,9 +29,45 @@ _AGGS = {
 def sql(query: str, **tables: Table) -> Table:
     """pw.sql — reference: internals/sql/processing.py (sqlglot transpiler).
     Native mini-transpiler: SELECT/WHERE/GROUP BY/HAVING/JOIN, UNION
-    [ALL]/INTERSECT/EXCEPT, and subqueries in FROM."""
+    [ALL]/INTERSECT/EXCEPT, subqueries in FROM, WITH CTEs, CASE WHEN,
+    BETWEEN, [NOT] IN lists, and the scalar functions IF/COALESCE/IFNULL/
+    ABS/ROUND/LOWER/UPPER/LENGTH/CONCAT."""
     q = query.strip().rstrip(";")
-    return _sql_query(q, dict(tables))
+    q, tables = _extract_ctes(q, dict(tables))
+    return _sql_query(q, tables)
+
+
+def _extract_ctes(q: str, tables: dict) -> tuple[str, dict]:
+    """WITH name AS (query) [, ...] main — each CTE evaluates against the
+    tables visible so far (earlier CTEs included, reference sql_expr.CTE).
+    Paren counting runs on quote-PROTECTED text so a ')' inside a string
+    literal cannot truncate a CTE body."""
+    m = re.match(r"(?is)^\s*WITH\s+", q)
+    if not m:
+        return q, tables
+    rest, lits = _quote_split(q[m.end():])
+    while True:
+        mc = re.match(r"(?is)^\s*([A-Za-z_]\w*)\s+AS\s*\(", rest)
+        if not mc:
+            raise NotImplementedError(f"malformed WITH clause near {rest!r}")
+        name = mc.group(1)
+        depth, i = 1, mc.end()
+        while i < len(rest) and depth:
+            if rest[i] == "(":
+                depth += 1
+            elif rest[i] == ")":
+                depth -= 1
+            i += 1
+        if depth:
+            raise NotImplementedError(f"unbalanced parens in WITH {name!r}")
+        tables = dict(tables)
+        body = _restore_literals(rest[mc.end(): i - 1].strip(), lits)
+        tables[name] = _sql_query(body, tables)
+        rest = rest[i:].lstrip()
+        if rest.startswith(","):
+            rest = rest[1:]
+            continue
+        return _restore_literals(rest, lits), tables
 
 
 def _restore_literals(txt: str, lits: list[str]) -> str:
@@ -446,7 +482,70 @@ def _eval_ast(node, names: dict, lits: list[str]):
             _eval_ast(node.left, names, lits),
             _eval_ast(node.comparators[0], names, lits),
         )
+    if isinstance(node, ast.Call):
+        if not isinstance(node.func, ast.Name) or node.keywords:
+            raise NotImplementedError("unsupported SQL function call form")
+        fname = node.func.id.upper()
+        fn = _sql_funcs().get(fname)
+        if fn is None:
+            raise NotImplementedError(f"unsupported SQL function {fname}")
+        args = [_eval_ast(a, names, lits) for a in node.args]
+        return fn(*args)
     raise NotImplementedError(f"unsupported SQL syntax node {type(node).__name__}")
+
+
+def _scalar_fn(py_fn, ret_type):
+    """Lift a python scalar function over column expressions via apply
+    (plain values short-circuit)."""
+    def lifted(*args):
+        from .. import apply_with_type
+        from .expression import ColumnExpression
+
+        if any(isinstance(a, ColumnExpression) for a in args):
+            return apply_with_type(py_fn, ret_type, *args)
+        return py_fn(*args)
+
+    return lifted
+
+
+def _make_sql_funcs():
+    from .. import coalesce as _coalesce, if_else as _if_else
+    from . import dtype as _dt
+
+    return {
+        "IF": _if_else,
+        "COALESCE": _coalesce,
+        "IFNULL": _coalesce,
+        "NULLIF": _scalar_fn(lambda a, b: None if a == b else a, _dt.ANY),
+        "ABS": _scalar_fn(lambda v: abs(v) if v is not None else None,
+                          _dt.ANY),
+        "ROUND": _scalar_fn(
+            lambda v, nd=0: round(v, int(nd)) if v is not None else None,
+            _dt.ANY,
+        ),
+        "LOWER": _scalar_fn(lambda v: v.lower() if v is not None else None,
+                            _dt.STR),
+        "UPPER": _scalar_fn(lambda v: v.upper() if v is not None else None,
+                            _dt.STR),
+        "LENGTH": _scalar_fn(lambda v: len(v) if v is not None else None,
+                             _dt.INT),
+        "CONCAT": _scalar_fn(
+            lambda *vs: "".join("" if v is None else str(v) for v in vs),
+            _dt.STR,
+        ),
+    }
+
+
+_SQL_FUNCS_CACHE: dict | None = None
+
+
+def _sql_funcs() -> dict:
+    """Memoized function table (built lazily: pathway_tpu's package init
+    imports this module, so eager top-level imports would cycle)."""
+    global _SQL_FUNCS_CACHE
+    if _SQL_FUNCS_CACHE is None:
+        _SQL_FUNCS_CACHE = _make_sql_funcs()
+    return _SQL_FUNCS_CACHE
 
 
 def _split_keyword(s: str, kw: str) -> list[str]:
@@ -544,4 +643,154 @@ def _parse_expr(txt: str, t: Table) -> Any:
         return fn(_parse_expr(inner, t))
     names = {n: t[n] for n in t.column_names()}
     protected, lits = _quote_split(txt)
+    protected = _rewrite_sugar(protected)
     return _parse_bool(protected, names, lits)
+
+
+# -- SQL-specific sugar rewritten onto the Python-ast grammar --------------
+
+_ATOM_RE = r"(?:[A-Za-z_]\w*(?:\.\w+)*|-?\d+(?:\.\d+)?|__litstr_\d+__)"
+
+
+def _left_operand(s: str, pos: int) -> tuple[int, str]:
+    """Scan BACKWARD from `pos` over one operand: identifier/number/
+    placeholder, a parenthesized group, or a call `name(...)`.  Raises if
+    the operand is preceded by an arithmetic operator — `a + 1 BETWEEN`
+    would otherwise silently bind only the `1` (parenthesize instead)."""
+    j = pos
+    while j > 0 and s[j - 1].isspace():
+        j -= 1
+    if j == 0:
+        raise NotImplementedError("BETWEEN/IN missing left operand")
+    if s[j - 1] == ")":
+        depth, i = 1, j - 1
+        while i > 0 and depth:
+            i -= 1
+            if s[i] == ")":
+                depth += 1
+            elif s[i] == "(":
+                depth -= 1
+        if depth:
+            raise NotImplementedError("unbalanced parens before BETWEEN/IN")
+        start = i
+        # a call: identifier glued to the group
+        while start > 0 and (s[start - 1].isalnum() or s[start - 1] in "_."):
+            start -= 1
+    else:
+        start = j
+        while start > 0 and (s[start - 1].isalnum() or s[start - 1] in "_."):
+            start -= 1
+    k = start
+    while k > 0 and s[k - 1].isspace():
+        k -= 1
+    if k > 0 and s[k - 1] in "+-*/%":
+        raise NotImplementedError(
+            f"complex operand before BETWEEN/IN near {s[max(0, k - 12): j]!r}"
+            " — parenthesize it, e.g. (a + 1) BETWEEN 3 AND 4"
+        )
+    return start, s[start:j]
+
+
+_OPERAND_RE = rf"(?:{_ATOM_RE}|[\w.]*\((?:[^()]|\([^()]*\))*\))"
+
+
+def _rewrite_sugar(s: str) -> str:
+    """BETWEEN / [NOT] IN (...) / CASE WHEN -> comparison chains and
+    IF().  Operates on quote-protected text (string literals are
+    placeholders), BEFORE boolean splitting — BETWEEN's AND must not
+    split the clause; BETWEEN/IN run FIRST so they also work inside CASE
+    conditions (whose AND/OR are converted to &/| afterwards)."""
+    # X [NOT] BETWEEN a AND b
+    pat_between = re.compile(
+        rf"(?is)\s+(NOT\s+)?BETWEEN\s+({_OPERAND_RE})\s+AND\s+"
+        rf"({_OPERAND_RE})"
+    )
+    while True:
+        m = pat_between.search(s)
+        if not m:
+            break
+        start, x = _left_operand(s, m.start())
+        neg, lo, hi = m.group(1), m.group(2), m.group(3)
+        rep = (f"(({x} < {lo}) | ({x} > {hi}))" if neg
+               else f"(({x} >= {lo}) & ({x} <= {hi}))")
+        s = s[:start] + rep + s[m.end():]
+    # X [NOT] IN (a, b, ...) with a flat literal/atom list
+    pat_in = re.compile(r"(?is)\s+(NOT\s+)?IN\s*\(([^()]*)\)")
+    while True:
+        m = pat_in.search(s)
+        if not m:
+            break
+        start, x = _left_operand(s, m.start())
+        neg, items = m.group(1), m.group(2)
+        parts = [p.strip() for p in items.split(",") if p.strip()]
+        if not parts:
+            raise NotImplementedError("empty IN list")
+        if neg:
+            rep = "(" + " & ".join(f"({x} != {p})" for p in parts) + ")"
+        else:
+            rep = "(" + " | ".join(f"({x} == {p})" for p in parts) + ")"
+        s = s[:start] + rep + s[m.end():]
+    return _rewrite_case(s)
+
+
+def _rewrite_case(s: str) -> str:
+    """CASE WHEN c THEN v [WHEN ...] [ELSE e] END -> IF(c, v, IF(..., e));
+    nested CASEs recurse through the inner rewrite."""
+    pat = re.compile(r"(?is)\bCASE\b")
+    while True:
+        m = pat.search(s)
+        if not m:
+            return s
+        # find the matching END at the same CASE-nesting depth
+        depth, i = 1, m.end()
+        tok = re.compile(r"(?is)\b(CASE|END)\b")
+        end_start = end_stop = None
+        for mt in tok.finditer(s, m.end()):
+            depth += 1 if mt.group(1).upper() == "CASE" else -1
+            if depth == 0:
+                end_start, end_stop = mt.start(), mt.end()
+                break
+        if end_start is None:
+            raise NotImplementedError("CASE without matching END")
+        body = _rewrite_case(s[m.end(): end_start])  # inner CASEs first
+        arms = re.split(r"(?is)\bWHEN\b", body)
+        if arms[0].strip():
+            raise NotImplementedError(
+                "only searched CASE (CASE WHEN ...) is supported"
+            )
+        else_expr = "None"
+        clauses = []
+        for arm in arms[1:]:
+            parts = re.split(r"(?is)\bTHEN\b", arm, maxsplit=1)
+            if len(parts) != 2:
+                raise NotImplementedError("CASE WHEN without THEN")
+            cond, rest = parts[0].strip(), parts[1]
+            eparts = re.split(r"(?is)\bELSE\b", rest, maxsplit=1)
+            clauses.append((cond, eparts[0].strip()))
+            if len(eparts) == 2:
+                else_expr = eparts[1].strip()
+        rep = else_expr
+        for cond, val in reversed(clauses):
+            rep = f"IF({_boolkw_to_ops(cond)}, ({val}), ({rep}))"
+        s = s[: m.start()] + rep + s[end_stop:]
+
+
+def _boolkw_to_ops(txt: str) -> str:
+    """AND/OR/NOT keywords -> explicitly parenthesized &/|/~ — needed
+    inside function-call arguments, where the top-level keyword splitter
+    cannot reach and Python's &/| precedence would otherwise bind tighter
+    than the comparisons."""
+    ors = _split_keyword(txt, "OR")
+    if len(ors) > 1:
+        return "(" + " | ".join(_boolkw_to_ops(p) for p in ors) + ")"
+    ands = _split_keyword(txt, "AND")
+    if len(ands) > 1:
+        return "(" + " & ".join(_boolkw_to_ops(p) for p in ands) + ")"
+    s2 = txt.strip()
+    m = re.match(r"(?is)^NOT\s+(.*)$", s2)
+    if m:
+        return "(~" + _boolkw_to_ops(m.group(1)) + ")"
+    stripped = _strip_outer_parens(s2)
+    if stripped is not None:
+        return "(" + _boolkw_to_ops(stripped) + ")"
+    return "(" + s2 + ")"

@@ -206,3 +206,105 @@ def test_join_on_nested_and_groups():
         "(l.k2 = r.k2 AND l.k3 = r.k3)", l=left, r=right)
     df = pw.debug.table_to_pandas(out)
     assert list(df.itertuples(index=False, name=None)) == [(10, 100)]
+
+
+# ---------------------------------------------------------------------------
+# r5 dialect depth: CASE WHEN, BETWEEN, IN, WITH CTEs, scalar functions
+# (reference: internals/sql/processing.py registers case/between/with/if)
+
+
+def _abc():
+    return table_from_markdown(
+        """
+        a | b
+        1 | 10
+        2 | 20
+        3 | 30
+        """
+    )
+
+
+def test_sql_case_when_with_boolean_arms():
+    out = pw.sql(
+        "SELECT a, CASE WHEN a > 2 AND b > 15 THEN 'x' WHEN a = 2 "
+        "THEN 'y' ELSE 'z' END AS c FROM t",
+        t=_abc(),
+    )
+    rows = sorted(run_and_squash(out).values())
+    assert rows == [(1, "z"), (2, "y"), (3, "x")]
+
+
+def test_sql_nested_case():
+    out = pw.sql(
+        "SELECT CASE WHEN a > 1 THEN CASE WHEN b > 25 THEN 'hi' "
+        "ELSE 'mid' END ELSE 'lo' END AS c FROM t",
+        t=_abc(),
+    )
+    assert sorted(run_and_squash(out).values()) == [("hi",), ("lo",), ("mid",)]
+
+
+def test_sql_between_and_not_between():
+    out = pw.sql("SELECT a FROM t WHERE a BETWEEN 1 AND 2", t=_abc())
+    assert sorted(run_and_squash(out).values()) == [(1,), (2,)]
+    out = pw.sql("SELECT a FROM t WHERE a NOT BETWEEN 2 AND 3", t=_abc())
+    assert sorted(run_and_squash(out).values()) == [(1,)]
+    # BETWEEN's AND must not confuse a surrounding boolean AND
+    out = pw.sql("SELECT a FROM t WHERE a BETWEEN 1 AND 2 AND b > 15",
+                 t=_abc())
+    assert sorted(run_and_squash(out).values()) == [(2,)]
+
+
+def test_sql_in_and_not_in():
+    out = pw.sql("SELECT a FROM t WHERE a IN (1, 3)", t=_abc())
+    assert sorted(run_and_squash(out).values()) == [(1,), (3,)]
+    out = pw.sql("SELECT a FROM t WHERE a NOT IN (2)", t=_abc())
+    assert sorted(run_and_squash(out).values()) == [(1,), (3,)]
+
+
+def test_sql_with_ctes_chained():
+    out = pw.sql(
+        "WITH x AS (SELECT a, b FROM t WHERE a > 1), "
+        "y AS (SELECT a FROM x WHERE b > 25) SELECT a FROM y",
+        t=_abc(),
+    )
+    assert sorted(run_and_squash(out).values()) == [(3,)]
+
+
+def test_sql_scalar_functions():
+    out = pw.sql(
+        "SELECT IF(a > 1, 'y', 'n') AS f, COALESCE(NULL, b) AS c, "
+        "UPPER('ok') AS u, LENGTH('abc') AS l, CONCAT('v', a) AS s "
+        "FROM t WHERE a = 2",
+        t=_abc(),
+    )
+    assert sorted(run_and_squash(out).values()) == [("y", 20, "OK", 3, "v2")]
+
+
+def test_sql_unknown_function_raises_clearly():
+    with pytest.raises(NotImplementedError, match="unsupported SQL function"):
+        pw.sql("SELECT MEDIAN_XYZ(a) AS m FROM t", t=_abc())
+
+
+def test_sql_between_in_operand_edge_cases():
+    t = _abc()
+    # parenthesized compound operand works; unparenthesized raises clearly
+    out = pw.sql("SELECT a FROM t WHERE (a + 1) BETWEEN 3 AND 4", t=t)
+    assert sorted(run_and_squash(out).values()) == [(2,), (3,)]
+    with pytest.raises(NotImplementedError, match="parenthesize"):
+        pw.sql("SELECT a FROM t WHERE a + 1 BETWEEN 3 AND 4", t=t)
+    # call operands bind whole
+    out = pw.sql("SELECT a FROM t WHERE ABS(a) IN (1, 3)", t=t)
+    assert sorted(run_and_squash(out).values()) == [(1,), (3,)]
+    # BETWEEN composes inside CASE conditions
+    out = pw.sql(
+        "SELECT CASE WHEN a BETWEEN 1 AND 2 THEN 'in' ELSE 'out' END "
+        "AS c FROM t", t=t)
+    assert sorted(run_and_squash(out).values()) == [("in",), ("in",),
+                                                    ("out",)]
+
+
+def test_sql_cte_with_paren_in_string_literal():
+    out = pw.sql(
+        "WITH x AS (SELECT a, CONCAT(a, ')') AS s FROM t) "
+        "SELECT s FROM x WHERE a = 1", t=_abc())
+    assert sorted(run_and_squash(out).values()) == [("1)",)]
