@@ -21,6 +21,39 @@ from .graph import Operator, Scheduler
 from .types import CapturedStream, Update
 
 
+_DEPS_PREFETCHED = False
+
+
+def _prefetch_lazy_deps() -> None:
+    """Import the hot-path lazy dependencies (pandas: the bulk groupby's
+    C factorizer) on a daemon thread when the FIRST runner is built,
+    overlapping the ~0.4s import with lowering/warmup — the first engine
+    run otherwise pays it inline (it was the entire cold-vs-r1 wordcount
+    gap: 758k rows/s cold with pandas resident vs 337k paying the
+    import).  Triggered at runner construction, not package import, so
+    schema-only/CLI imports never pay it; PATHWAY_NO_DEP_PREFETCH=1
+    disables it entirely (e.g. for fork-sensitive embedders — a child
+    forked mid-import would inherit held per-module import locks)."""
+    global _DEPS_PREFETCHED
+    if _DEPS_PREFETCHED:
+        return
+    _DEPS_PREFETCHED = True
+    import os as _os
+
+    if _os.environ.get("PATHWAY_NO_DEP_PREFETCH"):
+        return
+    import threading
+
+    def _imp():
+        try:
+            import pandas  # noqa: F401
+        except ImportError:
+            pass
+
+    threading.Thread(target=_imp, daemon=True,
+                     name="pw-dep-prefetch").start()
+
+
 def _compile(expr: ColumnExpression) -> Callable[[dict], Any]:
     return expr._eval
 
@@ -315,6 +348,7 @@ def register_lowering(kind: str):
 
 class GraphRunner:
     def __init__(self, sinks: list[pg.OpNode], terminate_on_error: bool = False):
+        _prefetch_lazy_deps()
         self.lg = lower(sinks)
         if terminate_on_error:
             from . import operators as _o
