@@ -16,7 +16,20 @@ from ..internals import parse_graph as pg
 from ..internals.datasource import StaticDataSource, rows_to_events
 from ..internals.schema import SchemaMetaclass, schema_from_types
 from ..internals.table import Table, Universe
-from ..internals.value import Pointer, auto_row_keys, ref_scalar
+from ..internals.value import (
+    Pointer,
+    auto_row_keys,
+    ref_scalar,
+    ref_scalar_batch_rows,
+)
+
+
+def _batch_pk_keys(rows, pk_idx):
+    """Primary-key keys through the native blake2b tier (bit-identical to
+    per-row ref_scalar); None -> caller's per-row fallback."""
+    return ref_scalar_batch_rows(
+        [[r[i] for i in pk_idx] for r in rows], len(pk_idx)
+    )
 
 __all__ = [
     "table_from_markdown",
@@ -176,7 +189,9 @@ def table_from_rows(
         n = len(rows)
         if pk:
             pk_idx = [colnames.index(c) for c in pk]
-            keys = [ref_scalar(*[r[i] for i in pk_idx]) for r in rows]
+            keys = _batch_pk_keys(rows, pk_idx)
+            if keys is None:
+                keys = [ref_scalar(*[r[i] for i in pk_idx]) for r in rows]
         else:
             # same auto-key scheme as the event path below and markdown
             # tables, so static/streamed tables over the same ordinal rows

@@ -109,6 +109,69 @@ def ref_pair(a: int, b: int) -> Pointer:
     return ref_scalar(a, b)
 
 
+def _hashes_to_pointers(his, los) -> list[Pointer]:
+    """(hi, lo) uint64 arrays -> Pointer list (the native hashing tiers'
+    output adapter; packed-bytes + from_bytes measures fastest)."""
+    import numpy as np
+
+    arr = np.empty((len(his), 2), dtype="<u8")
+    arr[:, 0] = los
+    arr[:, 1] = his
+    buf = arr.tobytes()
+    frm = int.from_bytes
+    return [Pointer(frm(buf[i: i + 16], "little"))
+            for i in range(0, len(buf), 16)]
+
+
+def ref_scalar_batch_rows(key_rows: list, n_cols: int) -> list[Pointer] | None:
+    """Batched ``ref_scalar(*row)`` over per-row key-value sequences when
+    every column is uniformly int/float/str — the ONE implementation of
+    the typed-column dispatch (debug tables and connector ingest both key
+    off it, so the dispatch rules can never diverge between them).  None
+    when the native tier is absent or a column type is unsupported."""
+    if not key_rows:
+        return None
+    try:
+        from ..native import available
+
+        if not available():  # no O(n*k) column scan when it can't pay off
+            return None
+        import numpy as np
+
+        cols: list = []
+        for j in range(n_cols):
+            vals = [kv[j] for kv in key_rows]
+            if all(type(v) is int for v in vals):
+                # >64-bit ints raise OverflowError -> per-row fallback
+                cols.append(np.asarray(vals, np.int64))
+            elif all(type(v) is float for v in vals):
+                cols.append(np.asarray(vals, np.float64))
+            elif all(type(v) is str for v in vals):
+                cols.append(vals)
+            else:
+                return None
+        return ref_scalar_batch(cols)
+    except OverflowError:
+        return None
+
+
+def ref_scalar_batch(columns: list) -> list[Pointer] | None:
+    """Batched ``ref_scalar`` over typed key columns (int64/float64
+    ndarrays or list[str]) through the native blake2b tier — bit-identical
+    to per-row ref_scalar (tests/test_value.py pins it).  None when the
+    native library is absent or a column's type is unsupported; callers
+    keep their per-row loop."""
+    try:
+        from ..native import ref_scalar_rows_hashes
+
+        hashed = ref_scalar_rows_hashes(columns)
+    except Exception:  # noqa: BLE001 - per-row path is always valid
+        return None
+    if hashed is None:
+        return None
+    return _hashes_to_pointers(*hashed)
+
+
 _AUTO_ROW_KEYS: list[Pointer] = []
 _AUTO_ROW_KEYS_LOCK = threading.Lock()
 
@@ -127,14 +190,25 @@ def auto_row_keys(n: int) -> list[Pointer]:
     cache = _AUTO_ROW_KEYS
     if len(cache) < n:
         with _AUTO_ROW_KEYS_LOCK:  # concurrent fills must not interleave
-            prefix = b"S" + (4).to_bytes(8, "little") + b"#row" + b"I"
-            blake2b = hashlib.blake2b
-            frm = int.from_bytes
-            for i in range(len(cache), n):
-                data = prefix + i.to_bytes((i.bit_length() + 8) // 8 + 1,
-                                           "little", signed=True)
-                d = blake2b(data, digest_size=16).digest()
-                cache.append(Pointer(frm(d, "little") & _MASK128))
+            start = len(cache)
+            native = None
+            try:
+                from ..native import auto_row_keys_hashes
+
+                native = auto_row_keys_hashes(start, n - start)
+            except Exception:  # noqa: BLE001 - python fill is always valid
+                native = None
+            if native is not None:
+                cache.extend(_hashes_to_pointers(*native))
+            else:
+                prefix = b"S" + (4).to_bytes(8, "little") + b"#row" + b"I"
+                blake2b = hashlib.blake2b
+                frm = int.from_bytes
+                for i in range(start, n):
+                    data = prefix + i.to_bytes(
+                        (i.bit_length() + 8) // 8 + 1, "little", signed=True)
+                    d = blake2b(data, digest_size=16).digest()
+                    cache.append(Pointer(frm(d, "little") & _MASK128))
     return cache[:n]
 
 

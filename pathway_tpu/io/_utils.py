@@ -84,16 +84,23 @@ def events_from_dicts(
         # connector strings would type-tag differently from int/float pks.
         # Unparseable pk values fall back to the raw value so distinct bad
         # rows never collapse onto the shared ERROR sentinel's key.
-        from ..internals.value import ERROR
+        from ..internals.value import ERROR, ref_scalar_batch_rows
 
         pk_idx = [colnames.index(c) for c in pk]
+        rows = []
+        kval_rows = []
         for d in dicts:
             row = tuple(coerce_value(d.get(c), dtypes[c]) for c in colnames)
-            kvals = [
+            rows.append(row)
+            kval_rows.append([
                 row[i] if row[i] is not ERROR else d.get(colnames[i])
                 for i in pk_idx
-            ]
-            events.append((time, ref_scalar(*kvals), row, 1))
+            ])
+        keys = ref_scalar_batch_rows(kval_rows, len(pk_idx))
+        if keys is None:
+            keys = [ref_scalar(*kv) for kv in kval_rows]
+        for row, key in zip(rows, keys):
+            events.append((time, key, row, 1))
         return events
     # auto keys are content+position based and never recomputed elsewhere —
     # batched through the native hashing tier when available

@@ -87,6 +87,29 @@ def get_lib():
             ctypes.POINTER(ctypes.c_uint64), ctypes.POINTER(ctypes.c_int64),
             ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
         ]
+        if not hasattr(lib, "pw_auto_row_keys"):
+            # stale cached .so from older source (copied workdir): fall
+            # back to pure Python now and clear it so a fresh process
+            # rebuilds from the current source
+            try:
+                os.unlink(so)
+            except OSError:
+                pass
+            _build_failed = True
+            return None
+        lib.pw_auto_row_keys.restype = None
+        lib.pw_auto_row_keys.argtypes = [
+            ctypes.c_int64, ctypes.c_uint64,
+            ctypes.POINTER(ctypes.c_uint64), ctypes.POINTER(ctypes.c_uint64),
+        ]
+        lib.pw_ref_scalar_rows.restype = None
+        lib.pw_ref_scalar_rows.argtypes = [
+            ctypes.c_uint64, ctypes.c_uint64,
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_void_p),
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_int64)),
+            ctypes.POINTER(ctypes.c_uint64), ctypes.POINTER(ctypes.c_uint64),
+        ]
         _lib = lib
     return _lib
 
@@ -246,6 +269,82 @@ def hash_rows(columns: list[np.ndarray | list], seed: int = 0) -> np.ndarray:
     return np.array(
         [(int(h) << 64) | int(l) for h, l in zip(out_hi, out_lo)], dtype=object
     )
+
+
+def auto_row_keys_hashes(start: int, n: int):
+    """(hi, lo) uint64 arrays of blake2b16(_ser("#row") + _ser(i)) for
+    i in [start, start+n) — the native tier of value.auto_row_keys (None
+    when the library is unavailable; the caller keeps its Python loop)."""
+    lib = get_lib()
+    if lib is None or n <= 0:
+        return None
+    hi = np.empty(n, np.uint64)
+    lo = np.empty(n, np.uint64)
+    lib.pw_auto_row_keys(
+        start, n,
+        hi.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+        lo.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+    )
+    return hi, lo
+
+
+def ref_scalar_rows_hashes(columns: list):
+    """(hi, lo) uint64 arrays of the CANONICAL key hash (blake2b16 over
+    _ser of each row's values) for typed columns: int64 ndarray, float64
+    ndarray, or list[str].  None when unavailable or a column type is
+    outside the supported set (caller falls back to per-row ref_scalar)."""
+    lib = get_lib()
+    if lib is None or not columns:
+        return None
+    n = len(columns[0])
+    if n == 0:
+        return None
+    kinds, values, offsets, keepalive = [], [], [], []
+    for col in columns:
+        if isinstance(col, np.ndarray) and col.dtype == np.int64:
+            kinds.append(0)
+            c = np.ascontiguousarray(col)
+            keepalive.append(c)
+            values.append(c.ctypes.data_as(ctypes.c_void_p))
+            offsets.append(None)
+        elif isinstance(col, np.ndarray) and col.dtype == np.float64:
+            kinds.append(1)
+            c = np.ascontiguousarray(col)
+            keepalive.append(c)
+            values.append(c.ctypes.data_as(ctypes.c_void_p))
+            offsets.append(None)
+        elif isinstance(col, list) and all(isinstance(v, str) for v in col):
+            kinds.append(2)
+            bufs = [v.encode() for v in col]
+            off = np.zeros(n + 1, np.int64)
+            for i, b in enumerate(bufs):
+                off[i + 1] = off[i] + len(b)
+            raw = b"".join(bufs)
+            cbuf = ctypes.create_string_buffer(raw, len(raw) or 1)
+            keepalive.extend([cbuf, off])
+            values.append(ctypes.cast(cbuf, ctypes.c_void_p))
+            offsets.append(off.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)))
+        else:
+            return None
+    k = len(columns)
+    kinds_arr = (ctypes.c_int32 * k)(*kinds)
+    values_arr = (ctypes.c_void_p * k)(
+        *[v.value if isinstance(v, ctypes.c_void_p) else v for v in values]
+    )
+    OffPtr = ctypes.POINTER(ctypes.c_int64)
+    offsets_arr = (OffPtr * k)(
+        *[o if o is not None else OffPtr() for o in offsets]
+    )
+    hi = np.empty(n, np.uint64)
+    lo = np.empty(n, np.uint64)
+    lib.pw_ref_scalar_rows(
+        n, k, kinds_arr,
+        ctypes.cast(values_arr, ctypes.POINTER(ctypes.c_void_p)),
+        offsets_arr,
+        hi.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+        lo.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+    )
+    return hi, lo
 
 
 def _py_col_val(col, i):

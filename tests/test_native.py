@@ -61,3 +61,61 @@ def test_io_auto_keys_use_native(tmp_path):
     s1, s2 = load(), load()
     assert len(s1) == 200
     assert s1.keys() == s2.keys()
+
+
+def test_native_blake2b_tier_bit_identical():
+    """pw_auto_row_keys / pw_ref_scalar_rows vs the Python canonical hash
+    (internals/value.py) — any drift silently splits universes."""
+    import numpy as np
+    import pytest
+
+    from pathway_tpu import native
+    from pathway_tpu.internals.value import (
+        ref_scalar, ref_scalar_batch,
+    )
+
+    if native.get_lib() is None:
+        pytest.skip("no compiler")
+
+    his, los = native.auto_row_keys_hashes(0, 300)
+    for i in (0, 1, 127, 128, 255, 299):
+        assert ((int(his[i]) << 64) | int(los[i])) == int(
+            ref_scalar("#row", i))
+
+    # ints incl. width boundaries + INT64_MIN, floats incl. nan/inf,
+    # strings incl. utf-8 and >128-byte (multi-block) bodies
+    ints = [0, 1, -1, 255, 256, -256, 2**31, -(2**31), 2**62, -(2**63)]
+    ptrs = ref_scalar_batch([np.asarray(ints, np.int64)])
+    assert ptrs == [ref_scalar(v) for v in ints]
+    floats = [0.0, -0.0, 1.5, float("nan"), float("inf"), -3.14159]
+    ptrs = ref_scalar_batch([np.asarray(floats, np.float64)])
+    assert ptrs == [ref_scalar(v) for v in floats]
+    strs = ["", "a", "hello world", "émoji ✓", "x" * 500]
+    ptrs = ref_scalar_batch([strs])
+    assert ptrs == [ref_scalar(v) for v in strs]
+    # multi-column composite keys
+    ptrs = ref_scalar_batch([strs, np.asarray(range(5), np.int64)])
+    assert ptrs == [ref_scalar(s, i) for i, s in enumerate(strs)]
+
+
+def test_pk_table_keys_match_pointer_from():
+    """table_from_rows pk keys (batched tier) must equal per-row
+    ref_scalar — streamed and static tables over the same pk share
+    universes."""
+    import pathway_tpu as pw
+    from pathway_tpu.debug import table_from_rows
+    from pathway_tpu.internals import parse_graph as pg
+    from pathway_tpu.internals.value import ref_scalar
+
+    class S(pw.Schema):
+        k: str = pw.column_definition(primary_key=True)
+        v: int
+
+    pg.G.clear()
+    t = table_from_rows(S, [("alpha", 1), ("beta", 2)])
+    from pathway_tpu.engine.runner import run_tables
+
+    [cap] = run_tables(t)
+    keys = set(cap.squash().keys())
+    assert keys == {ref_scalar("alpha"), ref_scalar("beta")}
+    pg.G.clear()
