@@ -758,11 +758,34 @@ def bench_generation() -> dict:
     step_tok_s = n_new / max(t_total - t_prefill, 1e-9)
     step_e2e_tok_s = n_new / max(t_total, 1e-9)
 
+    # ---- weight-int8 host tier (decoder.py generate routes CPU decoding
+    # here; models/host_decoder.py): same prefill-subtraction accounting
+    int8_decode_tok_s = int8_e2e_tok_s = None
+    t_prefill_int8 = None
+    host = lm._int8_host()
+    if host is not None:
+        lm.generate(prompt, max_new_tokens=2, fused="int8")  # warm/quantize
+        t0 = _t.perf_counter()
+        lm.generate(prompt, max_new_tokens=1, fused="int8")
+        t_prefill_int8 = _t.perf_counter() - t0
+        t0 = _t.perf_counter()
+        lm.generate(prompt, max_new_tokens=n_new + 1, fused="int8")
+        t_total_int8 = _t.perf_counter() - t0
+        int8_decode_tok_s = n_new / max(t_total_int8 - t_prefill_int8, 1e-9)
+        int8_e2e_tok_s = n_new / max(t_total_int8, 1e-9)
+
     # ---- the auto tier is what lm.generate() actually serves (decoder.py
-    # generate(fused="auto")): fused on TPU, stepwise on the CPU fallback
-    auto_is_fused = backend == "tpu"
-    sel_decode = fused_decode_tok_s if auto_is_fused else step_tok_s
-    sel_e2e = fused_e2e_tok_s if auto_is_fused else step_e2e_tok_s
+    # generate(fused="auto")): fused on TPU, int8 host on CPU (stepwise
+    # when torch is absent)
+    if backend == "tpu":
+        auto_tier = "fused"
+        sel_decode, sel_e2e = fused_decode_tok_s, fused_e2e_tok_s
+    elif int8_decode_tok_s is not None:
+        auto_tier = "int8_host"
+        sel_decode, sel_e2e = int8_decode_tok_s, int8_e2e_tok_s
+    else:
+        auto_tier = "stepwise"
+        sel_decode, sel_e2e = step_tok_s, step_e2e_tok_s
 
     # the no-cache cost: one full-context forward per token (old path)
     full = jax.jit(lambda p, t: forward_logits(p, cfg, t))
@@ -793,17 +816,22 @@ def bench_generation() -> dict:
         max_iterations=2,
     )
     adaptive_s = _t.perf_counter() - t0
+    prefill_sel = (t_prefill_int8 if auto_tier == "int8_host"
+                   else t_prefill)
     return {
         "model": "gpt2-small-class-124M-random",
         "context": 512,
-        "selected_tier": "fused" if auto_is_fused else "stepwise",
-        "prefill_ms": round(t_prefill * 1000, 1),
+        "selected_tier": auto_tier,
+        "prefill_ms": round(prefill_sel * 1000, 1),
         # headline: end-to-end completion rate of the served (auto) tier,
         # prefill included — what a server sees for a 32-token completion
         "tokens_per_sec": round(sel_e2e, 1),
         "decode_tokens_per_sec": round(sel_decode, 1),
         "fused_decode_tokens_per_sec": round(fused_decode_tok_s, 1),
         "stepwise_tokens_per_sec": round(step_tok_s, 1),
+        "int8_host_decode_tokens_per_sec": (
+            round(int8_decode_tok_s, 1) if int8_decode_tok_s else None
+        ),
         "nocache_tokens_per_sec": round(1.0 / t_nocache, 1),
         # decode-vs-decode, same accounting on both sides
         "speedup_vs_stepwise": round(sel_decode / max(step_tok_s, 1e-9), 2),

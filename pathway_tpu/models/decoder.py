@@ -356,16 +356,36 @@ class JaxDecoderLM:
 
         fused="auto" (default) tier-selects by backend: on TPU the fused
         program wins (it removes the ~50-90 ms per-token dispatch round
-        trip); on the CPU fallback decoding is host-bandwidth-bound
-        (~500 MB of params per token), per-step dispatch is ~1 ms, and the
-        fused program runs its full max_new bucket when no stop token fires
-        — so the stepwise loop, which stops exactly at max_new_tokens, is
-        never slower there (VERDICT r3 #3)."""
+        trip); on the CPU fallback decoding is host-bandwidth-bound, so
+        the weight-int8 host tier (half the bytes per token, measured
+        ~2.8x the stepwise XLA loop) serves, with the stepwise loop as
+        the torch-less fallback."""
         if fused == "auto":
-            fused = jax.default_backend() == "tpu"
+            if jax.default_backend() == "tpu":
+                fused = True
+            else:
+                # CPU: decoding is weight-streaming-bound; the int8 host
+                # tier halves bytes/token (models/host_decoder.py,
+                # measured ~2.8x the stepwise XLA loop) — fall back to
+                # stepwise when torch is unavailable
+                fused = "int8" if self._int8_host() is not None else False
         ids = self.tokenizer.encode(prompt)
         keep = self.cfg.max_len - max_new_tokens
         ids = ids[-max(keep, 1):] or [4]
+        if fused == "int8":
+            host = self._int8_host()
+            if host is None:
+                raise RuntimeError("int8 tier requires torch")
+            logits = host.prefill(ids)
+            out = [int(np.argmax(logits))]
+            for _ in range(max_new_tokens - 1):
+                nxt = out[-1]
+                if stop_token is not None and nxt == stop_token:
+                    break
+                if host.n_past >= host.cap:
+                    break
+                out.append(int(np.argmax(host.decode_step(nxt))))
+            return self._decode_out(out)
         L = self._bucket(len(ids) + max_new_tokens)
         if len(ids) + max_new_tokens > L:
             # largest bucket smaller than prompt+completion: keep the most
@@ -410,6 +430,33 @@ class JaxDecoderLM:
             n += 1
             out.append(int(jnp.argmax(logits[0])))
         return self._decode_out(out)
+
+    def _int8_host(self):
+        """Lazy weight-int8 host decoder (host_decoder.Int8DecoderHost);
+        None when torch or its quantized engine is unavailable (any
+        construction failure falls back to the f32 stepwise tier — the
+        quantization API is deprecated upstream, so a future torch may
+        raise something other than ImportError).  Keyed on the params
+        object so reassigning lm.params (JaxChat does) rebuilds the
+        quantized copy instead of serving stale weights."""
+        key = id(self.params)
+        cached = getattr(self, "_int8_host_inst", None)
+        if cached is not None and cached[0] == key:
+            return cached[1]
+        inst = None
+        try:
+            from .host_decoder import Int8DecoderHost
+
+            inst = Int8DecoderHost(self.cfg, self.params)
+        except Exception as exc:  # noqa: BLE001 - stepwise always works
+            import logging
+
+            logging.getLogger(__name__).info(
+                "int8 host decode tier unavailable (%s); CPU generation "
+                "uses the f32 stepwise loop", exc,
+            )
+        self._int8_host_inst = (key, inst)
+        return inst
 
     def _decode_out(self, out: list[int]) -> str:
         if hasattr(self.tokenizer, "decode"):

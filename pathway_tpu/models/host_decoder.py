@@ -1,0 +1,173 @@
+"""Int8 host decode tier for the causal decoder LM (models/decoder.py).
+
+Single-token decoding is a pure weight-streaming problem: every token
+reads all ~124M-class parameters once, so tokens/sec is bounded by bytes
+per parameter, not FLOPs.  On the serving host the measured matvec
+ladder is int8 ~2x f32 and bf16 SLOWER than f32 (no AMX tiling at
+batch 1), so this tier stores all projection weights as per-channel
+dynamically-quantized int8 Linears (fbgemm, AVX512-VNNI) and runs
+attention/normalization in f32.  Weight-only quantization: activations
+are quantized per-batch by fbgemm internally; logits parity vs the f32
+JAX forward is cosine >0.99 (tests/test_host_decoder.py) — the
+standard weight-int8 serving trade.
+
+Reference context: the reference's generation path calls external HTTP
+LLMs (xpacks/llm/llms.py); this framework serves its own decoder, so
+the host tier is the CPU analogue of the fused TPU decode loop.
+"""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+
+def _q8_linear(torch, w: np.ndarray):
+    """Per-channel int8 dynamic Linear from a (in, out) jax-layout matrix."""
+    wt = torch.from_numpy(np.ascontiguousarray(w.T.astype(np.float32)))
+    out_f, in_f = wt.shape
+    lin = torch.ao.nn.quantized.dynamic.Linear(in_f, out_f)
+    scales = wt.abs().amax(dim=1).clamp(min=1e-8) / 127.0
+    qw = torch.quantize_per_channel(
+        wt, scales, torch.zeros(out_f, dtype=torch.int64), 0, torch.qint8
+    )
+    lin.set_weight_bias(qw, None)
+    return lin
+
+
+class Int8DecoderHost:
+    """Weight-int8 greedy decoding over a fixed-capacity f32 KV cache."""
+
+    def __init__(self, cfg, params, cache_capacity: int | None = None):
+        import torch
+
+        self._torch = torch
+        # NOTE: no torch.set_num_threads here — this tier is constructed
+        # implicitly by auto routing and must not clobber the process-wide
+        # thread pool other torch users configured
+        self.cfg = cfg
+        self.cap = int(cache_capacity or cfg.max_len)
+        f32 = np.float32
+
+        def t(a):
+            # copy: jax-exported arrays are non-writable; torch wants owned
+            return torch.from_numpy(np.array(a, dtype=f32, copy=True))
+
+        self._emb = t(params["embed"])
+        self._pos = t(params["pos_embed"])
+        self._lnf = (t(params["ln_f_scale"]), t(params["ln_f_bias"]))
+        self._layers = []
+        for L in params["layers"]:
+            wqkv = np.concatenate(
+                [np.asarray(L["wq"]), np.asarray(L["wk"]),
+                 np.asarray(L["wv"])], axis=1,
+            )
+            self._layers.append({
+                "qkv": _q8_linear(torch, wqkv),
+                "o": _q8_linear(torch, np.asarray(L["wo"])),
+                "up": _q8_linear(torch, np.asarray(L["w_up"])),
+                "down": _q8_linear(torch, np.asarray(L["w_down"])),
+                "ln1": (t(L["ln1_scale"]), t(L["ln1_bias"])),
+                "ln2": (t(L["ln2_scale"]), t(L["ln2_bias"])),
+            })
+        self._head = _q8_linear(torch, np.asarray(params["embed"]).T)
+        H, D = cfg.n_heads, cfg.d_model
+        self._hd = D // H
+        self._K = torch.zeros(cfg.n_layers, H, self.cap, self._hd)
+        self._V = torch.zeros(cfg.n_layers, H, self.cap, self._hd)
+        self._scale = 1.0 / math.sqrt(self._hd)
+        self.n_past = 0
+
+    # -- shared blocks -----------------------------------------------------
+
+    def _act(self, v):
+        F = self._torch.nn.functional
+        if self.cfg.act == "gelu":
+            return F.gelu(v)
+        if self.cfg.act == "relu":
+            return self._torch.relu(v)
+        return F.gelu(v, approximate="tanh")
+
+    def _ln(self, x, sb):
+        F = self._torch.nn.functional
+        return F.layer_norm(x, (self.cfg.d_model,), sb[0], sb[1],
+                            self.cfg.ln_eps)
+
+    # -- prefill -----------------------------------------------------------
+
+    def prefill(self, token_ids) -> np.ndarray:
+        """Run the prompt through the int8 blocks, filling the KV cache;
+        returns the next-token logits (f32 numpy)."""
+        torch = self._torch
+        ids = torch.as_tensor(np.asarray(token_ids, np.int64))
+        T = len(ids)
+        if T > self.cap:
+            raise ValueError(f"prompt {T} exceeds cache capacity {self.cap}")
+        H, hd = self.cfg.n_heads, self._hd
+        with torch.no_grad():
+            x = self._emb[ids] + self._pos[:T]
+            causal = torch.tril(torch.ones(T, T, dtype=torch.bool))
+            for li, w in enumerate(self._layers):
+                h = self._ln(x, w["ln1"])
+                qkv = w["qkv"](h)
+                q, k, v = qkv.view(T, 3, H, hd).permute(1, 2, 0, 3)
+                self._K[li, :, :T] = k
+                self._V[li, :, :T] = v
+                sc = (q @ k.transpose(-1, -2)) * self._scale
+                sc = sc.masked_fill(~causal, float("-inf"))
+                att = torch.softmax(sc, dim=-1)
+                o = (att @ v).permute(1, 0, 2).reshape(T, self.cfg.d_model)
+                x = x + w["o"](o)
+                h = self._ln(x, w["ln2"])
+                x = x + w["down"](self._act(w["up"](h)))
+            x = self._ln(x[-1:], self._lnf)
+            logits = self._head(x)[0]
+        self.n_past = T
+        return logits.numpy()
+
+    # -- decode ------------------------------------------------------------
+
+    def decode_step(self, token_id: int) -> np.ndarray:
+        """Append one token against the cache; returns next-token logits."""
+        torch = self._torch
+        n = self.n_past
+        if n >= self.cap:
+            raise ValueError("KV cache full")
+        H, hd = self.cfg.n_heads, self._hd
+        with torch.no_grad():
+            x = (self._emb[token_id] + self._pos[n]).unsqueeze(0)
+            for li, w in enumerate(self._layers):
+                h = self._ln(x, w["ln1"])
+                qkv = w["qkv"](h)
+                q, k, v = qkv.view(3, H, hd)
+                self._K[li, :, n] = k
+                self._V[li, :, n] = v
+                keys = self._K[li, :, : n + 1]
+                vals = self._V[li, :, : n + 1]
+                att = torch.softmax(
+                    (keys @ q.unsqueeze(-1)).squeeze(-1) * self._scale,
+                    dim=-1,
+                )
+                o = (att.unsqueeze(1) @ vals).squeeze(1).reshape(
+                    1, self.cfg.d_model
+                )
+                x = x + w["o"](o)
+                h = self._ln(x, w["ln2"])
+                x = x + w["down"](self._act(w["up"](h)))
+            x = self._ln(x, self._lnf)
+            logits = self._head(x)[0]
+        self.n_past = n + 1
+        return logits.numpy()
+
+    def generate(self, prompt_ids, n_new: int) -> list[int]:
+        """Greedy completion: prefill + n_new cached decode steps."""
+        logits = self.prefill(prompt_ids)
+        out = []
+        tok = int(np.argmax(logits))
+        for _ in range(n_new):
+            out.append(tok)
+            if len(out) == n_new:
+                break
+            tok = int(np.argmax(self.decode_step(tok)))
+        return out

@@ -1,0 +1,92 @@
+"""Int8DecoderHost — the weight-int8 CPU decode tier
+(models/host_decoder.py) and its auto-tier routing in JaxDecoderLM."""
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+import jax
+import jax.numpy as jnp
+
+from pathway_tpu.models.decoder import (
+    DecoderConfig,
+    JaxDecoderLM,
+    forward_logits,
+    init_decoder_params,
+)
+
+
+@pytest.fixture(scope="module")
+def small():
+    cfg = DecoderConfig(vocab_size=512, d_model=128, n_layers=3, n_heads=4,
+                        d_ff=256, max_len=128)
+    params = init_decoder_params(cfg, jax.random.PRNGKey(0))
+    return cfg, params
+
+
+def _cos(a, b):
+    return float(a @ b / (np.linalg.norm(a) * np.linalg.norm(b) + 1e-12))
+
+
+def test_prefill_and_decode_parity(small):
+    from pathway_tpu.models.host_decoder import Int8DecoderHost
+
+    cfg, params = small
+    host = Int8DecoderHost(cfg, params)
+    rng = np.random.default_rng(0)
+    ids = rng.integers(4, 500, 24)
+    ref = np.asarray(
+        forward_logits(params, cfg, jnp.asarray(ids[None], jnp.int32))
+    )[0]
+    logits = host.prefill(ids)
+    assert _cos(logits, ref[-1]) > 0.99
+    assert int(np.argmax(logits)) == int(np.argmax(ref[-1]))
+
+    # decode steps stay aligned with the f32 full forward
+    tok = int(np.argmax(logits))
+    seq = list(ids)
+    for _ in range(4):
+        seq.append(tok)
+        step_logits = host.decode_step(tok)
+        ref_step = np.asarray(
+            forward_logits(params, cfg,
+                           jnp.asarray(np.asarray(seq)[None], jnp.int32))
+        )[0][-1]
+        assert _cos(step_logits, ref_step) > 0.99
+        tok = int(np.argmax(step_logits))
+
+
+def test_cache_reset_between_generations(small):
+    from pathway_tpu.models.host_decoder import Int8DecoderHost
+
+    cfg, params = small
+    host = Int8DecoderHost(cfg, params)
+    rng = np.random.default_rng(1)
+    ids = rng.integers(4, 500, 10)
+    a = host.generate(ids, 5)
+    b = host.generate(ids, 5)  # second run must not see stale cache rows
+    assert a == b
+
+
+def test_capacity_guard(small):
+    from pathway_tpu.models.host_decoder import Int8DecoderHost
+
+    cfg, params = small
+    host = Int8DecoderHost(cfg, params, cache_capacity=8)
+    with pytest.raises(ValueError, match="capacity"):
+        host.prefill(np.arange(4, 20))
+
+
+def test_lm_auto_routes_int8_on_cpu(small):
+    cfg, params = small
+    lm = JaxDecoderLM(cfg, params=params, seq_buckets=(64, 128))
+    # explicit tiers agree on the completion (greedy, same weights modulo
+    # int8 rounding — pin the int8 tier against itself and check auto
+    # routing picks it on the CPU backend)
+    out_int8 = lm.generate("hello w1 w2 w3", max_new_tokens=6, fused="int8")
+    out_auto = lm.generate("hello w1 w2 w3", max_new_tokens=6)
+    assert out_auto == out_int8  # auto == int8 on cpu
+    # and the f32 stepwise tier produces a same-length completion
+    out_step = lm.generate("hello w1 w2 w3", max_new_tokens=6, fused=False)
+    assert len(out_step.split()) == len(out_int8.split())
