@@ -914,11 +914,13 @@ def _write_self(obj: dict | None = None, partial: bool = True) -> None:
     tmp = f"{_SELF_REPORT}.{threading.get_ident()}.tmp"
     try:
         with open(tmp, "w") as fh:
-            json.dump(rec, fh, indent=1)
+            # default=str: a numpy scalar sneaking into a metric must
+            # degrade the record, never crash the bench at a stage boundary
+            json.dump(rec, fh, indent=1, default=str)
             fh.flush()
             os.fsync(fh.fileno())
         os.replace(tmp, _SELF_REPORT)
-    except OSError:
+    except (OSError, TypeError, ValueError):
         pass
 
 

@@ -178,7 +178,7 @@ class SubjectDataSource(DataSource):
         (mqtt/nats/rabbitmq/rest) only deliver NEW events after a restart,
         so skipping would eat real data.  Subjects that declare
         deterministic re-emission opt in (demo.replay_csv,
-        demo.range_stream, io.http.read's default); a subject with real
+        demo.range_stream; io.http.read via its parameter); a subject with real
         seek support never needs the skip."""
         return (
             getattr(self.subject, "seek", None) is None

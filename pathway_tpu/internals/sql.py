@@ -588,6 +588,8 @@ def _strip_outer_parens(s: str) -> str | None:
 
 
 def _parse_bool(s: str, names: dict, lits: list[str]):
+    # KEEP IN SYNC with _boolkw_to_ops (the string-rewrite twin used
+    # inside CASE/IF arguments) — see its docstring.
     """SQL boolean grammar: OR < AND < NOT < comparison — each comparison
     clause is evaluated as its own atom, so Python's `&`-binds-tighter-than-
     `==` precedence never mangles `a = 1 AND b = 2`."""
@@ -779,7 +781,13 @@ def _boolkw_to_ops(txt: str) -> str:
     """AND/OR/NOT keywords -> explicitly parenthesized &/|/~ — needed
     inside function-call arguments, where the top-level keyword splitter
     cannot reach and Python's &/| precedence would otherwise bind tighter
-    than the comparisons."""
+    than the comparisons.
+
+    KEEP IN SYNC with _parse_bool: both encode the OR < AND < NOT grammar
+    (this one as a string rewrite, that one over live expressions); a
+    precedence or keyword-splitting change applied to only one of them
+    would make the same condition parse differently at top level vs
+    inside a CASE/IF argument."""
     ors = _split_keyword(txt, "OR")
     if len(ors) > 1:
         return "(" + " | ".join(_boolkw_to_ops(p) for p in ors) + ")"

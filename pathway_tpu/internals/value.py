@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import hashlib
 import math
+import os
 import struct
 import threading
 from typing import Any, Iterable
@@ -188,6 +189,21 @@ def auto_row_keys(n: int) -> list[Pointer]:
     live tables' own key objects, so its marginal footprint is one
     pointer-list."""
     cache = _AUTO_ROW_KEYS
+    cap = int(os.environ.get("PATHWAY_AUTO_KEY_CACHE_MAX", "4000000"))
+    if n > cap:
+        # beyond the cap the prefix stays cached and the tail is computed
+        # fresh per call — bounds the process-lifetime pin (~50MB/1M keys)
+        head = auto_row_keys(cap)
+        tail_h = None
+        try:
+            from ..native import auto_row_keys_hashes
+
+            tail_h = auto_row_keys_hashes(cap, n - cap)
+        except Exception:  # noqa: BLE001
+            tail_h = None
+        if tail_h is not None:
+            return head + _hashes_to_pointers(*tail_h)
+        return head + [ref_scalar("#row", i) for i in range(cap, n)]
     if len(cache) < n:
         with _AUTO_ROW_KEYS_LOCK:  # concurrent fills must not interleave
             start = len(cache)

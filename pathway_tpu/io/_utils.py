@@ -162,12 +162,13 @@ class FilePollingSource(DataSource):
         self._progress: dict[str, int] = {}  # file -> rows already emitted
         self._fails: dict[str, tuple[float, int]] = {}  # file -> (mtime, count)
         self._emitted: dict[str, list] = {}  # file -> events (for deletion)
-        # deletion tracking duplicates rows in host memory; past this many
-        # TOTAL tracked rows, new files stop being tracked (their deletion
-        # then logs instead of retracting) so a large static corpus never
-        # doubles its footprint for a feature it may not use
+        # deletion tracking holds one extra event tuple per row (the ROW
+        # payloads are shared references with engine state, so the cost is
+        # ~80B of tuple/list overhead per row, not a payload copy); past
+        # this many TOTAL tracked rows, new files stop being tracked
+        # (their deletion then logs instead of retracting)
         self._emitted_budget = int(
-            os.environ.get("PATHWAY_FS_DELETION_TRACK_MAX_ROWS", "2000000")
+            os.environ.get("PATHWAY_FS_DELETION_TRACK_MAX_ROWS", "1000000")
         )
         self._emitted_rows = 0
         self._emitted_over_budget_logged = False

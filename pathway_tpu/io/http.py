@@ -570,7 +570,7 @@ def read(
     retry_codes: tuple | None = (429, 500, 502, 503, 504),
     autocommit_duration_ms: int = 10000,
     flush_trailing: bool = False,
-    deterministic_rerun: bool = True,
+    deterministic_rerun: bool = False,
     **kwargs,
 ):
     """Read a table from a streaming HTTP endpoint (reference: io/http
@@ -588,11 +588,13 @@ def read(
     their delimiter-less tail is always delivered.
 
     `deterministic_rerun`: under persistence, whether a process restart
-    re-delivers the same byte stream from the start (True — the common
-    case for re-requesting a URL; the journaled prefix is skipped for
-    exactly-once restarts).  Set False for push-style endpoints (SSE,
-    long-poll) that only send NEW events after reconnecting — skipping
-    would silently drop their first fresh messages."""
+    re-delivers the same byte stream from the start.  Opt-in (default
+    False, matching ConnectorSubject's safety default): for a push-style
+    endpoint (SSE, long-poll — anything that only sends NEW events per
+    connection) the prefix skip would silently drop the first fresh
+    messages after a restart, and loss is invisible where duplicates are
+    not.  Set True for stable re-requested resources to get exactly-once
+    restarts instead of duplicates."""
     from ..internals.schema import schema_from_types
     from . import python as io_python
 

@@ -306,6 +306,9 @@ class JaxDecoderLM:
         def _step_fn(params, cache, token, pos):
             return decode_step(params, _cfg, cache, token, pos)
 
+        import threading
+
+        self._int8_gen_lock = threading.Lock()
         self._prefill = jax.jit(_prefill_fn)
         # cache donated: each step consumes the previous cache buffers in place
         self._step = jax.jit(_step_fn, donate_argnums=(1,))
@@ -376,15 +379,19 @@ class JaxDecoderLM:
             host = self._int8_host()
             if host is None:
                 raise RuntimeError("int8 tier requires torch")
-            logits = host.prefill(ids)
-            out = [int(np.argmax(logits))]
-            for _ in range(max_new_tokens - 1):
-                nxt = out[-1]
-                if stop_token is not None and nxt == stop_token:
-                    break
-                if host.n_past >= host.cap:
-                    break
-                out.append(int(np.argmax(host.decode_step(nxt))))
+            # the host tier's KV cache is shared mutable state (unlike the
+            # functional fused/stepwise tiers): serialize generations so
+            # concurrent callers cannot interleave cache writes
+            with self._int8_gen_lock:
+                logits = host.prefill(ids)
+                out = [int(np.argmax(logits))]
+                for _ in range(max_new_tokens - 1):
+                    nxt = out[-1]
+                    if stop_token is not None and nxt == stop_token:
+                        break
+                    if host.n_past >= host.cap:
+                        break
+                    out.append(int(np.argmax(host.decode_step(nxt))))
             return self._decode_out(out)
         L = self._bucket(len(ids) + max_new_tokens)
         if len(ids) + max_new_tokens > L:
@@ -439,9 +446,11 @@ class JaxDecoderLM:
         raise something other than ImportError).  Keyed on the params
         object so reassigning lm.params (JaxChat does) rebuilds the
         quantized copy instead of serving stale weights."""
-        key = id(self.params)
         cached = getattr(self, "_int8_host_inst", None)
-        if cached is not None and cached[0] == key:
+        # identity (not id()) comparison WITH a strong reference kept in
+        # the cache: a garbage-collected params dict could otherwise hand
+        # its address to a new params object and serve stale weights
+        if cached is not None and cached[0] is self.params:
             return cached[1]
         inst = None
         try:
@@ -455,7 +464,7 @@ class JaxDecoderLM:
                 "int8 host decode tier unavailable (%s); CPU generation "
                 "uses the f32 stepwise loop", exc,
             )
-        self._int8_host_inst = (key, inst)
+        self._int8_host_inst = (self.params, inst)
         return inst
 
     def _decode_out(self, out: list[int]) -> str:

@@ -375,16 +375,27 @@ class JaxEncoder:
         bucket.  None when torch is absent.  ``mode="eager"`` skips
         inductor (tests; same math)."""
         attr = f"_compiled_query_{mode}"
-        if getattr(self, attr, None) is None:
+        cur = getattr(self, attr, None)
+        if cur is False:  # construction failed before; don't retry/respam
+            return None
+        if cur is None:
             try:
                 from .host_encoder import CompiledQueryEncoder
 
-                setattr(self, attr, CompiledQueryEncoder(
+                cur = CompiledQueryEncoder(
                     self.cfg, self.params, self.tokenizer, mode=mode
-                ))
-            except ImportError:
-                setattr(self, attr, None)
-        return getattr(self, attr)
+                )
+                setattr(self, attr, cur)
+            except Exception as exc:  # noqa: BLE001 - eager mirrors serve
+                import logging
+
+                logging.getLogger(__name__).info(
+                    "compiled query tier unavailable (%s); serving falls "
+                    "back to the eager mirrors", exc,
+                )
+                setattr(self, attr, False)
+                return None
+        return cur
 
     def cpu_mirror(self):
         """Host-side mirror — the serving latency tier (single queries).

@@ -749,7 +749,7 @@ def _wrap_source_with_persistence(source, backend: Backend, stream: str,
         return _retime(replayed + fresh)
 
     # live sources that OPTED INTO deterministic_rerun (replay_csv,
-    # range_stream, http.read by default; user subjects explicitly)
+    # range_stream; http.read and user subjects explicitly)
     # re-emit the whole stream on restart: skip the first
     # count(key) occurrences of each replayed/folded key, same prefix-count
     # idiom as static sources — otherwise journal replay + the re-run
