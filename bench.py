@@ -297,9 +297,14 @@ def bench_data_plane(n_rows: int = 1_000_000) -> dict:
     t0 = time.perf_counter()
     run_tables(pipeline())
     el_cold = time.perf_counter() - t0
-    t0 = time.perf_counter()
-    [cap] = run_tables(pipeline())
-    el_vec = time.perf_counter() - t0
+    # warm window is best-of-2: host throughput swings ~2x between runs
+    # depending on allocator/cache state left by earlier sections (same
+    # variance rationale as the ingest section's best-of-2)
+    el_vec = float("inf")
+    for _ in range(2):
+        t0 = time.perf_counter()
+        [cap] = run_tables(pipeline())
+        el_vec = min(el_vec, time.perf_counter() - t0)
     res_vec = cap.squash()
 
     import pathway_tpu.engine.runner as rmod
