@@ -83,7 +83,14 @@ class Operator:
         if not updates:
             return
         self.rows_out += len(updates)
-        self.rows_out_neg += sum(1 for _k, _r, d in updates if d < 0)
+        # ColumnarBatch exposes diffs directly — iterating the batch for
+        # the negative count would materialize every row tuple of every
+        # emitted batch (measured ~0.3s/1M rows per operator hop)
+        diffs = getattr(updates, "diffs", None)
+        if diffs is not None:
+            self.rows_out_neg += sum(1 for d in diffs if d < 0)
+        else:
+            self.rows_out_neg += sum(1 for _k, _r, d in updates if d < 0)
         assert self.scheduler is not None
         self.scheduler.route(self, time, updates)
 
