@@ -175,6 +175,28 @@ def ref_scalar_batch(columns: list) -> list[Pointer] | None:
 
 _AUTO_ROW_KEYS: list[Pointer] = []
 _AUTO_ROW_KEYS_LOCK = threading.Lock()
+_AUTO_KEY_CACHE_MAX: int | None = None
+
+
+def _auto_key_cache_max() -> int:
+    """Parsed once; a malformed env value logs and keeps the default
+    rather than crashing every table build in the hot key path."""
+    global _AUTO_KEY_CACHE_MAX
+    if _AUTO_KEY_CACHE_MAX is None:
+        try:
+            _AUTO_KEY_CACHE_MAX = int(
+                os.environ.get("PATHWAY_AUTO_KEY_CACHE_MAX", "4000000")
+            )
+        except ValueError:
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "PATHWAY_AUTO_KEY_CACHE_MAX=%r is not an integer; using "
+                "the 4000000 default",
+                os.environ.get("PATHWAY_AUTO_KEY_CACHE_MAX"),
+            )
+            _AUTO_KEY_CACHE_MAX = 4_000_000
+    return _AUTO_KEY_CACHE_MAX
 
 
 def auto_row_keys(n: int) -> list[Pointer]:
@@ -189,7 +211,7 @@ def auto_row_keys(n: int) -> list[Pointer]:
     live tables' own key objects, so its marginal footprint is one
     pointer-list."""
     cache = _AUTO_ROW_KEYS
-    cap = int(os.environ.get("PATHWAY_AUTO_KEY_CACHE_MAX", "4000000"))
+    cap = _auto_key_cache_max()
     if n > cap:
         # beyond the cap the prefix stays cached and the tail is computed
         # fresh per call — bounds the process-lifetime pin (~50MB/1M keys)

@@ -446,26 +446,30 @@ class JaxDecoderLM:
         raise something other than ImportError).  Keyed on the params
         object so reassigning lm.params (JaxChat does) rebuilds the
         quantized copy instead of serving stale weights."""
-        cached = getattr(self, "_int8_host_inst", None)
-        # identity (not id()) comparison WITH a strong reference kept in
-        # the cache: a garbage-collected params dict could otherwise hand
-        # its address to a new params object and serve stale weights
-        if cached is not None and cached[0] is self.params:
-            return cached[1]
-        inst = None
-        try:
-            from .host_decoder import Int8DecoderHost
+        # construction serialized under the generation lock: concurrent
+        # first generations must not each quantize a full parameter copy
+        with self._int8_gen_lock:
+            cached = getattr(self, "_int8_host_inst", None)
+            # identity (not id()) comparison WITH a strong reference kept
+            # in the cache: a garbage-collected params dict could
+            # otherwise hand its address to a new params object and serve
+            # stale weights
+            if cached is not None and cached[0] is self.params:
+                return cached[1]
+            inst = None
+            try:
+                from .host_decoder import Int8DecoderHost
 
-            inst = Int8DecoderHost(self.cfg, self.params)
-        except Exception as exc:  # noqa: BLE001 - stepwise always works
-            import logging
+                inst = Int8DecoderHost(self.cfg, self.params)
+            except Exception as exc:  # noqa: BLE001 - stepwise works
+                import logging
 
-            logging.getLogger(__name__).info(
-                "int8 host decode tier unavailable (%s); CPU generation "
-                "uses the f32 stepwise loop", exc,
-            )
-        self._int8_host_inst = (self.params, inst)
-        return inst
+                logging.getLogger(__name__).info(
+                    "int8 host decode tier unavailable (%s); CPU "
+                    "generation uses the f32 stepwise loop", exc,
+                )
+            self._int8_host_inst = (self.params, inst)
+            return inst
 
     def _decode_out(self, out: list[int]) -> str:
         if hasattr(self.tokenizer, "decode"):

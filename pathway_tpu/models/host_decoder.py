@@ -47,7 +47,8 @@ class Int8DecoderHost:
         # implicitly by auto routing and must not clobber the process-wide
         # thread pool other torch users configured
         self.cfg = cfg
-        self.cap = int(cache_capacity or cfg.max_len)
+        # clamp: positions beyond max_len have no positional embedding
+        self.cap = min(int(cache_capacity or cfg.max_len), cfg.max_len)
         f32 = np.float32
 
         def t(a):

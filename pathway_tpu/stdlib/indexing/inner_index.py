@@ -229,17 +229,16 @@ class BruteForceKnn(InnerIndex):
         m = self.host_matrix() if self._dev_refs else self.matrix[: self.n]
         if self.metric == "cos":
             qn = q / (np.linalg.norm(q) + 1e-12)
-            # the row-normalized matrix is cached per (version, rows):
-            # renormalizing 4096x384 per query was ~0.5ms of the serving
-            # p50 — one matvec is all a cos query should pay
-            cached = getattr(self, "_normed_mirror", None)
-            if cached is None or cached[0] != self._version or \
-                    cached[1] != len(m):
+            # shared version-keyed normalized mirror (same cache the
+            # tier="cpu" branch uses; _invalidate clears it on mutation) —
+            # renormalizing the matrix per query costs ~0.5ms at 4096x384
+            if (
+                self._host_mirror_norm is None
+                or self._host_mirror_norm[0] != self._version
+            ):
                 mn = m / (np.linalg.norm(m, axis=1, keepdims=True) + 1e-12)
-                self._normed_mirror = (self._version, len(m), mn)
-            else:
-                mn = cached[2]
-            return mn @ qn
+                self._host_mirror_norm = (self._version, mn)
+            return self._host_mirror_norm[1] @ qn
         if self.metric == "l2sq":
             return -np.sum((m - q) ** 2, axis=1)
         return m @ q  # dot

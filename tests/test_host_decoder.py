@@ -78,6 +78,8 @@ def test_capacity_guard(small):
         host.prefill(np.arange(4, 20))
 
 
+@pytest.mark.skipif(jax.default_backend() != "cpu",
+                    reason="auto routes to the fused tier on accelerators")
 def test_lm_auto_routes_int8_on_cpu(small):
     cfg, params = small
     lm = JaxDecoderLM(cfg, params=params, seq_buckets=(64, 128))
