@@ -675,7 +675,11 @@ def bench_retrieval_quality() -> dict:
             },
         },
         "hybrid_beats_dense": hybrid_eval["ndcg"] >= ours_test["ndcg"],
-        "hybrid_beats_bm25": hybrid_eval["ndcg"] >= bm25_test["ndcg"],
+        # strict >: with dense_weight=0.0 the hybrid IS bm25, and `>=` made
+        # this trivially true (round-5 VERDICT); the headline dense_weight
+        # makes a zero-contribution dense tier visible at a glance
+        "hybrid_beats_bm25": hybrid_eval["ndcg"] > bm25_test["ndcg"],
+        "hybrid_dense_weight": w_best,
         "ours": {"recall@10": ours["recall"], "ndcg@10": ours["ndcg"],
                  "mrr": ours["mrr"]},
         "reference": {"recall@10": ref["recall"], "ndcg@10": ref["ndcg"],

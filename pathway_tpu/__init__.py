@@ -119,6 +119,7 @@ from . import debug  # noqa: E402
 from . import demo  # noqa: E402
 from . import io  # noqa: E402
 from . import persistence  # noqa: E402
+from . import serve  # noqa: E402
 from . import stdlib  # noqa: E402
 from .internals import udfs  # noqa: E402
 from .internals.udfs import UDF, udf  # noqa: E402

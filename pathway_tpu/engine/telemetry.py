@@ -50,6 +50,14 @@ class MetricsServer:
             for k, v in fabric.stats.items():
                 val = f"{v:.6f}" if isinstance(v, float) else str(v)
                 lines.append(f'pathway_fabric{{stat="{k}"}} {val}')
+        # serving-path backpressure (queue depth, batch occupancy, sheds)
+        # shares this surface so one scrape covers dataflow AND serving
+        try:
+            from ..serve.metrics import render_prometheus_lines
+
+            lines.extend(render_prometheus_lines())
+        except Exception:
+            pass
         return "\n".join(lines) + "\n"
 
     def render_dashboard(self) -> str:
@@ -59,6 +67,26 @@ class MetricsServer:
             f"<td>{op.rows_out}</td></tr>"
             for op in self.scheduler.operators
         )
+        serve_html = ""
+        try:
+            from ..serve.metrics import all_stats
+
+            snaps = [s.snapshot() for s in all_stats()]
+        except Exception:
+            snaps = []
+        if snaps:
+            serve_rows = "".join(
+                f"<tr><td>{s['name']}</td><td>{s['queue_depth']}</td>"
+                f"<td>{s['batch_occupancy_avg']:.2f}</td>"
+                f"<td>{s['completed']}</td>"
+                f"<td>{sum(s['shed'].values())}</td></tr>"
+                for s in snaps
+            )
+            serve_html = (
+                "<h3>serving</h3><table><tr><th>scheduler</th>"
+                "<th>queue</th><th>occupancy</th><th>done</th>"
+                f"<th>shed</th></tr>{serve_rows}</table>"
+            )
         return (
             "<html><head><title>pathway-tpu</title>"
             '<meta http-equiv="refresh" content="2">'
@@ -69,6 +97,7 @@ class MetricsServer:
             f"&middot; uptime={time.time() - self.started_at:.0f}s</h2>"
             "<table><tr><th>operator</th><th>id</th><th>rows in</th>"
             f"<th>rows out</th></tr>{rows}</table>"
+            f"{serve_html}"
             '<p><a href="/metrics">/metrics</a></p></body></html>'
         )
 
@@ -402,20 +431,36 @@ def otlp_export_metrics(endpoint: str, scheduler) -> None:
                     {"key": "direction", "value": {"stringValue": direction}},
                 ],
             })
+    metrics = [{
+        "name": "pathway.operator.rows",
+        "sum": {
+            "aggregationTemporality": 2,  # CUMULATIVE
+            "isMonotonic": True,
+            "dataPoints": points,
+        },
+    }]
+    try:
+        from ..serve.metrics import otlp_points
+
+        serve_points = otlp_points(now)
+    except Exception:
+        serve_points = []
+    if serve_points:
+        metrics.append({
+            "name": "pathway.serve.requests",
+            "sum": {
+                "aggregationTemporality": 2,  # CUMULATIVE
+                "isMonotonic": True,
+                "dataPoints": serve_points,
+            },
+        })
     _post_json(
         endpoint.rstrip("/") + "/v1/metrics",
         {"resourceMetrics": [{
             "resource": _RESOURCE,
             "scopeMetrics": [{
                 "scope": {"name": "pathway_tpu"},
-                "metrics": [{
-                    "name": "pathway.operator.rows",
-                    "sum": {
-                        "aggregationTemporality": 2,  # CUMULATIVE
-                        "isMonotonic": True,
-                        "dataPoints": points,
-                    },
-                }],
+                "metrics": metrics,
             }],
         }]},
     )

@@ -31,7 +31,14 @@ def sql(query: str, **tables: Table) -> Table:
     Native mini-transpiler: SELECT/WHERE/GROUP BY/HAVING/JOIN, UNION
     [ALL]/INTERSECT/EXCEPT, subqueries in FROM, WITH CTEs, CASE WHEN,
     BETWEEN, [NOT] IN lists, and the scalar functions IF/COALESCE/IFNULL/
-    ABS/ROUND/LOWER/UPPER/LENGTH/CONCAT."""
+    ABS/ROUND/LOWER/UPPER/LENGTH/CONCAT.
+
+    Dialect notes: ``ROUND`` rounds halves AWAY FROM ZERO (the
+    MySQL/Postgres/SQLite convention — ``ROUND(2.5) = 3``), not Python's
+    banker's rounding.  ``CONCAT`` treats NULL arguments as the empty
+    string (the MySQL ``CONCAT_WS``-style lenient policy) rather than
+    propagating NULL; wrap arguments in ``NULLIF``/``IF`` if NULL
+    propagation is wanted."""
     q = query.strip().rstrip(";")
     q, tables = _extract_ctes(q, dict(tables))
     return _sql_query(q, tables)
@@ -508,6 +515,22 @@ def _scalar_fn(py_fn, ret_type):
     return lifted
 
 
+def _sql_round(v, nd=0):
+    """SQL ROUND: half away from zero (MySQL/Postgres/SQLite behavior),
+    NOT Python's banker's rounding — round(2.5)=2 in Python but SQL says 3.
+    Decimal-based so scaling artifacts (2.675*100 = 267.4999…) don't flip
+    the tie direction."""
+    if v is None:
+        return None
+    from decimal import ROUND_HALF_UP, Decimal
+
+    nd = int(nd)
+    q = Decimal(str(v)).quantize(Decimal(1).scaleb(-nd), rounding=ROUND_HALF_UP)
+    if isinstance(v, int) and nd <= 0:
+        return int(q)
+    return float(q)
+
+
 def _make_sql_funcs():
     from .. import coalesce as _coalesce, if_else as _if_else
     from . import dtype as _dt
@@ -519,10 +542,7 @@ def _make_sql_funcs():
         "NULLIF": _scalar_fn(lambda a, b: None if a == b else a, _dt.ANY),
         "ABS": _scalar_fn(lambda v: abs(v) if v is not None else None,
                           _dt.ANY),
-        "ROUND": _scalar_fn(
-            lambda v, nd=0: round(v, int(nd)) if v is not None else None,
-            _dt.ANY,
-        ),
+        "ROUND": _scalar_fn(_sql_round, _dt.ANY),
         "LOWER": _scalar_fn(lambda v: v.lower() if v is not None else None,
                             _dt.STR),
         "UPPER": _scalar_fn(lambda v: v.upper() if v is not None else None,

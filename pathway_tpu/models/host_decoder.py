@@ -172,3 +172,33 @@ class Int8DecoderHost:
                 break
             tok = int(np.argmax(self.decode_step(tok)))
         return out
+
+    # -- serving -----------------------------------------------------------
+
+    def serving_executor(self, **kwargs):
+        """Single shared executor for this decode tier (serve/scheduler.py).
+
+        The KV cache (`self._K/_V/n_past`) is mutable per-instance state, so
+        concurrent `generate` callers would interleave prefill/decode steps
+        and corrupt each other; the executor serializes device access
+        (max_batch_size=1) while still providing priority classes, deadline
+        shedding, bounded queueing and backpressure metrics — a shared
+        executor instead of per-call dispatch."""
+        sched = getattr(self, "_serve_executor", None)
+        if sched is None or sched._closed:
+            from ..serve.scheduler import RequestScheduler
+
+            kwargs.setdefault("name", "host_decoder")
+            kwargs.setdefault("max_queue", 64)
+            self._serve_executor = sched = RequestScheduler(
+                lambda reqs: [self.generate(p, n) for p, n in reqs],
+                max_batch_size=1, batch_linger_ms=0.0, **kwargs,
+            )
+        return sched
+
+    def generate_scheduled(self, prompt_ids, n_new: int,
+                           **submit_kwargs) -> list[int]:
+        """`generate` routed through the shared serving executor."""
+        return self.serving_executor().submit(
+            (list(prompt_ids), int(n_new)), **submit_kwargs
+        )

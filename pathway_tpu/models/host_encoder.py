@@ -401,7 +401,8 @@ class CompiledQueryEncoder:
     (cosine; bf16 rounding bounds the gap)."""
 
     def __init__(self, cfg, params, tokenizer,
-                 buckets=(16, 32, 48, 64, 96, 128), mode: str = "compile"):
+                 buckets=(16, 32, 48, 64, 96, 128), mode: str = "compile",
+                 set_torch_threads: bool = False):
         import torch
 
         self._torch = torch
@@ -411,7 +412,11 @@ class CompiledQueryEncoder:
             cfg.max_len,
         )
         self.mode = mode
-        torch.set_num_threads(max(1, (os.cpu_count() or 1)))
+        if set_torch_threads:
+            # opt-in only (ADVICE r5): set_num_threads is process-wide and
+            # must not clobber other torch users' pools — same policy as
+            # Int8DecoderHost, which never touches it
+            torch.set_num_threads(max(1, (os.cpu_count() or 1)))
         p = _np_params(params)
         bf16 = torch.bfloat16
 
@@ -448,6 +453,7 @@ class CompiledQueryEncoder:
         self._fns: dict = {}
         self._compiling: set = set()
         self._threads: dict = {}
+        self._serve_scheduler = None
 
     @property
     def dimensions(self) -> int:
@@ -600,6 +606,26 @@ class CompiledQueryEncoder:
 
     def __call__(self, text: str) -> np.ndarray:
         return self.embed(text)
+
+    def serving_scheduler(self, **kwargs):
+        """Single shared executor for this latency tier (serve/scheduler.py):
+        concurrent serving threads queue through ONE worker — priority,
+        deadline shedding and backpressure metrics included — instead of
+        each dispatching its own forward (and fighting over the BLAS/AMX
+        thread pool)."""
+        if self._serve_scheduler is None or self._serve_scheduler._closed:
+            from ..serve.scheduler import RequestScheduler
+
+            kwargs.setdefault("name", "host_encoder")
+            kwargs.setdefault("max_batch_size", 16)
+            kwargs.setdefault("batch_linger_ms", 1.0)
+            self._serve_scheduler = RequestScheduler(
+                lambda texts: [self.embed(t) for t in texts], **kwargs
+            )
+        return self._serve_scheduler
+
+    def embed_scheduled(self, text: str, **submit_kwargs) -> np.ndarray:
+        return self.serving_scheduler().submit(text, **submit_kwargs)
 
 
 def make_host_mirror(cfg, params, tokenizer):

@@ -15,3 +15,19 @@ def pad_to(x: jax.Array, axis: int, multiple: int) -> jax.Array:
     pad = [(0, 0)] * x.ndim
     pad[axis] = (0, rem)
     return jnp.pad(x, pad)
+
+
+def bucket_for(n: int, buckets) -> int:
+    """Smallest bucket >= n (the largest bucket when none fits).
+
+    The shared bucketing idiom: padding batch sizes to a fixed ladder keeps
+    the number of distinct jit/pallas program shapes bounded, so a serving
+    process compiles each shape once instead of per request-count.
+    """
+    buckets = sorted(int(b) for b in buckets)
+    if not buckets:
+        return n
+    for b in buckets:
+        if b >= n:
+            return b
+    return buckets[-1]

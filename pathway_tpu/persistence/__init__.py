@@ -659,6 +659,47 @@ def _compact_events(events: list) -> list:
     return out
 
 
+_implicit_rerun_warned: set[str] = set()
+
+
+def _warn_implicit_rerun_default(source) -> None:
+    """One-time heads-up (ADVICE r5): the `deterministic_rerun` default
+    flipped True -> False in r5, which silently changes exactly-once replay
+    semantics for pre-existing ConnectorSubject subclasses.  A persisted
+    subject that neither implements seek()/get_offsets() nor sets
+    deterministic_rerun explicitly now gets duplicate-on-restart instead of
+    prefix-skip — visible loss-safety win, but worth one log line."""
+    subject = getattr(source, "subject", None)
+    if subject is None or not source.is_live():
+        return
+    if getattr(subject, "seek", None) is not None:
+        return
+    from ..io.python import ConnectorSubject as _Base
+
+    cls = type(subject)
+    explicit = any(
+        "deterministic_rerun" in vars(k)
+        for k in cls.__mro__
+        if k is not _Base and k is not object
+    )
+    if explicit:
+        return
+    label = f"{cls.__module__}.{cls.__qualname__}"
+    if label in _implicit_rerun_warned:
+        return
+    _implicit_rerun_warned.add(label)
+    import logging
+
+    logging.getLogger("pathway_tpu.persistence").warning(
+        "persisted subject %s relies on the deterministic_rerun DEFAULT, "
+        "which flipped True -> False: restarts now re-ingest any events "
+        "the subject re-emits (duplicates) instead of skipping the "
+        "journaled prefix (which could silently drop fresh events).  Set "
+        "deterministic_rerun explicitly or implement seek() to choose.",
+        label,
+    )
+
+
 def _wrap_source_with_persistence(source, backend: Backend, stream: str,
                                   replayed: list, last_offsets,
                                   owns_event=None,
@@ -759,6 +800,8 @@ def _wrap_source_with_persistence(source, backend: Backend, stream: str,
         skip_counts = Counter(e[1] for e in replayed)
         if folded_counts:
             skip_counts.update(folded_counts)
+
+    _warn_implicit_rerun_default(source)
 
     warned = [False]
 

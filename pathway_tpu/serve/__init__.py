@@ -1,0 +1,50 @@
+"""pathway_tpu.serve — continuous-batching request scheduler for the
+serving path.
+
+Three pieces turn the existing kernels and model tiers into a servable
+stack (ISSUE 1):
+
+- :class:`RequestScheduler` — priority classes, per-request deadlines,
+  continuous batch formation: concurrent embed/retrieve/generate calls
+  coalesce into padded, bucketed batches so one device call serves many
+  callers.
+- :class:`AdmissionController` — bounded queues with a configurable
+  overflow policy (block / shed with 429 + Retry-After / degrade to a
+  cheaper tier) and a token-bucket rate limiter per priority class.
+- :mod:`pathway_tpu.serve.metrics` — queue depth, batch occupancy,
+  time-in-queue and shed/deadline-miss counters, exported through the
+  engine's existing Prometheus/OTLP surface (engine/telemetry.py).
+"""
+
+from __future__ import annotations
+
+from .admission import (
+    AdmissionController,
+    AdmissionPolicy,
+    DeadlineExceededError,
+    Priority,
+    QueueFullError,
+    RateLimitedError,
+    SchedulerClosedError,
+    ShedError,
+    TokenBucket,
+)
+from .metrics import ServeStats, render_prometheus_lines, serve_stats
+from .scheduler import RequestScheduler, shared_scheduler
+
+__all__ = [
+    "AdmissionController",
+    "AdmissionPolicy",
+    "DeadlineExceededError",
+    "Priority",
+    "QueueFullError",
+    "RateLimitedError",
+    "RequestScheduler",
+    "SchedulerClosedError",
+    "ServeStats",
+    "ShedError",
+    "TokenBucket",
+    "render_prometheus_lines",
+    "serve_stats",
+    "shared_scheduler",
+]

@@ -1,0 +1,212 @@
+"""Backpressure observability for the serving path.
+
+One :class:`ServeStats` per scheduler/admission-controller registers into a
+process-global table; `render_prometheus_lines()` is appended to the
+engine's existing ``/metrics`` payload (engine/telemetry.py MetricsServer)
+and `otlp_points()` to its OTLP push, so serving backpressure shows up on
+the same surface as the dataflow counters.
+
+Metric names (Prometheus):
+
+- ``pathway_serve_queue_depth{scheduler}``           gauge
+- ``pathway_serve_admitted_total{scheduler}``        counter
+- ``pathway_serve_completed_total{scheduler}``       counter
+- ``pathway_serve_shed_total{scheduler,reason}``     counter
+  (reasons: ``queue_full``, ``deadline``, ``timeout``, ``rate_limit``,
+  ``closed``)
+- ``pathway_serve_degraded_total{scheduler}``        counter
+- ``pathway_serve_deadline_miss_total{scheduler}``   counter
+- ``pathway_serve_batches_total{scheduler}``         counter (device calls)
+- ``pathway_serve_batched_requests_total{scheduler}``counter
+- ``pathway_serve_batch_occupancy_avg{scheduler}``   gauge (req / device call)
+- ``pathway_serve_time_in_queue_seconds_total{scheduler}`` counter (+ sum
+  form usable with ``batched_requests_total`` as the count)
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import Counter
+
+_SHED_REASONS = ("queue_full", "deadline", "timeout", "rate_limit", "closed")
+
+
+class ServeStats:
+    """Thread-safe counter block for one scheduler / admission controller."""
+
+    def __init__(self, name: str, depth_fn=None):
+        self.name = name
+        self._lock = threading.Lock()
+        self._depth_fn = depth_fn
+        self.admitted = 0
+        self.completed = 0
+        self.degraded = 0
+        self.deadline_miss = 0
+        self.shed: Counter = Counter()
+        self.batches = 0
+        self.batched_requests = 0
+        self.time_in_queue_s = 0.0
+
+    # -- recording ---------------------------------------------------------
+    def record_admitted(self, n: int = 1) -> None:
+        with self._lock:
+            self.admitted += n
+
+    def record_completed(self, n: int = 1) -> None:
+        with self._lock:
+            self.completed += n
+
+    def record_degraded(self, n: int = 1) -> None:
+        with self._lock:
+            self.degraded += n
+
+    def record_shed(self, reason: str, n: int = 1) -> None:
+        with self._lock:
+            self.shed[reason] += n
+            if reason == "deadline":
+                self.deadline_miss += n
+
+    def record_batch(self, occupancy: int, time_in_queue_s: float = 0.0) -> None:
+        """One device/tier call serving `occupancy` coalesced requests."""
+        with self._lock:
+            self.batches += 1
+            self.batched_requests += occupancy
+            self.time_in_queue_s += time_in_queue_s
+
+    # -- reading -----------------------------------------------------------
+    @property
+    def queue_depth(self) -> int:
+        if self._depth_fn is None:
+            return 0
+        try:
+            return int(self._depth_fn())
+        except Exception:
+            return 0
+
+    @property
+    def shed_total(self) -> int:
+        return sum(self.shed.values())
+
+    @property
+    def batch_occupancy_avg(self) -> float:
+        return self.batched_requests / self.batches if self.batches else 0.0
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "name": self.name,
+                "queue_depth": self.queue_depth,
+                "admitted": self.admitted,
+                "completed": self.completed,
+                "degraded": self.degraded,
+                "shed": dict(self.shed),
+                "deadline_miss": self.deadline_miss,
+                "batches": self.batches,
+                "batched_requests": self.batched_requests,
+                "batch_occupancy_avg": self.batch_occupancy_avg,
+                "time_in_queue_s": self.time_in_queue_s,
+            }
+
+
+_registry: dict[str, ServeStats] = {}
+_registry_lock = threading.Lock()
+
+
+def serve_stats(name: str, depth_fn=None) -> ServeStats:
+    """Get-or-create the stats block for `name` (stable across restarts of
+    the owning scheduler, so counters stay monotonic within a process)."""
+    with _registry_lock:
+        stats = _registry.get(name)
+        if stats is None:
+            stats = _registry[name] = ServeStats(name, depth_fn)
+        elif depth_fn is not None:
+            stats._depth_fn = depth_fn
+        return stats
+
+
+def all_stats() -> list[ServeStats]:
+    with _registry_lock:
+        return list(_registry.values())
+
+
+def reset_registry() -> None:
+    """Test hook: drop all registered stats blocks."""
+    with _registry_lock:
+        _registry.clear()
+
+
+def render_prometheus_lines() -> list[str]:
+    """Prometheus text-format lines, appended to MetricsServer.render()."""
+    stats = all_stats()
+    if not stats:
+        return []
+    lines = [
+        "# TYPE pathway_serve_queue_depth gauge",
+        "# TYPE pathway_serve_admitted_total counter",
+        "# TYPE pathway_serve_completed_total counter",
+        "# TYPE pathway_serve_shed_total counter",
+        "# TYPE pathway_serve_degraded_total counter",
+        "# TYPE pathway_serve_deadline_miss_total counter",
+        "# TYPE pathway_serve_batches_total counter",
+        "# TYPE pathway_serve_batched_requests_total counter",
+        "# TYPE pathway_serve_batch_occupancy_avg gauge",
+        "# TYPE pathway_serve_time_in_queue_seconds_total counter",
+    ]
+    for s in stats:
+        snap = s.snapshot()
+        lbl = f'scheduler="{s.name}"'
+        lines.append(f"pathway_serve_queue_depth{{{lbl}}} {snap['queue_depth']}")
+        lines.append(f"pathway_serve_admitted_total{{{lbl}}} {snap['admitted']}")
+        lines.append(f"pathway_serve_completed_total{{{lbl}}} {snap['completed']}")
+        for reason in _SHED_REASONS:
+            lines.append(
+                f"pathway_serve_shed_total{{{lbl},reason=\"{reason}\"}} "
+                f"{snap['shed'].get(reason, 0)}"
+            )
+        lines.append(f"pathway_serve_degraded_total{{{lbl}}} {snap['degraded']}")
+        lines.append(
+            f"pathway_serve_deadline_miss_total{{{lbl}}} {snap['deadline_miss']}"
+        )
+        lines.append(f"pathway_serve_batches_total{{{lbl}}} {snap['batches']}")
+        lines.append(
+            f"pathway_serve_batched_requests_total{{{lbl}}} "
+            f"{snap['batched_requests']}"
+        )
+        lines.append(
+            f"pathway_serve_batch_occupancy_avg{{{lbl}}} "
+            f"{snap['batch_occupancy_avg']:.3f}"
+        )
+        lines.append(
+            f"pathway_serve_time_in_queue_seconds_total{{{lbl}}} "
+            f"{snap['time_in_queue_s']:.6f}"
+        )
+    return lines
+
+
+def otlp_points(now_ns: str) -> list[dict]:
+    """Serve counters as OTLP sum data points (merged into the engine's
+    otlp_export_metrics push)."""
+    points = []
+    for s in all_stats():
+        snap = s.snapshot()
+        for key in ("admitted", "completed", "degraded", "batches",
+                    "batched_requests", "deadline_miss"):
+            points.append({
+                "asInt": str(snap[key]),
+                "timeUnixNano": now_ns,
+                "attributes": [
+                    {"key": "scheduler", "value": {"stringValue": s.name}},
+                    {"key": "counter", "value": {"stringValue": key}},
+                ],
+            })
+        for reason, val in snap["shed"].items():
+            points.append({
+                "asInt": str(val),
+                "timeUnixNano": now_ns,
+                "attributes": [
+                    {"key": "scheduler", "value": {"stringValue": s.name}},
+                    {"key": "counter", "value": {"stringValue": "shed"}},
+                    {"key": "reason", "value": {"stringValue": reason}},
+                ],
+            })
+    return points

@@ -115,6 +115,18 @@ class ExternalIndexOperator(DiffOutputOperator):
                 answers = self._answer_batch(pending_inserts)
             else:
                 answers = [self._answer(k, r) for k, r in pending_inserts]
+            # backpressure observability: how many concurrent queries each
+            # index pass actually served (serve/metrics.py; the engine-side
+            # counterpart of the REST scheduler's batch occupancy)
+            try:
+                from ...serve.metrics import serve_stats
+
+                stats = serve_stats(f"index:{self.name}")
+                stats.record_admitted(len(pending_inserts))
+                stats.record_batch(len(pending_inserts))
+                stats.record_completed(len(pending_inserts))
+            except Exception:
+                pass
             for (key, _row), ans in zip(pending_inserts, answers):
                 out.append((key, ans, 1))
                 self.emitted[key] = ans

@@ -50,7 +50,8 @@ class SentenceTransformerEmbedder(BaseEmbedder):
     def __init__(self, model: str | None = None, *, config=None, seed: int = 0,
                  call_kwargs: dict | None = None, device: str = "tpu",
                  cache_strategy: CacheStrategy | None = None,
-                 device_resident: bool | None = None):
+                 device_resident: bool | None = None,
+                 batch_scheduler=None):
         from ...models.encoder import EncoderConfig, JaxEncoder
 
         import os
@@ -71,16 +72,38 @@ class SentenceTransformerEmbedder(BaseEmbedder):
 
             device_resident = jax.default_backend() == "tpu"
         self.device_resident = device_resident
+        # continuous-batching tier (serve/scheduler.py): single-embed calls
+        # from concurrent serving threads coalesce into ONE bucketed device
+        # batch instead of one dispatch per caller.  Pass True for a
+        # default scheduler, or a configured RequestScheduler.
+        self._scheduler = None
+        if batch_scheduler:
+            from ...serve.scheduler import RequestScheduler
+
+            if batch_scheduler is True:
+                batch_scheduler = RequestScheduler(
+                    self._embed_many,
+                    name=f"embed:{self.model_name}",
+                    max_batch_size=64,
+                    batch_linger_ms=3.0,
+                    size_buckets=(1, 2, 4, 8, 16, 32, 64),
+                )
+            self._scheduler = batch_scheduler
         if cache_strategy is not None:
             self._embed = with_cache_strategy(  # type: ignore[method-assign]
-                self._embed_uncached, cache_strategy, f"emb:{self.model_name}"
+                self._embed_one, cache_strategy, f"emb:{self.model_name}"
             )
 
     def _embed_uncached(self, text: str) -> np.ndarray:
         return self._enc.embed(text or "")
 
-    def _embed(self, text: str) -> np.ndarray:
+    def _embed_one(self, text: str) -> np.ndarray:
+        if self._scheduler is not None:
+            return self._scheduler.submit(text or "")
         return self._embed_uncached(text)
+
+    def _embed(self, text: str) -> np.ndarray:
+        return self._embed_one(text)
 
     def _embed_many(self, texts: list[str]) -> list:
         texts = [t or "" for t in texts]

@@ -100,10 +100,32 @@ class BaseRAGQuestionAnswerer:
         default_llm_name: str | None = None,
         prompt_template: str | Callable[[list[str], str], str] | None = None,
         search_topk: int = 6,
+        llm_scheduler=None,
     ):
         self.llm = llm
         self.indexer = indexer
         self.search_topk = search_topk
+        # generation tier scheduling (serve/scheduler.py): concurrent answer
+        # requests queue through ONE executor with priority/deadline/
+        # admission semantics instead of dispatching per call.  When the llm
+        # exposes a batch entry point (`generate_batch` or `batch`), a whole
+        # coalesced batch is answered in one tier call.
+        self._llm_scheduler = None
+        if llm_scheduler:
+            from ...serve.scheduler import RequestScheduler
+
+            if llm_scheduler is True:
+                batch = getattr(llm, "generate_batch", None) or getattr(
+                    llm, "batch", None
+                )
+                batch_fn = batch if callable(batch) else (
+                    lambda items: [llm(i) for i in items]
+                )
+                llm_scheduler = RequestScheduler(
+                    batch_fn, name="llm", max_batch_size=8,
+                    batch_linger_ms=5.0,
+                )
+            self._llm_scheduler = llm_scheduler
         if isinstance(prompt_template, str):
             tmpl = prompt_template
 
@@ -114,6 +136,11 @@ class BaseRAGQuestionAnswerer:
         else:
             self.prompt_fn = prompt_template or _prompt
 
+    def _call_llm(self, messages: list[dict]) -> str:
+        if self._llm_scheduler is not None:
+            return self._llm_scheduler.submit(messages)
+        return self.llm(messages)
+
     def answer_query(self, prompt_queries: Table) -> Table:
         q = prompt_queries
         reply = self.indexer.index.query_as_of_now(
@@ -122,7 +149,7 @@ class BaseRAGQuestionAnswerer:
 
         def run(prompt, docs):
             doc_texts = [d for d in (docs or ())]
-            return self.llm(
+            return self._call_llm(
                 [{"role": "user", "content": self.prompt_fn(doc_texts, prompt)}]
             )
 
@@ -137,7 +164,7 @@ class BaseRAGQuestionAnswerer:
 
         def run(texts):
             joined = "\n\n".join(texts or ())
-            return self.llm(
+            return self._call_llm(
                 [{"role": "user", "content": f"Summarize the following:\n\n{joined}"}]
             )
 

@@ -1,4 +1,13 @@
-"""REST servers for RAG apps (reference: xpacks/llm/servers.py:92-250)."""
+"""REST servers for RAG apps (reference: xpacks/llm/servers.py:92-250).
+
+Serving-at-scale wiring (serve/ subsystem): every server accepts an
+``admission`` argument — an AdmissionController or a kwargs dict — that
+bounds how many requests may be pending in the engine at once, rate-limits
+per priority class (header ``X-Pathway-Priority``), and sheds overflow with
+``429`` + ``Retry-After`` instead of queueing unboundedly.  Backpressure
+counters (queue depth, sheds, completions) export through the engine's
+``/metrics`` endpoint (engine/telemetry.py + serve/metrics.py).
+"""
 
 from __future__ import annotations
 
@@ -11,16 +20,54 @@ from ...internals.table import Table
 from ...io.http import PathwayWebserver, rest_connector
 
 
+def _make_admission(admission, name: str):
+    """None | AdmissionController | dict -> AdmissionController | None."""
+    if admission is None:
+        return None
+    from ...serve.admission import AdmissionController
+
+    if isinstance(admission, AdmissionController):
+        return admission
+    if isinstance(admission, dict):
+        kwargs = dict(admission)
+        kwargs.setdefault("name", name)
+        return AdmissionController(**kwargs)
+    raise TypeError(
+        "admission must be an AdmissionController or a kwargs dict, "
+        f"got {type(admission).__name__}"
+    )
+
+
 class BaseRestServer:
-    def __init__(self, host: str, port: int, **kwargs):
+    """Shared REST host.
+
+    Args:
+        host, port: bind address.
+        admission: optional admission control shared by every route of this
+            server (AdmissionController instance or kwargs dict, e.g.
+            ``{"max_pending": 32, "policy": "shed"}``).
+        degrade_handler: optional ``(payload, meta) -> response`` cheap tier
+            used for over-capacity requests instead of shedding them.
+    """
+
+    def __init__(self, host: str, port: int, *, admission=None,
+                 degrade_handler: Callable | None = None, **kwargs):
         self.webserver = PathwayWebserver(host=host, port=port,
                                           with_cors=kwargs.get("with_cors", False))
+        self.admission = _make_admission(
+            admission, name=f"rest:{host}:{port}"
+        )
+        self.degrade_handler = degrade_handler
 
     def serve(self, route: str, schema: SchemaMetaclass,
               handler: Callable[[Table], Table], **kwargs) -> None:
         queries, writer = rest_connector(
             webserver=self.webserver, route=route, schema=schema,
             delete_completed_queries=True,
+            admission_controller=kwargs.pop("admission_controller",
+                                            self.admission),
+            degrade_handler=kwargs.pop("degrade_handler",
+                                       self.degrade_handler),
         )
         writer(handler(queries))
 
@@ -93,7 +140,8 @@ class DocumentStoreServer(BaseRestServer):
 
 def serve_callable(route: str, schema: SchemaMetaclass | None = None, *,
                    host: str = "0.0.0.0", port: int = 8080,
-                   webserver: PathwayWebserver | None = None, **kwargs):
+                   webserver: PathwayWebserver | None = None,
+                   admission=None, **kwargs):
     """Serve a python callable behind a REST route (reference: servers.py:250)."""
 
     def wrap(fn: Callable):
@@ -111,8 +159,13 @@ def serve_callable(route: str, schema: SchemaMetaclass | None = None, *,
             ]
             schema = schema_from_types(**{p: Any for p in params})
         ws = webserver or PathwayWebserver(host=host, port=port)
-        queries, writer = rest_connector(webserver=ws, route=route, schema=schema,
-                                         delete_completed_queries=True)
+        queries, writer = rest_connector(
+            webserver=ws, route=route, schema=schema,
+            delete_completed_queries=True,
+            admission_controller=_make_admission(
+                admission, name=f"rest:{route}"
+            ),
+        )
         cols = [queries[c] for c in schema.column_names()]
         writer(queries.select(result=apply_with_type(fn, dt.ANY, *cols)))
         return fn

@@ -1,0 +1,229 @@
+"""Regression tests for the round-5 advisor findings (ADVICE.md r5,
+fixed alongside the ISSUE 1 serve/ subsystem):
+
+1. (med) SQL ROUND rounds halves AWAY FROM ZERO (the MySQL/Postgres/
+   SQLite convention), not Python's banker's rounding; the `sql()`
+   docstring documents the dialect (incl. CONCAT NULL -> '').
+2. (low) CompiledQueryEncoder must not clobber other torch users'
+   process-wide thread pool: `torch.set_num_threads` is opt-in via
+   `set_torch_threads=True`, matching the Int8DecoderHost policy.
+3. (low) pw.io.http.read with flush_trailing=False: an IDENTICAL
+   unterminated trailing buffer across 3 consecutive retries is a stable
+   tail from a well-behaved endpoint — delivered as the final record
+   instead of burning the whole retry budget re-reading it.
+4. (low) the deterministic_rerun default flip (True -> False, r5) gets a
+   one-time warning when a persisted subject relies on the default
+   (neither seek() nor an explicit class-level setting).
+"""
+
+from __future__ import annotations
+
+import http.server
+import logging
+import threading
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.debug import table_from_markdown
+from pathway_tpu.internals import parse_graph as pg
+
+from .utils import run_and_squash
+
+
+# ---------------------------------------------------------------------------
+# 1. SQL ROUND: half away from zero
+
+
+def test_sql_round_half_away_from_zero_unit():
+    from pathway_tpu.internals.sql import _sql_round
+
+    assert _sql_round(2.5) == 3
+    assert _sql_round(3.5) == 4
+    assert _sql_round(-2.5) == -3
+    assert _sql_round(-0.5) == -1
+    # Decimal-based: the float artifact 2.675*100 = 267.4999... must not
+    # flip the tie downward
+    assert _sql_round(2.675, 2) == 2.68
+    assert _sql_round(None) is None
+    assert _sql_round(5) == 5 and isinstance(_sql_round(5), int)
+
+
+def test_sql_round_half_away_from_zero_query():
+    t = table_from_markdown(
+        """
+        | v
+      1 | 0.5
+      2 | 1.5
+      3 | 2.5
+        """
+    )
+    out = pw.sql("SELECT ROUND(v) AS r FROM tab", tab=t)
+    state = run_and_squash(out)
+    # banker's rounding would give [0, 2, 2]
+    assert sorted(r[0] for r in state.values()) == [1, 2, 3]
+
+
+def test_sql_docstring_documents_dialect():
+    doc = pw.sql.__doc__
+    assert "AWAY FROM ZERO" in doc
+    assert "CONCAT" in doc and "NULL" in doc
+
+
+# ---------------------------------------------------------------------------
+# 2. CompiledQueryEncoder thread-pool policy
+
+
+def test_compiled_query_encoder_does_not_clobber_torch_threads():
+    torch = pytest.importorskip("torch")
+    from pathway_tpu.models.encoder import EncoderConfig, JaxEncoder
+    from pathway_tpu.models.host_encoder import CompiledQueryEncoder
+
+    enc = JaxEncoder(EncoderConfig(max_len=32, vocab_size=512, d_model=16,
+                                   n_layers=1, n_heads=2, d_ff=32),
+                     seq_buckets=(16,), batch_buckets=(1,))
+    before = torch.get_num_threads()
+    try:
+        torch.set_num_threads(1)
+        cq = CompiledQueryEncoder(enc.cfg, enc.params, enc.tokenizer,
+                                  mode="eager")
+        assert cq is not None
+        assert torch.get_num_threads() == 1  # untouched by default
+        import os
+
+        CompiledQueryEncoder(enc.cfg, enc.params, enc.tokenizer,
+                             mode="eager", set_torch_threads=True)
+        assert torch.get_num_threads() == max(1, (os.cpu_count() or 1))
+    finally:
+        torch.set_num_threads(before)
+
+
+# ---------------------------------------------------------------------------
+# 3. http.read: stable unterminated tail across retries
+
+
+class _StreamHandler(http.server.BaseHTTPRequestHandler):
+    payload: bytes = b""
+
+    def do_GET(self):
+        self.send_response(200)
+        # NO Content-Length: chunked-ish stream, then hard close
+        self.send_header("Connection", "close")
+        self.end_headers()
+        self.wfile.write(self.payload)
+
+    def log_message(self, *args):
+        pass
+
+
+def _serve(payload: bytes):
+    handler = type("H", (_StreamHandler,), {"payload": payload})
+    srv = http.server.HTTPServer(("127.0.0.1", 0), handler)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    return srv, srv.server_address[1]
+
+
+def _collect(url: str, **read_kwargs):
+    class S(pw.Schema):
+        v: int
+
+    pg.G.clear()
+    t = pw.io.http.read(url, schema=S, **read_kwargs)
+    got = []
+    pw.io.subscribe(t, on_change=lambda key, row, time, is_addition:
+                    got.append(row["v"]))
+    pw.run(idle_stop_s=1.5, monitoring_level=pw.MonitoringLevel.NONE)
+    return got
+
+
+def test_http_read_stable_tail_delivered_after_3_identical_retries(caplog):
+    # the final record is COMPLETE, just missing the trailing delimiter —
+    # the server returns the identical bytes on every retry
+    srv, port = _serve(b'{"v": 1}\n{"v": 2}\n{"v": 3}')
+    try:
+        with caplog.at_level(logging.INFO, "pathway_tpu.io.http"):
+            got = _collect(
+                f"http://127.0.0.1:{port}/", n_retries=5,
+                retry_policy=pw.io.http.RetryPolicy(first_delay_ms=10,
+                                                    backoff_factor=1.0),
+            )
+        # the stable tail IS delivered (without flush_trailing)...
+        assert sorted(set(got)) == [1, 2, 3]
+        # ...after the distinct mid-message log line fired on the way
+        msgs = [r.getMessage() for r in caplog.records]
+        assert any("connection ended mid-message" in m for m in msgs)
+        assert any("delivering it as the final record" in m for m in msgs)
+    finally:
+        srv.shutdown()
+
+
+@pytest.mark.filterwarnings(
+    "ignore::pytest.PytestUnhandledThreadExceptionWarning"
+)
+def test_http_read_changing_tail_still_retries_to_failure():
+    # a truncated tail that differs per attempt must NOT be delivered
+    # (stable-tail detection requires 3 IDENTICAL reads)
+    counter = {"n": 0}
+
+    class _Growing(_StreamHandler):
+        def do_GET(self):
+            counter["n"] += 1
+            self.payload = b'{"v": 1}\n{"v": 2' + b"0" * counter["n"]
+            super().do_GET()
+
+    srv = http.server.HTTPServer(("127.0.0.1", 0), _Growing)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    port = srv.server_address[1]
+    try:
+        got = _collect(
+            f"http://127.0.0.1:{port}/", n_retries=3,
+            retry_policy=pw.io.http.RetryPolicy(first_delay_ms=10,
+                                                backoff_factor=1.0),
+        )
+        assert 1 in got
+        assert all(v == 1 for v in got)  # no truncated tail delivered
+    finally:
+        srv.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# 4. one-time warning for implicit deterministic_rerun under persistence
+
+
+def test_persisted_subject_warns_on_implicit_rerun_default(tmp_path, caplog):
+    backend = pw.persistence.Backend.filesystem(str(tmp_path / "p"))
+
+    class ImplicitSub(pw.io.python.ConnectorSubject):
+        def run(self):
+            self.next(v=1)
+            self.close()
+
+    pg.G.clear()
+    t = pw.io.python.read(ImplicitSub(), schema=pw.schema_from_types(v=int))
+    pw.io.subscribe(t, on_change=lambda **kw: None)
+    with caplog.at_level(logging.WARNING, "pathway_tpu.persistence"):
+        pw.run(persistence_config=pw.persistence.Config(backend),
+               timeout_s=5.0, monitoring_level=pw.MonitoringLevel.NONE)
+    assert any("deterministic_rerun DEFAULT" in r.getMessage()
+               for r in caplog.records)
+
+
+def test_persisted_subject_with_explicit_setting_does_not_warn(tmp_path,
+                                                               caplog):
+    backend = pw.persistence.Backend.filesystem(str(tmp_path / "p2"))
+
+    class ExplicitSub(pw.io.python.ConnectorSubject):
+        deterministic_rerun = False  # explicit choice, even if == default
+
+        def run(self):
+            self.next(v=1)
+            self.close()
+
+    pg.G.clear()
+    t = pw.io.python.read(ExplicitSub(), schema=pw.schema_from_types(v=int))
+    pw.io.subscribe(t, on_change=lambda **kw: None)
+    with caplog.at_level(logging.WARNING, "pathway_tpu.persistence"):
+        pw.run(persistence_config=pw.persistence.Config(backend),
+               timeout_s=5.0, monitoring_level=pw.MonitoringLevel.NONE)
+    assert not any("deterministic_rerun DEFAULT" in r.getMessage()
+                   for r in caplog.records)

@@ -6,6 +6,7 @@ import json
 import socket
 import threading
 import time
+import urllib.error
 import urllib.request
 
 import pytest
@@ -267,6 +268,103 @@ def test_forget_immediately_under_query_storm(tmp_path):
     assert not results["errors"], results["errors"][:3]
     assert results["hits"] == 25
     _ = server  # storm answered through one connector
+
+
+def _post_raw(port, route, payload, timeout=20):
+    """Like _post but never raises on HTTP errors: returns
+    (status, parsed_body, headers)."""
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{route}",
+        json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    try:
+        resp = urllib.request.urlopen(req, timeout=timeout)
+        return resp.status, json.loads(resp.read()), dict(resp.headers)
+    except urllib.error.HTTPError as exc:
+        body = exc.read()
+        try:
+            body = json.loads(body)
+        except Exception:  # noqa: BLE001
+            pass
+        return exc.code, body, dict(exc.headers)
+
+
+def test_rag_answers_through_scheduler_with_429_on_overflow(tmp_path):
+    """e2e (ISSUE 1 acceptance): the RAG server answers correctly with the
+    generation tier routed through the serve/ RequestScheduler, and the
+    REST admission gate sheds queue overflow with 429 + Retry-After
+    instead of queueing unboundedly."""
+    docs_dir = tmp_path / "docs"
+    docs_dir.mkdir()
+    (docs_dir / "a.txt").write_text("zeta compendium about request scheduling")
+    store = _mk_store(docs_dir)
+
+    llm_gate = threading.Event()
+    llm_gate.set()  # open: the warm-up answer flows straight through
+
+    def gated_llm(msgs):
+        llm_gate.wait(6.0)
+        return "A[" + msgs[0]["content"][:16] + "]"
+
+    rag = BaseRAGQuestionAnswerer(gated_llm, store, search_topk=1,
+                                  llm_scheduler=True)
+    assert rag._llm_scheduler is not None
+    port = _free_port()
+    QARestServer("127.0.0.1", port, rag,
+                 admission={"max_pending": 2, "retry_after_s": 2.0})
+    results = {"overflow": [], "late": []}
+
+    def client():
+        # 1. a normal answer travels HTTP -> engine -> llm scheduler -> back
+        results["warm"] = _poll_until(
+            lambda: (r := _post_raw(port, "/v1/pw_ai_answer",
+                                    {"prompt": "what is zeta"}, timeout=10))
+            and r[0] == 200 and r,
+            deadline_s=10.0,
+        )
+        # 2. block the generation tier and storm: only max_pending=2 may
+        # wait in the engine; the rest must be shed with 429
+        llm_gate.clear()
+        threads, statuses = [], [None] * 6
+
+        def fire(i):
+            statuses[i] = _post_raw(port, "/v1/pw_ai_answer",
+                                    {"prompt": f"storm {i}"}, timeout=15)
+
+        for i in range(6):
+            t = threading.Thread(target=fire, args=(i,))
+            t.start()
+            threads.append(t)
+            time.sleep(0.05)  # admission slots fill before the overflow hits
+        time.sleep(0.3)
+        llm_gate.set()  # release the tier; admitted requests complete
+        for t in threads:
+            t.join(timeout=20)
+        results["overflow"] = statuses
+
+    th = threading.Thread(target=client, daemon=True)
+    th.start()
+    pw.run(timeout_s=14.0, autocommit_duration_ms=40,
+           monitoring_level=pw.MonitoringLevel.NONE)
+    th.join(timeout=5)
+
+    status, body, _hdrs = results["warm"]
+    assert status == 200 and body.startswith("A["), results["warm"]
+    # the answer really went through the scheduler (one batch recorded)
+    assert rag._llm_scheduler.stats.completed >= 1
+    assert rag._llm_scheduler.stats.batches >= 1
+
+    statuses = [s for s in results["overflow"] if s is not None]
+    assert statuses, "storm produced no responses"
+    shed = [s for s in statuses if s[0] == 429]
+    served = [s for s in statuses if s[0] == 200]
+    assert shed, f"overflow must shed with 429, got {[s[0] for s in statuses]}"
+    for code, body, hdrs in shed:
+        assert int(hdrs.get("Retry-After", 0)) >= 1
+        assert "error" in body
+    for code, body, _hdrs in served:
+        assert body.startswith("A[")
 
 
 def test_document_deletion_mid_serving(tmp_path):
