@@ -87,6 +87,28 @@ class MetricsServer:
                 "<th>queue</th><th>occupancy</th><th>done</th>"
                 f"<th>shed</th></tr>{serve_rows}</table>"
             )
+        kv_html = ""
+        try:
+            from ..serve.metrics import all_kv_stats
+
+            kv_snaps = [s.snapshot() for s in all_kv_stats()]
+        except Exception:
+            kv_snaps = []
+        if kv_snaps:
+            kv_rows = "".join(
+                f"<tr><td>{s['name']}</td>"
+                f"<td>{s['blocks_in_use']}/{s['blocks_total']}</td>"
+                f"<td>{s['prefix_hits']}/{s['prefix_hits'] + s['prefix_misses']}</td>"
+                f"<td>{s['preemptions']}</td><td>{s['cow_copies']}</td>"
+                f"<td>{s['prefix_evictions']}</td></tr>"
+                for s in kv_snaps
+            )
+            kv_html = (
+                "<h3>kv cache</h3><table><tr><th>pool</th>"
+                "<th>blocks</th><th>prefix hit/lookup</th>"
+                "<th>preempt</th><th>cow</th>"
+                f"<th>evict</th></tr>{kv_rows}</table>"
+            )
         return (
             "<html><head><title>pathway-tpu</title>"
             '<meta http-equiv="refresh" content="2">'
@@ -97,7 +119,7 @@ class MetricsServer:
             f"&middot; uptime={time.time() - self.started_at:.0f}s</h2>"
             "<table><tr><th>operator</th><th>id</th><th>rows in</th>"
             f"<th>rows out</th></tr>{rows}</table>"
-            f"{serve_html}"
+            f"{serve_html}{kv_html}"
             '<p><a href="/metrics">/metrics</a></p></body></html>'
         )
 

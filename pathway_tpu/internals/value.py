@@ -178,6 +178,15 @@ _AUTO_ROW_KEYS_LOCK = threading.Lock()
 _AUTO_KEY_CACHE_MAX: int | None = None
 
 
+# Default cap on the process-lifetime auto-row-key memo.  1M keys pin
+# ~50MB for the life of the process (r5 ADVICE flagged the old 4M default
+# as a ~200MB permanent pin); raise PATHWAY_AUTO_KEY_CACHE_MAX for hosts
+# that repeatedly build larger static tables, or call
+# release_auto_key_cache() from batch processes to drop the pin entirely
+# between jobs.
+_AUTO_KEY_CACHE_DEFAULT = 1_000_000
+
+
 def _auto_key_cache_max() -> int:
     """Parsed once; a malformed env value logs and keeps the default
     rather than crashing every table build in the hot key path."""
@@ -185,18 +194,44 @@ def _auto_key_cache_max() -> int:
     if _AUTO_KEY_CACHE_MAX is None:
         try:
             _AUTO_KEY_CACHE_MAX = int(
-                os.environ.get("PATHWAY_AUTO_KEY_CACHE_MAX", "4000000")
+                os.environ.get(
+                    "PATHWAY_AUTO_KEY_CACHE_MAX",
+                    str(_AUTO_KEY_CACHE_DEFAULT),
+                )
             )
         except ValueError:
             import logging
 
             logging.getLogger(__name__).warning(
                 "PATHWAY_AUTO_KEY_CACHE_MAX=%r is not an integer; using "
-                "the 4000000 default",
+                "the %d default",
                 os.environ.get("PATHWAY_AUTO_KEY_CACHE_MAX"),
+                _AUTO_KEY_CACHE_DEFAULT,
             )
-            _AUTO_KEY_CACHE_MAX = 4_000_000
+            _AUTO_KEY_CACHE_MAX = _AUTO_KEY_CACHE_DEFAULT
     return _AUTO_KEY_CACHE_MAX
+
+
+def release_auto_key_cache() -> int:
+    """Drop the memoized auto-row-key prefix and re-read
+    ``PATHWAY_AUTO_KEY_CACHE_MAX`` on next use; returns how many cached
+    keys were released.
+
+    The memo is a deliberate process-lifetime pin (the key sequence is a
+    pure function of the ordinal, so every static-table build reuses it).
+    Long-running BATCH processes that build one large table per job have
+    no further use for it between jobs — call this at job boundaries to
+    return the memory (~50MB per million keys).  Live tables keep their
+    own references to the key objects they hold, so releasing the cache
+    never invalidates existing keys; the next build just recomputes."""
+    global _AUTO_ROW_KEYS, _AUTO_KEY_CACHE_MAX
+    with _AUTO_ROW_KEYS_LOCK:
+        released = len(_AUTO_ROW_KEYS)
+        # rebind rather than clear(): a concurrent auto_row_keys() call
+        # may still be slicing the old list it captured
+        _AUTO_ROW_KEYS = []
+        _AUTO_KEY_CACHE_MAX = None
+    return released
 
 
 def auto_row_keys(n: int) -> list[Pointer]:

@@ -1,0 +1,31 @@
+"""pathway_tpu.kvcache — paged KV-cache management for batched decoding.
+
+Round-7 subsystem (see ARCHITECTURE.md "Round-7: paged KV serving"): the
+dense per-instance `[1, T_max]` KV buffer in models/decoder.py pinned the
+serving path to one sequence at a time.  Here the cache is a managed,
+shared resource — a fixed HBM block pool (block_pool.py) addressed through
+per-sequence block tables, with hash-chained prefix sharing
+(prefix_cache.py), a paged attention op with a pure-JAX gather reference
+path and a Pallas kernel (paged_attention.py), and a continuous-batching
+generation engine (engine.py) that admits new sequences into in-flight
+decode batches at step boundaries and preempts-with-recompute when the
+pool is exhausted.
+
+Kernel shape follows Ragged Paged Attention (arxiv 2604.15464); the
+managed-resource framing follows arxiv 2603.09555.
+"""
+
+from .block_pool import BlockPool, PoolExhausted, SequenceState
+from .engine import PagedDecodeEngine
+from .paged_attention import paged_attention, paged_attention_reference
+from .prefix_cache import PrefixCache
+
+__all__ = [
+    "BlockPool",
+    "PoolExhausted",
+    "SequenceState",
+    "PrefixCache",
+    "PagedDecodeEngine",
+    "paged_attention",
+    "paged_attention_reference",
+]

@@ -1,0 +1,530 @@
+"""Continuous-batching greedy generation over the paged KV cache.
+
+The dense serving path (models/host_decoder.py `serving_executor`) was
+pinned to ``max_batch_size=1`` because the KV cache was per-instance
+mutable state.  Here the cache is the shared BlockPool, so the engine
+decodes MANY sequences per device step:
+
+- admission: a request's prompt is matched against the prefix cache
+  (shared leading blocks are mapped instead of re-STORED — prefill
+  compute still runs over the full bucket, but its scatter skips the
+  shared blocks, whose K/V is already resident; the win is HBM blocks,
+  not prefill FLOPs), fresh blocks are allocated, and the prompt runs
+  one :func:`~pathway_tpu.models.decoder.paged_prefill` at its length
+  bucket;
+- decode: every running sequence advances one token per
+  :func:`~pathway_tpu.models.decoder.paged_decode_step` call — one device
+  dispatch serves the whole batch, with per-sequence positions/block
+  tables (the dense path's one-scalar-position design is what forced
+  batch 1);
+- continuous batching: between steps the engine polls its scheduler for
+  new arrivals and admits them into the in-flight batch (step-boundary
+  admission, serve/scheduler.py `poll_inflight`);
+- preemption: when the pool is exhausted, refcount-0 prefix blocks are
+  evicted first; if that is not enough a victim sequence (lowest
+  priority class, most recent arrival) is preempted — blocks freed,
+  request re-queued — and later re-admitted by recompute-prefill over
+  ``prompt + tokens_emitted_so_far`` (token-identical to never having
+  been preempted: the recomputed prefill's next-token logits equal the
+  decode path's).
+
+Shapes are static per compile: decode steps are padded to
+``max_batch_size`` rows (idle rows write to the reserved null block) and
+prefill to the sequence-bucket ladder, per the TPU static-shape rule.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .block_pool import BlockPool, PoolExhausted
+from .prefix_cache import PrefixCache
+
+
+class _Request:
+    __slots__ = ("prompt", "max_new", "priority", "stop_token", "emitted",
+                 "index", "on_done", "on_error")
+
+    def __init__(self, prompt, max_new: int, *, priority: int = 1,
+                 stop_token: int | None = None, index: int | None = None,
+                 on_done: Callable | None = None,
+                 on_error: Callable | None = None):
+        self.prompt = list(prompt)
+        self.max_new = int(max_new)
+        self.priority = int(priority)
+        self.stop_token = stop_token
+        self.emitted: list[int] = []
+        self.index = index
+        self.on_done = on_done
+        self.on_error = on_error
+
+
+class _Active:
+    __slots__ = ("seq_id", "req")
+
+    def __init__(self, seq_id: int, req: _Request):
+        self.seq_id = seq_id
+        self.req = req
+
+
+def build_engine(cfg, params, fallback_msg: str, logger_name: str,
+                 **kwargs):
+    """Construct a :class:`PagedDecodeEngine`, or log at INFO and return
+    None when it cannot be built — the shared fallback shape for hosts
+    whose serial tier keeps working (JaxDecoderLM.paged_engine,
+    Int8DecoderHost.paged_engine)."""
+    try:
+        return PagedDecodeEngine(cfg, params, **kwargs)
+    except Exception as exc:  # noqa: BLE001 - the serial tier works
+        import logging
+
+        logging.getLogger(logger_name).info(
+            "paged KV decode engine unavailable (%s); %s", exc, fallback_msg
+        )
+        return None
+
+
+class PagedDecodeEngine:
+    """Batched greedy decoding through BlockPool + PrefixCache."""
+
+    def __init__(self, cfg, params, *, num_blocks: int = 256,
+                 block_size: int = 16, max_blocks_per_seq: int | None = None,
+                 max_batch_size: int = 8, seq_buckets=(64, 256, 1024),
+                 prefix_sharing: bool = True, stop_token: int | None = None,
+                 attn: str | None = None, name: str = "paged_decoder"):
+        from ..models.encoder import _resolve_dtype
+
+        self.cfg = cfg
+        self.params = params
+        self.max_batch_size = int(max_batch_size)
+        self.stop_token = stop_token
+        if attn is None:
+            attn = "pallas" if jax.default_backend() == "tpu" else "reference"
+        self.attn = attn
+        head_dim = cfg.d_model // cfg.n_heads
+        self.pool = BlockPool(
+            num_blocks=num_blocks, block_size=block_size,
+            n_layers=cfg.n_layers, n_heads=cfg.n_heads, head_dim=head_dim,
+            dtype=_resolve_dtype(cfg.dtype), name=name,
+        )
+        self.prefix = PrefixCache(self.pool) if prefix_sharing else None
+        bs = self.pool.block_size
+        cap = min((num_blocks - 1) * bs, cfg.max_len)
+        if max_blocks_per_seq is None:
+            max_blocks_per_seq = -(-min(cfg.max_len, cap) // bs)
+        self.max_blocks_per_seq = int(max_blocks_per_seq)
+        self.max_seq_tokens = min(self.max_blocks_per_seq * bs, cfg.max_len)
+        # prefill buckets: block-aligned, capped at what one table can span.
+        # The cap itself must round DOWN to a block multiple — rounding a
+        # bucket up past a non-aligned max_seq_tokens (cfg.max_len not a
+        # multiple of block_size) would break paged_prefill's reshape
+        bucket_cap = max((self.max_seq_tokens // bs) * bs, bs)
+        buckets = sorted({
+            min(-(-b // bs) * bs, bucket_cap) for b in seq_buckets
+        })
+        self.seq_buckets = buckets or [bucket_cap]
+        self._seq_counter = 0
+        self._lock = threading.RLock()
+        _cfg = cfg
+        _attn = self.attn
+
+        def _step_fn(p, k_pool, v_pool, token, positions, bt, sb, so):
+            from ..models.decoder import paged_decode_step
+
+            return paged_decode_step(
+                p, _cfg, k_pool, v_pool, token, positions, bt, sb, so,
+                attn=_attn,
+            )
+
+        def _prefill_fn(p, token_ids, n_valid, k_pool, v_pool, bt):
+            from ..models.decoder import paged_prefill
+
+            return paged_prefill(
+                p, _cfg, token_ids, n_valid, k_pool, v_pool, bt
+            )
+
+        # pools donated: every step/prefill consumes them in place.
+        # jit specializes per (1, bucket) token shape, so one wrapper
+        # covers the whole bucket ladder
+        self._step = jax.jit(_step_fn, donate_argnums=(1, 2))
+        self._prefill = jax.jit(_prefill_fn, donate_argnums=(3, 4))
+
+    # -- public API --------------------------------------------------------
+    def generate(self, prompt_ids, max_new: int, *,
+                 stop_token: int | None = None) -> list[int]:
+        """Single-sequence convenience wrapper over :meth:`generate_batch`."""
+        return self.generate_batch([(list(prompt_ids), max_new)],
+                                   stop_token=stop_token)[0]
+
+    def serve_batch(self, reqs, scheduler=None) -> list[list[int]]:
+        """``batch_fn`` adapter for serve.scheduler.RequestScheduler: reqs
+        are ``(prompt_ids, n_new)`` payloads — an optional third element
+        carries the submit-time priority class into preemption decisions
+        (host_decoder.generate_scheduled threads it through; payloads
+        without one decode at NORMAL).  When the owning scheduler is
+        passed, new arrivals are admitted into the in-flight batch at step
+        boundaries via its ``poll_inflight`` hook — true continuous
+        batching instead of batch-at-a-time coalescing."""
+        import functools
+
+        poll = None
+        if scheduler is not None:
+            def poll(n):
+                items = []
+                for w in scheduler.poll_inflight(n):
+                    items.append((
+                        (list(w.payload[0]), int(w.payload[1])),
+                        int(w.priority),
+                        functools.partial(scheduler.complete_inflight, w),
+                        functools.partial(scheduler.fail_inflight, w),
+                    ))
+                return items
+        def _prio(v) -> int:
+            try:
+                return int(v)
+            except (TypeError, ValueError):
+                from ..serve.admission import Priority
+
+                return int(Priority.parse(v))
+
+        return self.generate_batch(
+            [
+                (list(r[0]), int(r[1])) if len(r) < 3
+                else (list(r[0]), int(r[1]), _prio(r[2]))
+                for r in reqs
+            ],
+            poll=poll,
+            return_exceptions=True,
+        )
+
+    def generate_batch(self, requests, *, poll: Callable | None = None,
+                       stop_token: int | None = None,
+                       return_exceptions: bool = False) -> list[list[int]]:
+        """Greedy-decode a batch of ``(prompt_ids, max_new)`` requests (an
+        optional third element is a serve.admission.Priority value).
+
+        ``poll(n)``, when given, is called at every step boundary and may
+        return up to ``n`` newly arrived ``(payload, priority, on_done,
+        on_error)`` tuples to admit into the in-flight batch; their results
+        flow through the callbacks instead of the returned list.
+
+        ``return_exceptions=True`` places a per-request exception in that
+        request's result slot instead of raising after the loop — one
+        undecodable request must not throw away the rest of the batch's
+        completed decodes (serve_batch relies on this; the scheduler maps
+        exception results back to their individual callers).
+        """
+        stop = self.stop_token if stop_token is None else stop_token
+        pending: deque[_Request] = deque()
+        for i, r in enumerate(requests):
+            prompt, max_new = r[0], r[1]
+            priority = r[2] if len(r) > 2 else 1
+            pending.append(_Request(
+                prompt, max_new, priority=priority, stop_token=stop, index=i,
+            ))
+        results: list[Any] = [None] * len(requests)
+        errors: list[tuple[int, BaseException]] = []
+        outstanding = {"n": len(requests)}  # batch-origin work still open
+
+        def deliver(req: _Request, err: BaseException | None = None) -> None:
+            if req.on_done is None and req.on_error is None:
+                outstanding["n"] -= 1
+            if err is not None:
+                if req.on_error is not None:
+                    req.on_error(err)
+                elif return_exceptions:
+                    results[req.index] = err
+                else:
+                    errors.append((req.index, err))
+            elif req.on_done is not None:
+                req.on_done(list(req.emitted))
+            else:
+                results[req.index] = list(req.emitted)
+
+        if poll is not None:
+            # stop admitting NEW arrivals once every batch-origin request
+            # has delivered: their callers are blocked on this function's
+            # return, and a sustained arrival stream must not starve them
+            # past the (bounded) tail of already-admitted work
+            inner_poll = poll
+
+            def poll(n):  # noqa: F811 - deliberate bounded wrapper
+                return inner_poll(n) if outstanding["n"] > 0 else []
+
+        with self._lock:
+            running = self._run_loop(pending, deliver, poll, stop)
+            assert not running
+        if errors:
+            raise errors[0][1]
+        return results
+
+    # -- main loop ---------------------------------------------------------
+    def _run_loop(self, pending, deliver, poll, stop):
+        running: list[_Active] = []
+        try:
+            self._loop_body(running, pending, deliver, poll, stop)
+        except BaseException as exc:
+            # fail EVERYTHING still in flight before propagating: requests
+            # admitted via poll_inflight are owned by this engine, and
+            # leaving their waiters unset would hang submit() callers
+            # until timeout with a misleading deadline error
+            for act in running:
+                try:
+                    self.pool.free_sequence(act.seq_id)
+                except Exception:  # noqa: BLE001 - best-effort cleanup
+                    pass
+                deliver(act.req, exc)
+            while pending:
+                deliver(pending.popleft(), exc)
+            raise
+        return running
+
+    def _loop_body(self, running, pending, deliver, poll, stop):
+        while pending or running:
+            # step-boundary admission of newly arrived requests
+            if poll is not None and len(running) < self.max_batch_size:
+                budget = self.max_batch_size - len(running) - len(pending)
+                for item in (poll(budget) if budget > 0 else ()):
+                    payload, priority, on_done, on_error = item
+                    # priority-ordered like _requeue: an urgent arrival
+                    # must not queue behind a lower-priority victim
+                    self._requeue(pending, _Request(
+                        payload[0], payload[1], priority=priority,
+                        stop_token=stop, on_done=on_done, on_error=on_error,
+                    ))
+            while pending and len(running) < self.max_batch_size:
+                req = pending[0]
+                status = self._try_admit(req, running, pending, deliver)
+                if status == "wait":
+                    break
+                pending.popleft()
+            if not running:
+                # nothing admitted implies nothing pending either:
+                # _try_admit only returns "wait" while others run, and the
+                # admission loop above drains pending otherwise
+                break
+            self._decode_round(running, pending, deliver)
+        return running
+
+    def _readmit_len(self, req: _Request) -> int:
+        """How many tokens _try_admit would prefill for this request right
+        now (its capacity-trim rule, before the bucket cap)."""
+        total = len(req.prompt) + len(req.emitted)
+        remaining = req.max_new - len(req.emitted)
+        if total + remaining > self.max_seq_tokens:
+            return max(self.max_seq_tokens - remaining, 1)
+        return total
+
+    def _requeue(self, pending, req: _Request) -> None:
+        """Put a preemption victim back in line by PRIORITY class: ahead
+        of strictly-lower-priority work, behind equal-or-higher — a
+        victim must not leapfrog an urgent arrival (priority inversion)
+        nor lose its place to later same-class requests."""
+        idx = next(
+            (i for i, r in enumerate(pending) if r.priority > req.priority),
+            len(pending),
+        )
+        pending.insert(idx, req)
+
+    # -- admission ---------------------------------------------------------
+    def _try_admit(self, req: _Request, running, pending, deliver) -> str:
+        """Allocate + prefill one request.  Returns "admitted", "done"
+        (finished at its first token), "failed" (undecodable — delivered as
+        an error), or "wait" (pool full while other sequences run)."""
+        if req.max_new - len(req.emitted) <= 0:
+            # zero-token request: the dense path returns nothing, so must we
+            deliver(req)
+            return "done"
+        tokens = req.prompt + req.emitted
+        limit = self.max_seq_tokens
+        remaining = req.max_new - len(req.emitted)
+        if len(tokens) + remaining > limit:
+            # keep the most recent context that still leaves room for every
+            # new token (JaxDecoderLM.generate's trimming rule)
+            tokens = tokens[-max(limit - remaining, 1):]
+        if len(tokens) > self.seq_buckets[-1]:
+            # prefill must fit the largest bucket even when the table could
+            # span more (max_seq_tokens bounds the TOTAL, growth included)
+            tokens = tokens[-self.seq_buckets[-1]:]
+        if not tokens:
+            tokens = [4]
+        n = len(tokens)
+        self._seq_counter += 1
+        seq_id = self._seq_counter
+        state = None
+        attempt = 0
+        while state is None:
+            shared, keys = ([], [])
+            if self.prefix is not None:
+                # sharing is safe even when it covers EVERY prompt block:
+                # full blocks are never decode-write targets (appends open
+                # a fresh block at the boundary) and shared blocks are
+                # excluded from the prefill scatter below.  Only the first
+                # match records hit/miss stats — eviction retries re-match
+                # the same admission
+                shared, keys = self.prefix.match(tokens, record=attempt == 0)
+            attempt += 1
+            try:
+                state = self.pool.allocate(
+                    seq_id, n, shared_blocks=shared, priority=req.priority,
+                )
+            except PoolExhausted as exc:
+                freed = 0
+                if self.prefix is not None:
+                    freed = self.prefix.evict(exc.needed - exc.free)
+                if freed:
+                    continue  # re-match: eviction may have dropped `shared`
+                if running:
+                    return "wait"
+                # nothing running and nothing evictable: every engine-owned
+                # sequence is freed, so preempt() can only reclaim a stray
+                # registered through direct pool use — retry if it did
+                if self.pool.preempt() is None:
+                    deliver(req, RuntimeError(
+                        f"KV pool ({self.pool.num_blocks - 1} blocks of "
+                        f"{self.pool.block_size}) cannot hold a "
+                        f"{n}-token sequence"
+                    ))
+                    return "failed"
+        try:
+            bucket = next(b for b in self.seq_buckets if b >= n)
+            nb = bucket // self.pool.block_size
+            buf = np.zeros((1, bucket), np.int32)
+            buf[0, :n] = tokens
+            # prefix-shared leading blocks already hold the right K/V:
+            # divert their scatter slots to the null block instead of
+            # rewriting them — a live sequence may be attending through
+            # those blocks RIGHT NOW, and a rewrite from a different
+            # length bucket is not bit-identical on kernels that switch
+            # algorithm by length (flash vs dense), which would silently
+            # perturb its remaining decode
+            scatter_bt = self.pool.block_table(seq_id, nb)
+            scatter_bt[: len(shared)] = 0
+            logits, self.pool.k, self.pool.v = self._prefill(
+                self.params, jnp.asarray(buf), jnp.asarray([n], jnp.int32),
+                self.pool.k, self.pool.v, jnp.asarray(scatter_bt[None, :]),
+            )
+            if self.prefix is not None:
+                # zip inside insert() truncates to the full-block keys, so
+                # a partial tail block (the live decode-write target) is
+                # never registered
+                self.prefix.insert(keys, state.block_ids)
+        except BaseException:
+            # the sequence is not yet in `running`, so _run_loop's failure
+            # cleanup cannot see it — free here or its blocks leak for the
+            # engine's (process-long) lifetime
+            self.pool.free_sequence(seq_id)
+            raise
+        first = int(np.argmax(np.asarray(logits[0])))
+        req.emitted.append(first)
+        act = _Active(seq_id, req)
+        if self._is_done(req, seq_id):
+            self.pool.free_sequence(seq_id)
+            deliver(req)
+            return "done"
+        running.append(act)
+        return "admitted"
+
+    def _is_done(self, req: _Request, seq_id: int) -> bool:
+        if len(req.emitted) >= req.max_new:
+            return True
+        if req.stop_token is not None and req.emitted[-1] == req.stop_token:
+            return True
+        # capacity: the next token's position must fit the table + pos_embed
+        return self.pool.sequence(seq_id).n_tokens >= self.max_seq_tokens
+
+    # -- decode ------------------------------------------------------------
+    def _decode_round(self, running, pending, deliver) -> None:
+        reserved = self._reserve_slots(running, pending)
+        if not reserved:
+            return
+        B = self.max_batch_size
+        NB = self.max_blocks_per_seq
+        token = np.zeros(B, np.int32)
+        positions = np.zeros(B, np.int32)
+        sb = np.zeros(B, np.int32)
+        so = np.zeros(B, np.int32)
+        bt = np.zeros((B, NB), np.int32)
+        for i, (act, (blk, off)) in enumerate(reserved):
+            seq = self.pool.sequence(act.seq_id)
+            token[i] = act.req.emitted[-1]
+            positions[i] = seq.n_tokens - 1  # append_slot already advanced
+            sb[i] = blk
+            so[i] = off
+            bt[i, : len(seq.block_ids)] = seq.block_ids
+        logits, self.pool.k, self.pool.v = self._step(
+            self.params, self.pool.k, self.pool.v, jnp.asarray(token),
+            jnp.asarray(positions), jnp.asarray(bt), jnp.asarray(sb),
+            jnp.asarray(so),
+        )
+        logits = np.asarray(logits)
+        for i, (act, _slot) in enumerate(reserved):
+            nxt = int(np.argmax(logits[i]))
+            act.req.emitted.append(nxt)
+            if self._is_done(act.req, act.seq_id):
+                running.remove(act)
+                self.pool.free_sequence(act.seq_id)
+                deliver(act.req)
+
+    def _reserve_slots(self, running, pending
+                       ) -> list[tuple[_Active, tuple[int, int]]]:
+        """Reserve one write slot per running sequence, resolving pool
+        exhaustion by prefix eviction first, preemption second.  Victims
+        are only taken from sequences that have NOT yet reserved this
+        round (a reserved slot is already in the outgoing device arrays)."""
+        reserved: list[tuple[_Active, tuple[int, int]]] = []
+        survivors = list(running)
+        idx = 0
+        while idx < len(survivors):
+            act = survivors[idx]
+            try:
+                slot = self.pool.append_slot(act.seq_id)
+            except PoolExhausted:
+                if self.prefix is not None and self.prefix.evict(1) > 0:
+                    continue
+                # never preempt a sequence whose RE-ADMISSION prefill would
+                # not fit the largest bucket (it would have to truncate,
+                # breaking token identity) — such sequences are
+                # preempt-immune.  The length is the admission trim math,
+                # not the raw prompt: a long prompt already trimmed at
+                # admission re-admits at the same (suffix-consistent) size
+                bucket_cap = self.seq_buckets[-1]
+                exclude = {a.seq_id for a, _ in reserved} | {
+                    a.seq_id for a in survivors
+                    if self._readmit_len(a.req) > bucket_cap
+                }
+                victim = self.pool.preempt(exclude=exclude)
+                if victim is None:
+                    raise RuntimeError(
+                        "KV pool exhausted with nothing left to preempt; "
+                        "increase num_blocks"
+                    )
+                vact = next(
+                    (a for a in survivors if a.seq_id == victim.seq_id),
+                    None,
+                )
+                if vact is None:
+                    # the victim was a stray registered through direct pool
+                    # use, not one of ours: its blocks are freed, retry
+                    continue
+                survivors.remove(vact)
+                running.remove(vact)
+                # preemption-with-recompute: the request rejoins the queue
+                # carrying its emitted tokens; re-admission prefills over
+                # prompt + emitted (the last emitted token's K/V was never
+                # written, so recompute is the only correct resumption).
+                # Trim consistency makes this token-identical: admission
+                # keeps the last (limit - max_new) + len(emitted) tokens,
+                # exactly the originally-admitted suffix plus everything
+                # emitted since
+                self._requeue(pending, vact.req)
+                continue  # same idx: list shifted or retry current
+            reserved.append((act, slot))
+            idx += 1
+        return reserved

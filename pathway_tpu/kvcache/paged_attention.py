@@ -1,0 +1,188 @@
+"""Paged decode attention: single-query attention through a block table.
+
+Two tiers with one contract:
+
+- :func:`paged_attention_reference` — pure-JAX gather path (tier-1,
+  ``JAX_PLATFORMS=cpu``).  It mirrors ``models/decoder.decode_step``'s
+  einsum strings and masking EXACTLY, so when the gathered context length
+  (``num_table_blocks * block_size``) equals the dense path's cache
+  length, the logits are bit-identical to the dense batch-1 decode — the
+  token-identity guarantee tests/test_paged_decode.py pins.
+- a Pallas TPU kernel (Ragged-Paged-Attention shape, arxiv 2604.15464):
+  the block table rides in scalar-prefetch SMEM so each grid step DMAs
+  one physical KV block straight into VMEM — the (B, L, H, D) gathered
+  copy the reference path materializes in HBM never exists.  Online
+  softmax is carried in VMEM scratch across the (sequential, innermost)
+  block dimension, same (m, l, acc) recurrence as ops/attention_pallas.py.
+
+Pool layout: ``(num_blocks, block_size, n_heads, head_dim)`` per layer
+(the per-layer slice of BlockPool's stacked arrays).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops._tiling import pad_to as _pad_to
+
+_NEG = -1e9
+
+try:  # pallas import is deferred-safe: fall back to the gather path
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    _HAVE_PALLAS = True
+except Exception:  # pragma: no cover
+    _HAVE_PALLAS = False
+
+
+def paged_attention_reference(q, k_pool, v_pool, block_tables, context_lens):
+    """Gather-based paged attention.
+
+    q: (B, 1, H, hd) single decode query per sequence;
+    k_pool/v_pool: (num_blocks, block_size, H, hd);
+    block_tables: (B, NB) int32, padded with the null block;
+    context_lens: (B,) int32 — valid tokens per sequence (position + 1).
+    Returns (B, 1, H, hd).
+    """
+    B = q.shape[0]
+    NB = block_tables.shape[1]
+    BS, H, hd = k_pool.shape[1:]
+    k = k_pool[block_tables].reshape(B, NB * BS, H, hd)
+    v = v_pool[block_tables].reshape(B, NB * BS, H, hd)
+    # decode_step's exact math: same einsum strings, mask, f32 softmax
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(hd)
+    valid = (
+        jnp.arange(NB * BS)[None, :] < context_lens[:, None]
+    )[:, None, None, :]
+    scores = jnp.where(valid, scores, _NEG)
+    probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def _paged_kernel(bt_ref, cl_ref, q_ref, k_ref, v_ref, o_ref,
+                  m_ref, l_ref, acc_ref, *, nb: int, block_size: int,
+                  scale: float):
+    """Grid: (B, NB) — blocks innermost, so (m, l, acc) scratch carries the
+    online softmax across one sequence's blocks.  Blocks: q/o (H, Dp);
+    k/v (block_size, H, Dp) — the physical block the scalar-prefetched
+    table maps grid step j to."""
+    b = pl.program_id(0)
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, _NEG)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    ctx = cl_ref[b]
+
+    @pl.when(j * block_size < ctx)  # skip blocks wholly past the context
+    def _visible():
+        qb = q_ref[:]  # (H, Dp)
+        kb = k_ref[:]  # (BS, H, Dp)
+        # per-head dot: batch over H, contract Dp -> (H, BS)
+        s = jax.lax.dot_general(
+            qb, kb,
+            dimension_numbers=(((1,), (2,)), ((0,), (1,))),
+            preferred_element_type=jnp.float32,
+        ) * scale
+        k_pos = j * block_size + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, 1
+        )
+        valid = k_pos < ctx
+        s = jnp.where(valid, s, _NEG)
+        m_prev = m_ref[:, :1]  # (H, 1)
+        m_cur = jnp.max(s, axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new)
+        p = jnp.where(valid, p, 0.0)
+        corr = jnp.exp(m_prev - m_new)
+        l_ref[:] = jnp.broadcast_to(
+            l_ref[:, :1] * corr + jnp.sum(p, axis=1, keepdims=True),
+            l_ref.shape,
+        )
+        acc_ref[:] = acc_ref[:] * corr + jax.lax.dot_general(
+            p.astype(v_ref.dtype), v_ref[:],
+            dimension_numbers=(((1,), (0,)), ((0,), (1,))),
+            preferred_element_type=jnp.float32,
+        )
+        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+
+    @pl.when(j == nb - 1)
+    def _final():
+        denom = jnp.maximum(l_ref[:, :1], 1e-20)
+        o_ref[:] = (acc_ref[:] / denom).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("d_true", "interpret"))
+def _paged_bhd(q, k_pool, v_pool, block_tables, context_lens, *,
+               d_true: int, interpret: bool = False):
+    """q: (B, H, Dp); pools (num_blocks, BS, H, Dp), Dp lane-padded."""
+    B, H, Dp = q.shape
+    BS = k_pool.shape[1]
+    NB = block_tables.shape[1]
+    kernel = functools.partial(
+        _paged_kernel, nb=NB, block_size=BS, scale=1.0 / np.sqrt(d_true)
+    )
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,  # block_tables, context_lens
+        grid=(B, NB),
+        in_specs=[
+            pl.BlockSpec((None, H, Dp), lambda b, j, bt, cl: (b, 0, 0)),
+            pl.BlockSpec(
+                (None, BS, H, Dp), lambda b, j, bt, cl: (bt[b, j], 0, 0, 0)
+            ),
+            pl.BlockSpec(
+                (None, BS, H, Dp), lambda b, j, bt, cl: (bt[b, j], 0, 0, 0)
+            ),
+        ],
+        out_specs=pl.BlockSpec((None, H, Dp), lambda b, j, bt, cl: (b, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((H, 128), jnp.float32),  # m
+            pltpu.VMEM((H, 128), jnp.float32),  # l
+            pltpu.VMEM((H, Dp), jnp.float32),   # acc
+        ],
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, H, Dp), q.dtype),
+        interpret=interpret,
+    )(block_tables, context_lens, q, k_pool, v_pool)
+
+
+def paged_attention(q, k_pool, v_pool, block_tables, context_lens, *,
+                    use_pallas: bool | None = None,
+                    interpret: bool | None = None):
+    """Dispatch: Pallas kernel on TPU, gather reference elsewhere (the
+    interpreted kernel is for tests).  Same signature/shape contract as
+    :func:`paged_attention_reference`.
+
+    The kernel path lane-pads head_dim to 128 on the fly — production
+    pools meant to live on the kernel path should be allocated with
+    ``head_dim`` already a 128-multiple to avoid the copy."""
+    backend = jax.default_backend()
+    if use_pallas is None:
+        use_pallas = _HAVE_PALLAS and backend == "tpu"
+    if not use_pallas or not _HAVE_PALLAS:
+        return paged_attention_reference(
+            q, k_pool, v_pool, block_tables, context_lens
+        )
+    B, _, H, hd = q.shape
+    qq = _pad_to(q[:, 0], 2, 128)
+    kk = _pad_to(k_pool, 3, 128)
+    vv = _pad_to(v_pool, 3, 128)
+    out = _paged_bhd(
+        qq, kk, vv,
+        jnp.asarray(block_tables, jnp.int32),
+        jnp.asarray(context_lens, jnp.int32),
+        d_true=hd,
+        interpret=(backend != "tpu") if interpret is None else interpret,
+    )
+    return out[:, None, :, :hd]

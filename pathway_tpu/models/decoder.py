@@ -187,6 +187,94 @@ def decode_step(params: dict, cfg: DecoderConfig, cache: list[dict],
     return logits, new_cache
 
 
+def paged_prefill(params: dict, cfg: DecoderConfig, token_ids: jax.Array,
+                  n_valid: jax.Array, k_pool: jax.Array, v_pool: jax.Array,
+                  block_tables: jax.Array, *, flash: bool | None = None):
+    """Prefill through the paged KV cache (kvcache/block_pool.py).
+
+    Runs the exact dense :func:`prefill` (so prompt logits are bit-identical
+    to the batch-1 path), then scatters the per-layer K/V into the pool
+    blocks named by ``block_tables``.
+
+    token_ids: (B, T) with T a multiple of the pool block size;
+    k_pool/v_pool: (n_layers, num_blocks, block_size, H, hd) donated pool
+    arrays; block_tables: (B, T // block_size) int32 — rows padded with the
+    null block 0, whose garbage contents are never attended to (masked by
+    context length) and are overwritten slot-by-slot as decoding proceeds.
+    Returns ``(logits, k_pool, v_pool)``.
+    """
+    logits, cache = prefill(params, cfg, token_ids, n_valid, flash=flash)
+    B, T = token_ids.shape
+    BS = k_pool.shape[2]
+    nb = T // BS
+    H = cfg.n_heads
+    hd = cfg.d_model // H
+    k_new = jnp.stack([c["k"] for c in cache])  # (L, B, T, H, hd)
+    v_new = jnp.stack([c["v"] for c in cache])
+    k_blocks = k_new.reshape(cfg.n_layers, B, nb, BS, H, hd)
+    v_blocks = v_new.reshape(cfg.n_layers, B, nb, BS, H, hd)
+    k_pool = k_pool.at[:, block_tables].set(k_blocks)
+    v_pool = v_pool.at[:, block_tables].set(v_blocks)
+    return logits, k_pool, v_pool
+
+
+def paged_decode_step(params: dict, cfg: DecoderConfig, k_pool: jax.Array,
+                      v_pool: jax.Array, token: jax.Array,
+                      positions: jax.Array, block_tables: jax.Array,
+                      slot_blocks: jax.Array, slot_offsets: jax.Array, *,
+                      attn: str = "reference"):
+    """One batched incremental token through the paged cache.
+
+    Unlike :func:`decode_step` (one shared scalar ``pos`` — the
+    max_batch_size=1 pin), every sequence carries its own position: K/V for
+    the incoming token land at ``(slot_blocks[b], slot_offsets[b])`` and
+    attention reads back through ``block_tables`` masked to
+    ``positions + 1`` tokens.  The per-layer math mirrors decode_step
+    line-for-line, so a gathered context equal in length to the dense
+    cache yields bit-identical logits.
+
+    token/positions/slot_blocks/slot_offsets: (B,) int32;
+    block_tables: (B, NB) int32.  ``attn``: "reference" (gather, tier-1) or
+    "pallas" (kvcache/paged_attention.py kernel).
+    Returns ``(logits, k_pool, v_pool)``.
+    """
+    from .encoder import _proj
+    from ..kvcache.paged_attention import (paged_attention,
+                                           paged_attention_reference)
+
+    dtype = _resolve_dtype(cfg.dtype)
+    B = token.shape[0]
+    H = cfg.n_heads
+    hd = cfg.d_model // H
+    x = params["embed"].astype(dtype)[token][:, None, :]  # (B, 1, D)
+    x = x + params["pos_embed"].astype(dtype)[positions][:, None, :]
+    eps = cfg.ln_eps
+    act = _act_fn(cfg)
+    context_lens = (positions + 1).astype(jnp.int32)
+    for li, layer in enumerate(params["layers"]):
+        h = _layer_norm(x, layer["ln1_scale"], layer["ln1_bias"], eps)
+        q = _proj(layer, h, "wq", "bq").reshape(B, 1, H, hd)
+        k1 = _proj(layer, h, "wk", "bk").reshape(B, 1, H, hd)
+        v1 = _proj(layer, h, "wv", "bv").reshape(B, 1, H, hd)
+        k_pool = k_pool.at[li, slot_blocks, slot_offsets].set(k1[:, 0])
+        v_pool = v_pool.at[li, slot_blocks, slot_offsets].set(v1[:, 0])
+        if attn == "pallas":
+            a = paged_attention(
+                q, k_pool[li], v_pool[li], block_tables, context_lens
+            )
+        else:
+            a = paged_attention_reference(
+                q, k_pool[li], v_pool[li], block_tables, context_lens
+            )
+        x = x + _proj(layer, a.reshape(B, 1, cfg.d_model), "wo", "bo")
+        h = _layer_norm(x, layer["ln2_scale"], layer["ln2_bias"], eps)
+        ff = act(_proj(layer, h, "w_up", "b_up"))
+        x = x + _proj(layer, ff, "w_down", "b_down")
+    x = _layer_norm(x, params["ln_f_scale"], params["ln_f_bias"], eps)
+    logits = (x[:, 0, :] @ params["embed"].astype(x.dtype).T).astype(jnp.float32)
+    return logits, k_pool, v_pool
+
+
 def generate_tokens_fused(params: dict, cfg: DecoderConfig,
                           token_ids: jax.Array, n_valid: jax.Array,
                           max_new: int, stop_token: int | None):
@@ -437,6 +525,64 @@ class JaxDecoderLM:
             n += 1
             out.append(int(jnp.argmax(logits[0])))
         return self._decode_out(out)
+
+    def paged_engine(self, **kwargs):
+        """Lazy paged-KV batched decode engine (kvcache/engine.py) over
+        this LM's weights — the batch entry point the serving path uses
+        for multi-sequence continuous batching; None when construction
+        fails (callers keep their serial loop).  Keyed on the params
+        object (like _int8_host) so reassigning lm.params rebuilds the
+        engine instead of serving stale weights."""
+        requested = dict(kwargs)
+        cached = getattr(self, "_paged_engine_inst", None)
+        if cached is not None and cached[0] is self.params:
+            if requested and requested != cached[2]:
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "paged_engine(%r) ignored: engine already built with "
+                    "%r for these params — the shared instance is "
+                    "returned unchanged", requested, cached[2],
+                )
+            return cached[1]
+        from ..kvcache.engine import build_engine
+
+        kwargs.setdefault("name", "jax_decoder_kv")
+        inst = build_engine(
+            self.cfg, self.params,
+            "generation stays on the serial path", __name__, **kwargs,
+        )
+        self._paged_engine_inst = (self.params, inst, requested)
+        return inst
+
+    def generate_batch(self, prompts: list[str], max_new_tokens: int = 32,
+                       stop_token: int | None = None) -> list[str]:
+        """Batched greedy completion through the paged KV cache — ONE
+        engine pass decodes every prompt (mixed lengths, shared prefixes
+        mapped to shared physical blocks).  Falls back to serial
+        :meth:`generate` when the engine is unavailable."""
+        engine = self.paged_engine()
+        if engine is None:
+            return [
+                self.generate(p, max_new_tokens=max_new_tokens,
+                              stop_token=stop_token)
+                for p in prompts
+            ]
+        reqs = []
+        for p in prompts:
+            ids = self.tokenizer.encode(p)
+            keep = self.cfg.max_len - max_new_tokens
+            reqs.append((ids[-max(keep, 1):] or [4], max_new_tokens))
+        outs = engine.generate_batch(reqs, stop_token=stop_token)
+        texts = []
+        for toks in outs:
+            out = []
+            for t in toks:
+                out.append(t)
+                if stop_token is not None and t == stop_token:
+                    break
+            texts.append(self._decode_out(out))
+        return texts
 
     def _int8_host(self):
         """Lazy weight-int8 host decoder (host_decoder.Int8DecoderHost);

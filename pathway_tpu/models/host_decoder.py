@@ -47,6 +47,10 @@ class Int8DecoderHost:
         # implicitly by auto routing and must not clobber the process-wide
         # thread pool other torch users configured
         self.cfg = cfg
+        # kept (references only) so the paged serving tier can build the
+        # JAX-side engine from the same weights (serving_executor(paged=True))
+        self._jax_params = params
+        self._paged_engine = None
         # clamp: positions beyond max_len have no positional embedding
         self.cap = min(int(cache_capacity or cfg.max_len), cfg.max_len)
         f32 = np.float32
@@ -175,30 +179,138 @@ class Int8DecoderHost:
 
     # -- serving -----------------------------------------------------------
 
-    def serving_executor(self, **kwargs):
+    def paged_engine(self, **kwargs):
+        """The paged-KV batched decode engine (kvcache/engine.py) built
+        from this host's weights, lazily constructed; None when the engine
+        cannot be built (construction failure falls back to the serialized
+        int8 tier)."""
+        if self._paged_engine is not None:
+            cached_kwargs = getattr(self, "_paged_engine_kwargs", None)
+            if kwargs and self._paged_engine and kwargs != cached_kwargs:
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "paged_engine(%r) ignored: engine already built with "
+                    "%r — the shared instance is returned unchanged",
+                    kwargs, cached_kwargs,
+                )
+        if self._paged_engine is None:
+            self._paged_engine_kwargs = dict(kwargs)
+            from ..kvcache.engine import build_engine
+
+            kwargs.setdefault("name", "host_decoder_kv")
+            engine = build_engine(
+                self.cfg, self._jax_params,
+                "serving falls back to serialized batch-1 decode",
+                __name__, **kwargs,
+            )
+            if engine is None:
+                self._paged_engine = False
+                # the failure is sticky, so the f32 weights kept for the
+                # engine have no further use — release the pin
+                self._jax_params = None
+            else:
+                self._paged_engine = engine
+        return self._paged_engine or None
+
+    def serving_executor(self, *, paged: bool | None = None,
+                         max_batch_size: int | None = None, **kwargs):
         """Single shared executor for this decode tier (serve/scheduler.py).
 
-        The KV cache (`self._K/_V/n_past`) is mutable per-instance state, so
-        concurrent `generate` callers would interleave prefill/decode steps
-        and corrupt each other; the executor serializes device access
-        (max_batch_size=1) while still providing priority classes, deadline
-        shedding, bounded queueing and backpressure metrics — a shared
-        executor instead of per-call dispatch."""
-        sched = getattr(self, "_serve_executor", None)
-        if sched is None or sched._closed:
-            from ..serve.scheduler import RequestScheduler
+        ``paged=True`` (default when the kvcache engine is constructible)
+        routes generation through the paged KV-cache engine: the KV cache
+        is a shared block pool rather than per-instance mutable state, so
+        the executor runs TRUE multi-sequence continuous batching —
+        ``max_batch_size`` > 1 per device step, with queued requests
+        admitted into the in-flight decode batch at step boundaries
+        (``RequestScheduler.poll_inflight``).
 
-            kwargs.setdefault("name", "host_decoder")
-            kwargs.setdefault("max_queue", 64)
+        ``paged=False`` keeps the legacy serialized tier: the int8 host
+        cache (`self._K/_V/n_past`) is per-instance mutable state, so
+        concurrent `generate` callers would interleave prefill/decode
+        steps and corrupt each other — the executor pins
+        ``max_batch_size=1`` while still providing priority classes,
+        deadline shedding, bounded queueing and backpressure metrics.
+
+        Memory note: the paged tier decodes through the full-precision
+        JAX weights plus a float KV block pool — throughput, not
+        footprint.  Deployments that chose this class to shed the f32
+        weights should pass ``paged=False``, which releases the retained
+        f32 params (sticky: the paged tier is then unavailable on this
+        instance)."""
+        sched = getattr(self, "_serve_executor", None)
+        if sched is not None and not sched._closed:
+            if paged is not None or max_batch_size is not None:
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "serving_executor(paged=%r, max_batch_size=%r) "
+                    "ignored: the shared executor already exists; shut it "
+                    "down first to rebuild with different settings",
+                    paged, max_batch_size,
+                )
+            return sched
+        from ..serve.scheduler import RequestScheduler
+
+        kwargs.setdefault("name", "host_decoder")
+        kwargs.setdefault("max_queue", 64)
+        linger = kwargs.pop("batch_linger_ms", None)
+        engine = None
+        if paged is False and self._paged_engine is None:
+            # explicit opt-out frees the f32 weight pin for good
+            self._paged_engine = False
+            self._jax_params = None
+        if paged or paged is None:
+            engine_kwargs = {}
+            if max_batch_size is not None:
+                engine_kwargs["max_batch_size"] = max_batch_size
+            engine = self.paged_engine(**engine_kwargs)
+            if engine is None and paged:
+                raise RuntimeError("paged=True but the KV engine is "
+                                   "unavailable (see log)")
+        if engine is not None:
+            if paged is None:
+                import logging
+
+                logging.getLogger(__name__).info(
+                    "serving_executor: decode tier auto-selected the paged "
+                    "KV engine (batched f32 decode; pass paged=False for "
+                    "the serialized int8 tier)"
+                )
             self._serve_executor = sched = RequestScheduler(
-                lambda reqs: [self.generate(p, n) for p, n in reqs],
-                max_batch_size=1, batch_linger_ms=0.0, **kwargs,
+                lambda reqs: engine.serve_batch(
+                    reqs, scheduler=self._serve_executor
+                ),
+                max_batch_size=max_batch_size or engine.max_batch_size,
+                batch_linger_ms=2.0 if linger is None else linger, **kwargs,
+            )
+        else:
+            # payloads may carry a third (priority) element for the paged
+            # tier; the serialized tier just ignores it
+            self._serve_executor = sched = RequestScheduler(
+                lambda reqs: [self.generate(r[0], r[1]) for r in reqs],
+                max_batch_size=1,
+                batch_linger_ms=0.0 if linger is None else linger, **kwargs,
             )
         return sched
 
     def generate_scheduled(self, prompt_ids, n_new: int,
                            **submit_kwargs) -> list[int]:
-        """`generate` routed through the shared serving executor."""
-        return self.serving_executor().submit(
-            (list(prompt_ids), int(n_new)), **submit_kwargs
-        )
+        """Generation routed through the shared serving executor.
+
+        NOTE: with the default paged tier this decodes through the
+        full-precision JAX weights, so near-tie tokens can differ from
+        the int8 :meth:`generate` output on the same instance; build the
+        executor with ``paged=False`` for int8 output parity.  A
+        ``priority=`` submit kwarg also rides in the payload so the paged
+        engine's preemption policy sees the class even for requests that
+        enter at batch formation (not just poll_inflight arrivals)."""
+        payload = (list(prompt_ids), int(n_new))
+        if submit_kwargs.get("priority") is not None:
+            from ..serve.admission import Priority
+
+            # submit() accepts Priority | str | int — parse, don't int()
+            payload = payload + (
+                int(Priority.parse(submit_kwargs["priority"])),
+            )
+        return self.serving_executor().submit(payload, **submit_kwargs)

@@ -108,7 +108,77 @@ class ServeStats:
             }
 
 
+class KVCacheStats:
+    """Thread-safe counter block for one paged KV-cache pool
+    (kvcache/block_pool.py + prefix_cache.py).
+
+    Prometheus names (rendered by :func:`render_prometheus_lines`):
+
+    - ``pathway_kv_blocks_in_use{pool}``        gauge
+    - ``pathway_kv_blocks_total{pool}``         gauge
+    - ``pathway_kv_prefix_hit_total{pool}``     counter (full shared blocks)
+    - ``pathway_kv_prefix_miss_total{pool}``    counter
+    - ``pathway_kv_preemptions_total{pool}``    counter
+    - ``pathway_kv_cow_copies_total{pool}``     counter
+    - ``pathway_kv_prefix_evictions_total{pool}`` counter
+    """
+
+    def __init__(self, name: str, blocks_in_use_fn=None, blocks_total: int = 0):
+        self.name = name
+        self._lock = threading.Lock()
+        self._blocks_in_use_fn = blocks_in_use_fn
+        self.blocks_total = blocks_total
+        self.prefix_hits = 0
+        self.prefix_misses = 0
+        self.preemptions = 0
+        self.cow_copies = 0
+        self.prefix_evictions = 0
+
+    def record_prefix_hit(self, n: int = 1) -> None:
+        with self._lock:
+            self.prefix_hits += n
+
+    def record_prefix_miss(self, n: int = 1) -> None:
+        with self._lock:
+            self.prefix_misses += n
+
+    def record_preemption(self, n: int = 1) -> None:
+        with self._lock:
+            self.preemptions += n
+
+    def record_cow(self, n: int = 1) -> None:
+        with self._lock:
+            self.cow_copies += n
+
+    def record_prefix_eviction(self, n: int = 1) -> None:
+        with self._lock:
+            self.prefix_evictions += n
+
+    @property
+    def blocks_in_use(self) -> int:
+        if self._blocks_in_use_fn is None:
+            return 0
+        try:
+            return int(self._blocks_in_use_fn())
+        except Exception:
+            return 0
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "name": self.name,
+                "blocks_in_use": self.blocks_in_use,
+                "blocks_total": self.blocks_total,
+                "prefix_hits": self.prefix_hits,
+                "prefix_misses": self.prefix_misses,
+                "preemptions": self.preemptions,
+                "cow_copies": self.cow_copies,
+                "prefix_evictions": self.prefix_evictions,
+            }
+
+
 _registry: dict[str, ServeStats] = {}
+_kv_registry: dict[str, KVCacheStats] = {}
 _registry_lock = threading.Lock()
 
 
@@ -124,22 +194,46 @@ def serve_stats(name: str, depth_fn=None) -> ServeStats:
         return stats
 
 
+def kv_stats(name: str, blocks_in_use_fn=None, blocks_total: int | None = None
+             ) -> KVCacheStats:
+    """Get-or-create the KV-cache stats block for `name` (same contract as
+    :func:`serve_stats`: counters stay monotonic across pool restarts)."""
+    with _registry_lock:
+        stats = _kv_registry.get(name)
+        if stats is None:
+            stats = _kv_registry[name] = KVCacheStats(
+                name, blocks_in_use_fn, blocks_total or 0
+            )
+        else:
+            if blocks_in_use_fn is not None:
+                stats._blocks_in_use_fn = blocks_in_use_fn
+            if blocks_total is not None:
+                stats.blocks_total = blocks_total
+        return stats
+
+
 def all_stats() -> list[ServeStats]:
     with _registry_lock:
         return list(_registry.values())
+
+
+def all_kv_stats() -> list[KVCacheStats]:
+    with _registry_lock:
+        return list(_kv_registry.values())
 
 
 def reset_registry() -> None:
     """Test hook: drop all registered stats blocks."""
     with _registry_lock:
         _registry.clear()
+        _kv_registry.clear()
 
 
 def render_prometheus_lines() -> list[str]:
     """Prometheus text-format lines, appended to MetricsServer.render()."""
     stats = all_stats()
     if not stats:
-        return []
+        return _render_kv_lines()
     lines = [
         "# TYPE pathway_serve_queue_depth gauge",
         "# TYPE pathway_serve_admitted_total counter",
@@ -180,6 +274,43 @@ def render_prometheus_lines() -> list[str]:
             f"pathway_serve_time_in_queue_seconds_total{{{lbl}}} "
             f"{snap['time_in_queue_s']:.6f}"
         )
+    lines.extend(_render_kv_lines())
+    return lines
+
+
+def _render_kv_lines() -> list[str]:
+    """Paged KV-cache pool occupancy / prefix-sharing / preemption lines."""
+    stats = all_kv_stats()
+    if not stats:
+        return []
+    lines = [
+        "# TYPE pathway_kv_blocks_in_use gauge",
+        "# TYPE pathway_kv_blocks_total gauge",
+        "# TYPE pathway_kv_prefix_hit_total counter",
+        "# TYPE pathway_kv_prefix_miss_total counter",
+        "# TYPE pathway_kv_preemptions_total counter",
+        "# TYPE pathway_kv_cow_copies_total counter",
+        "# TYPE pathway_kv_prefix_evictions_total counter",
+    ]
+    for s in stats:
+        snap = s.snapshot()
+        lbl = f'pool="{s.name}"'
+        lines.append(f"pathway_kv_blocks_in_use{{{lbl}}} {snap['blocks_in_use']}")
+        lines.append(f"pathway_kv_blocks_total{{{lbl}}} {snap['blocks_total']}")
+        lines.append(f"pathway_kv_prefix_hit_total{{{lbl}}} {snap['prefix_hits']}")
+        lines.append(
+            f"pathway_kv_prefix_miss_total{{{lbl}}} {snap['prefix_misses']}"
+        )
+        lines.append(
+            f"pathway_kv_preemptions_total{{{lbl}}} {snap['preemptions']}"
+        )
+        lines.append(
+            f"pathway_kv_cow_copies_total{{{lbl}}} {snap['cow_copies']}"
+        )
+        lines.append(
+            f"pathway_kv_prefix_evictions_total{{{lbl}}} "
+            f"{snap['prefix_evictions']}"
+        )
     return lines
 
 
@@ -207,6 +338,18 @@ def otlp_points(now_ns: str) -> list[dict]:
                     {"key": "scheduler", "value": {"stringValue": s.name}},
                     {"key": "counter", "value": {"stringValue": "shed"}},
                     {"key": "reason", "value": {"stringValue": reason}},
+                ],
+            })
+    for s in all_kv_stats():
+        snap = s.snapshot()
+        for key in ("prefix_hits", "prefix_misses", "preemptions",
+                    "cow_copies", "prefix_evictions", "blocks_in_use"):
+            points.append({
+                "asInt": str(snap[key]),
+                "timeUnixNano": now_ns,
+                "attributes": [
+                    {"key": "pool", "value": {"stringValue": s.name}},
+                    {"key": "counter", "value": {"stringValue": key}},
                 ],
             })
     return points

@@ -284,6 +284,9 @@ class RequestScheduler:
             while self._heap and len(batch) < self.max_batch_size:
                 batch.append(heapq.heappop(self._heap))
             self._cond.notify_all()  # space freed for blocked submitters
+        return self._shed_stale(batch)
+
+    def _shed_stale(self, batch: list[_Waiter]) -> list[_Waiter]:
         # shed anything already over deadline or abandoned — before the
         # device call, so expired work never occupies a batch slot
         now = time.monotonic()
@@ -302,6 +305,39 @@ class RequestScheduler:
             else:
                 live.append(w)
         return live
+
+    # -- step-boundary admission (continuous batching for steppable tiers) --
+    def poll_inflight(self, max_n: int) -> list[_Waiter]:
+        """Pop up to ``max_n`` queued waiters for admission into an
+        IN-FLIGHT batch at a step boundary — the continuous-batching hook
+        for steppable execution tiers (kvcache/engine.py admits new
+        sequences between decode steps instead of waiting for the whole
+        batch to drain).  Deadline/cancel shedding applies exactly as in
+        normal batch formation.  The caller owns completion: finish each
+        returned waiter with :meth:`complete_inflight` /
+        :meth:`fail_inflight`."""
+        if max_n <= 0:
+            return []
+        with self._cond:
+            popped: list[_Waiter] = []
+            while self._heap and len(popped) < max_n:
+                popped.append(heapq.heappop(self._heap))
+            if popped:
+                self._cond.notify_all()  # space freed for blocked submitters
+        return self._shed_stale(popped)
+
+    def complete_inflight(self, waiter: _Waiter, result: Any) -> None:
+        """Deliver a result for a waiter obtained via :meth:`poll_inflight`."""
+        waiter.result = result
+        waiter.event.set()
+        self.stats.record_completed()
+
+    def fail_inflight(self, waiter: _Waiter, error: BaseException) -> None:
+        # like _execute's error path, a failed request is neither a
+        # completion nor a shed: the admitted-vs-(completed+shed) gap is
+        # the error count
+        waiter.error = error
+        waiter.event.set()
 
     def _pad(self, payloads: list) -> list:
         if self.size_buckets is None or not payloads:
@@ -335,6 +371,13 @@ class RequestScheduler:
         self.stats.record_batch(n, sum(t0 - w.enqueued for w in batch))
         completed = 0
         for w, r in zip(batch, results):
+            if isinstance(r, BaseException):
+                # batch_fn may return a per-item exception (e.g. one
+                # undecodable request in a paged decode batch) — fail just
+                # that caller instead of poisoning the whole batch
+                w.error = r
+                w.event.set()
+                continue
             w.result = r
             w.event.set()
             # mid-execution detaches still count as completed: the device

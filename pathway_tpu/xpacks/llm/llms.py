@@ -69,6 +69,32 @@ class JaxChat(BaseChat):
             prompt, max_new_tokens=kwargs.get("max_tokens", self.max_new_tokens)
         )
 
+    def paged_engine(self):
+        """The paged KV decode engine behind :meth:`generate_batch`, or
+        None when it cannot be built — question_answering.py probes this
+        to size the llm scheduler's batches (kvcache/engine.py)."""
+        return self._lm.paged_engine()
+
+    def generate_batch(self, message_batches: list, **kwargs) -> list[str]:
+        """Answer a whole coalesced batch in ONE decode-tier pass through
+        the paged KV cache (mixed lengths, shared-prefix blocks mapped to
+        the same physical blocks); serial fallback when the engine is
+        unavailable."""
+        prompts = []
+        for messages in message_batches:
+            if isinstance(messages, str):
+                messages = prompt_chat_single_qa(messages)
+            elif hasattr(messages, "value"):
+                messages = messages.value
+            prompts.append("\n".join(
+                f"{m.get('role', 'user')}: {m.get('content', '')}"
+                for m in messages
+            ))
+        return self._lm.generate_batch(
+            prompts,
+            max_new_tokens=kwargs.get("max_tokens", self.max_new_tokens),
+        )
+
 
 class OpenAIChat(BaseChat):
     def __init__(self, model: str = "gpt-4o-mini", *, api_key: str | None = None,

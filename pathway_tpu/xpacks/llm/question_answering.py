@@ -20,6 +20,24 @@ from .document_store import DocumentStore
 
 _NO_ANSWER = "No information found."
 
+_warned_serial: set = set()
+
+
+def _warn_serial_decode(llm, why: str) -> None:
+    """One warning per llm class when llm_scheduler=True cannot batch the
+    decode tier (max_batch_size stays 1 / decode stays serial) — silent
+    degradation here hides an 8x serving-throughput loss."""
+    key = type(llm).__name__
+    if key in _warned_serial:
+        return
+    _warned_serial.add(key)
+    import logging
+
+    logging.getLogger(__name__).warning(
+        "llm_scheduler=True with %s: %s (see kvcache/engine.py for the "
+        "batched paged-KV decode path)", key, why,
+    )
+
 
 def _prompt(docs: list[str], query: str) -> str:
     ctx = "\n\n".join(docs)
@@ -118,11 +136,38 @@ class BaseRAGQuestionAnswerer:
                 batch = getattr(llm, "generate_batch", None) or getattr(
                     llm, "batch", None
                 )
-                batch_fn = batch if callable(batch) else (
-                    lambda items: [llm(i) for i in items]
-                )
+                if callable(batch):
+                    batch_fn = batch
+                    # a paged KV engine behind the batch entry point means
+                    # the whole coalesced batch decodes in ONE device pass
+                    # (kvcache/engine.py) — size the scheduler's batches to
+                    # what the engine actually steps together
+                    max_bs = 8
+                    probe = getattr(llm, "paged_engine", None)
+                    if callable(probe):
+                        try:
+                            engine = probe()
+                        except Exception:  # noqa: BLE001 - probe only
+                            engine = None
+                        if engine is not None:
+                            max_bs = max(int(engine.max_batch_size), 2)
+                        else:
+                            _warn_serial_decode(
+                                llm, "its paged KV engine is unavailable; "
+                                "batches coalesce but decode serially"
+                            )
+                else:
+                    # no batch entry point at all: the scheduler still
+                    # provides admission/priority semantics, but each item
+                    # is a separate llm call — don't pretend otherwise
+                    batch_fn = lambda items: [llm(i) for i in items]  # noqa: E731
+                    max_bs = 1
+                    _warn_serial_decode(
+                        llm, "it exposes no generate_batch/batch entry "
+                        "point; falling back to serial decode"
+                    )
                 llm_scheduler = RequestScheduler(
-                    batch_fn, name="llm", max_batch_size=8,
+                    batch_fn, name="llm", max_batch_size=max_bs,
                     batch_linger_ms=5.0,
                 )
             self._llm_scheduler = llm_scheduler

@@ -24,6 +24,14 @@ if "jax" in __import__("sys").modules:
 import pytest
 
 
+def pytest_configure(config):
+    # tier-1 runs `-m 'not slow'` (ROADMAP.md); register the mark so slow
+    # variants (e.g. interpreted Pallas kernels) don't warn
+    config.addinivalue_line(
+        "markers", "slow: excluded from the tier-1 budgeted run"
+    )
+
+
 @pytest.fixture(autouse=True)
 def clear_parse_graph():
     """Reference parity: autouse fixture clears the global ParseGraph after
