@@ -95,19 +95,29 @@ class MetricsServer:
         except Exception:
             kv_snaps = []
         if kv_snaps:
+            def _ttft_p50_ms(s):
+                recent = sorted(s.get("recent_ttfts") or ())
+                if not recent:
+                    return "-"
+                return f"{recent[len(recent) // 2] * 1e3:.1f}"
+
             kv_rows = "".join(
                 f"<tr><td>{s['name']}</td>"
                 f"<td>{s['blocks_in_use']}/{s['blocks_total']}</td>"
                 f"<td>{s['prefix_hits']}/{s['prefix_hits'] + s['prefix_misses']}</td>"
                 f"<td>{s['preemptions']}</td><td>{s['cow_copies']}</td>"
-                f"<td>{s['prefix_evictions']}</td></tr>"
+                f"<td>{s['prefix_evictions']}</td>"
+                f"<td>{s.get('prefill_chunks', 0)}</td>"
+                f"<td>{s.get('mixed_step_occupancy_avg', 0.0):.2f}</td>"
+                f"<td>{_ttft_p50_ms(s)}</td></tr>"
                 for s in kv_snaps
             )
             kv_html = (
                 "<h3>kv cache</h3><table><tr><th>pool</th>"
                 "<th>blocks</th><th>prefix hit/lookup</th>"
-                "<th>preempt</th><th>cow</th>"
-                f"<th>evict</th></tr>{kv_rows}</table>"
+                "<th>preempt</th><th>cow</th><th>evict</th>"
+                "<th>chunks</th><th>mixed occ</th>"
+                f"<th>ttft p50 ms</th></tr>{kv_rows}</table>"
             )
         return (
             "<html><head><title>pathway-tpu</title>"

@@ -11,6 +11,14 @@ generation engine (engine.py) that admits new sequences into in-flight
 decode batches at step boundaries and preempts-with-recompute when the
 pool is exhausted.
 
+Round-8 (ARCHITECTURE.md "Round-8: Ragged fused-step decode") makes one
+engine step one device program over a ragged mixed batch: prompts stream
+in as block-aligned chunks through the token-packed fused step
+(models/decoder.paged_mixed_step) instead of per-admission whole-bucket
+prefills, greedy argmax runs inside the jitted step (only [B] int32 ids
+cross to host per round), and the Pallas kernel's grid is length-aware
+(blocks past a row's context are neither DMA'd nor computed).
+
 Kernel shape follows Ragged Paged Attention (arxiv 2604.15464); the
 managed-resource framing follows arxiv 2603.09555.
 """

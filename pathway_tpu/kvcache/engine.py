@@ -5,37 +5,50 @@ pinned to ``max_batch_size=1`` because the KV cache was per-instance
 mutable state.  Here the cache is the shared BlockPool, so the engine
 decodes MANY sequences per device step:
 
-- admission: a request's prompt is matched against the prefix cache
-  (shared leading blocks are mapped instead of re-STORED — prefill
-  compute still runs over the full bucket, but its scatter skips the
-  shared blocks, whose K/V is already resident; the win is HBM blocks,
-  not prefill FLOPs), fresh blocks are allocated, and the prompt runs
-  one :func:`~pathway_tpu.models.decoder.paged_prefill` at its length
-  bucket;
-- decode: every running sequence advances one token per
-  :func:`~pathway_tpu.models.decoder.paged_decode_step` call — one device
-  dispatch serves the whole batch, with per-sequence positions/block
-  tables (the dense path's one-scalar-position design is what forced
-  batch 1);
+- admission (Round-8, chunked): a request's prompt is matched against
+  the prefix cache (shared leading blocks are mapped instead of
+  re-stored AND re-computed — chunked prefill starts after them), fresh
+  blocks are allocated for the remainder, and the prompt then streams
+  through the RAGGED fused step in block-aligned chunks
+  (:func:`~pathway_tpu.models.decoder.paged_mixed_step`): each engine
+  step carries the in-flight decode rows (1 token each) plus one
+  ``prefill_chunk``-token chunk per admitting sequence in ONE dispatch,
+  so a 1k-token arrival never stalls running decodes behind a
+  monolithic whole-bucket prefill (head-of-line blocking at step
+  boundaries).  ``chunked_prefill=False`` restores the Round-7
+  whole-bucket admission prefill (the bench baseline);
+- decode: every running sequence advances one token per dispatch with
+  per-sequence positions/block tables (the dense path's
+  one-scalar-position design is what forced batch 1).  Rounds with no
+  chunk in flight dispatch the cheap 1-token-per-row program; rounds
+  with admissions dispatch the mixed program — two static shapes total,
+  compiled once each (no per-bucket prefill ladder in chunked mode);
+- device-side sampling: greedy argmax runs INSIDE the jitted step; only
+  ``[B]`` int32 token ids cross the device->host boundary per round
+  (the done-mask is a host compare on those ids), shrinking the
+  per-token sync by ~vocab x vs shipping ``[B, vocab]`` logits;
 - continuous batching: between steps the engine polls its scheduler for
   new arrivals and admits them into the in-flight batch (step-boundary
-  admission, serve/scheduler.py `poll_inflight`);
+  admission, serve/scheduler.py `poll_inflight`).  N same-round
+  arrivals ride the SAME mixed dispatch — their first tokens all come
+  from that dispatch's device-side argmax, one dispatch, not N;
 - preemption: when the pool is exhausted, refcount-0 prefix blocks are
   evicted first; if that is not enough a victim sequence (lowest
-  priority class, most recent arrival) is preempted — blocks freed,
-  request re-queued — and later re-admitted by recompute-prefill over
-  ``prompt + tokens_emitted_so_far`` (token-identical to never having
-  been preempted: the recomputed prefill's next-token logits equal the
-  decode path's).
+  priority class, most recent arrival — mid-prefill sequences
+  included) is preempted — blocks freed, request re-queued — and later
+  re-admitted by recompute-prefill over ``prompt + tokens_emitted_so_
+  far`` (token-identical to never having been preempted: the
+  recomputed prefill's next-token logits equal the decode path's).
 
-Shapes are static per compile: decode steps are padded to
-``max_batch_size`` rows (idle rows write to the reserved null block) and
-prefill to the sequence-bucket ladder, per the TPU static-shape rule.
+Shapes are static per compile: steps are padded to ``max_batch_size``
+rows x ``prefill_chunk`` columns (idle rows/columns write to the
+reserved null block), per the TPU static-shape rule.
 """
 
 from __future__ import annotations
 
 import threading
+import time
 from collections import deque
 from typing import Any, Callable
 
@@ -49,7 +62,7 @@ from .prefix_cache import PrefixCache
 
 class _Request:
     __slots__ = ("prompt", "max_new", "priority", "stop_token", "emitted",
-                 "index", "on_done", "on_error")
+                 "index", "on_done", "on_error", "t_arrival")
 
     def __init__(self, prompt, max_new: int, *, priority: int = 1,
                  stop_token: int | None = None, index: int | None = None,
@@ -63,14 +76,28 @@ class _Request:
         self.index = index
         self.on_done = on_done
         self.on_error = on_error
+        self.t_arrival = time.perf_counter()
 
 
 class _Active:
-    __slots__ = ("seq_id", "req")
+    __slots__ = ("seq_id", "req", "tokens", "n_filled", "n_diverted",
+                 "prefix_keys", "wait_writer")
 
     def __init__(self, seq_id: int, req: _Request):
         self.seq_id = seq_id
         self.req = req
+        # chunked-prefill state: `tokens` is the full (trimmed) prompt
+        # still being streamed in; None once prefill completes (or for
+        # the legacy whole-bucket path, from the start)
+        self.tokens: list[int] | None = None
+        self.n_filled = 0
+        self.n_diverted = 0  # positions < this are prefix-shared blocks
+        self.prefix_keys: list | None = None
+        # set when the shared leading blocks belong to another sequence
+        # whose chunked prefill is STILL WRITING them: our chunks are
+        # gated on that writer's progress (same-dispatch writes are
+        # visible, so lockstep rows usually cost zero extra rounds)
+        self.wait_writer: "_Active | None" = None
 
 
 def build_engine(cfg, params, fallback_msg: str, logger_name: str,
@@ -97,7 +124,9 @@ class PagedDecodeEngine:
                  block_size: int = 16, max_blocks_per_seq: int | None = None,
                  max_batch_size: int = 8, seq_buckets=(64, 256, 1024),
                  prefix_sharing: bool = True, stop_token: int | None = None,
-                 attn: str | None = None, name: str = "paged_decoder"):
+                 attn: str | None = None, chunked_prefill: bool = True,
+                 prefill_chunk: int | None = None,
+                 name: str = "paged_decoder"):
         from ..models.encoder import _resolve_dtype
 
         self.cfg = cfg
@@ -129,30 +158,74 @@ class PagedDecodeEngine:
             min(-(-b // bs) * bs, bucket_cap) for b in seq_buckets
         })
         self.seq_buckets = buckets or [bucket_cap]
+        self.chunked_prefill = bool(chunked_prefill)
+        # chunk width: block-aligned (so chunk writes cover whole blocks
+        # except the prompt's tail), default two blocks per step — small
+        # enough that an arrival adds bounded latency to in-flight
+        # decodes, large enough to amortize the dispatch
+        if prefill_chunk is None:
+            prefill_chunk = 2 * bs
+        self.prefill_chunk = max(bs, min(-(-int(prefill_chunk) // bs) * bs,
+                                         bucket_cap))
+        # packed token budget of one ragged dispatch: every decode row
+        # costs one token, the rest is chunk headroom — so the mixed
+        # program's cost scales with B + chunk, never B x chunk
+        self.mixed_tokens = self.max_batch_size + self.prefill_chunk
         self._seq_counter = 0
         self._lock = threading.RLock()
+        # chain key -> (writer _Active, physical block) for blocks an
+        # in-flight chunked prefill is still writing: same-round arrivals
+        # with a common prefix map these immediately (the HBM saving and
+        # the compute skip) and lockstep their chunks behind the writer.
+        # Per-run state (reset by _run_loop); the engine lock serializes
+        # runs, so one map on self is safe
+        self._inflight_prefix: dict = {}
         _cfg = cfg
         _attn = self.attn
 
+        # device-side sampling: every step/prefill wrapper argmaxes INSIDE
+        # the jitted program, so only [B] int32 ids (not [B, vocab]
+        # logits) cross the device->host boundary per round
         def _step_fn(p, k_pool, v_pool, token, positions, bt, sb, so):
             from ..models.decoder import paged_decode_step
 
-            return paged_decode_step(
+            logits, k_pool, v_pool = paged_decode_step(
                 p, _cfg, k_pool, v_pool, token, positions, bt, sb, so,
                 attn=_attn,
             )
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32), \
+                k_pool, v_pool
+
+        def _mixed_fn(p, k_pool, v_pool, tokens, positions, row_tables,
+                      row_start, row_nvalid, row_token_idx, tok_row,
+                      tok_col, sb, so, logit_idx):
+            from ..models.decoder import paged_mixed_step
+
+            logits, k_pool, v_pool = paged_mixed_step(
+                p, _cfg, k_pool, v_pool, tokens, positions, row_tables,
+                row_start, row_nvalid, row_token_idx, tok_row, tok_col,
+                sb, so, logit_idx, attn=_attn,
+            )
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32), \
+                k_pool, v_pool
 
         def _prefill_fn(p, token_ids, n_valid, k_pool, v_pool, bt):
             from ..models.decoder import paged_prefill
 
-            return paged_prefill(
+            logits, k_pool, v_pool = paged_prefill(
                 p, _cfg, token_ids, n_valid, k_pool, v_pool, bt
             )
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32), \
+                k_pool, v_pool
 
-        # pools donated: every step/prefill consumes them in place.
-        # jit specializes per (1, bucket) token shape, so one wrapper
-        # covers the whole bucket ladder
+        # pools donated: every step/prefill consumes them in place.  Two
+        # static shapes cover the whole workload in chunked mode — the
+        # (B,) decode program and the (B, prefill_chunk) mixed program —
+        # so a bucket-ladder workload compiles exactly twice (pinned by
+        # tests/test_ragged_step.py's recompile guard); the legacy
+        # whole-bucket prefill specializes per (1, bucket) as before
         self._step = jax.jit(_step_fn, donate_argnums=(1, 2))
+        self._mixed = jax.jit(_mixed_fn, donate_argnums=(1, 2))
         self._prefill = jax.jit(_prefill_fn, donate_argnums=(3, 4))
 
     # -- public API --------------------------------------------------------
@@ -267,9 +340,11 @@ class PagedDecodeEngine:
     # -- main loop ---------------------------------------------------------
     def _run_loop(self, pending, deliver, poll, stop):
         running: list[_Active] = []
+        self._inflight_prefix.clear()
         try:
             self._loop_body(running, pending, deliver, poll, stop)
         except BaseException as exc:
+            self._inflight_prefix.clear()
             # fail EVERYTHING still in flight before propagating: requests
             # admitted via poll_inflight are owned by this engine, and
             # leaving their waiters unset would hang submit() callers
@@ -309,7 +384,7 @@ class PagedDecodeEngine:
                 # _try_admit only returns "wait" while others run, and the
                 # admission loop above drains pending otherwise
                 break
-            self._decode_round(running, pending, deliver)
+            self._step_round(running, pending, deliver)
         return running
 
     def _readmit_len(self, req: _Request) -> int:
@@ -332,11 +407,27 @@ class PagedDecodeEngine:
         )
         pending.insert(idx, req)
 
+    def _emit(self, req: _Request, token_id: int) -> None:
+        """Record one emitted token; the FIRST token of a request closes
+        its time-to-first-token window (preemption does not reopen it —
+        a victim re-admitted mid-decode already emitted)."""
+        req.emitted.append(token_id)
+        if len(req.emitted) == 1:
+            self.pool.stats.record_ttft(
+                time.perf_counter() - req.t_arrival
+            )
+
     # -- admission ---------------------------------------------------------
     def _try_admit(self, req: _Request, running, pending, deliver) -> str:
-        """Allocate + prefill one request.  Returns "admitted", "done"
-        (finished at its first token), "failed" (undecodable — delivered as
-        an error), or "wait" (pool full while other sequences run)."""
+        """Allocate (and in legacy mode prefill) one request.  Returns
+        "admitted", "done" (finished at its first token — legacy mode
+        only), "failed" (undecodable — delivered as an error), or "wait"
+        (pool full while other sequences run).
+
+        Chunked mode allocates the sequence's blocks and queues the
+        prompt for streaming through the ragged mixed step — NO device
+        work happens at admission, so an arrival can never stall the
+        in-flight batch here."""
         if req.max_new - len(req.emitted) <= 0:
             # zero-token request: the dense path returns nothing, so must we
             deliver(req)
@@ -359,8 +450,10 @@ class PagedDecodeEngine:
         seq_id = self._seq_counter
         state = None
         attempt = 0
+        writer = None
         while state is None:
             shared, keys = ([], [])
+            writer = None
             if self.prefix is not None:
                 # sharing is safe even when it covers EVERY prompt block:
                 # full blocks are never decode-write targets (appends open
@@ -368,7 +461,33 @@ class PagedDecodeEngine:
                 # excluded from the prefill scatter below.  Only the first
                 # match records hit/miss stats — eviction retries re-match
                 # the same admission
-                shared, keys = self.prefix.match(tokens, record=attempt == 0)
+                shared, keys = self.prefix.match(
+                    tokens,
+                    record=(attempt == 0 and not self.chunked_prefill),
+                )
+                if self.chunked_prefill:
+                    # extend the match into blocks an IN-FLIGHT chunked
+                    # prefill is still writing: the physical sharing (and
+                    # compute skip) starts NOW; our chunks gate on the
+                    # writer's progress.  One writer only — chaining
+                    # across writers would need a multi-way gate for
+                    # marginal benefit
+                    for key in keys[len(shared):]:
+                        ent = self._inflight_prefix.get(key)
+                        if ent is None or (
+                            writer is not None and ent[0] is not writer
+                        ):
+                            break
+                        writer = ent[0]
+                        shared.append(ent[1])
+                    if attempt == 0:
+                        hits = len(shared)
+                        if hits:
+                            self.pool.stats.record_prefix_hit(hits)
+                        if len(keys) - hits:
+                            self.pool.stats.record_prefix_miss(
+                                len(keys) - hits
+                            )
             attempt += 1
             try:
                 state = self.pool.allocate(
@@ -392,6 +511,31 @@ class PagedDecodeEngine:
                         f"{n}-token sequence"
                     ))
                     return "failed"
+        if self.chunked_prefill:
+            act = _Active(seq_id, req)
+            act.tokens = tokens
+            # prefix-shared leading blocks need no recompute: their K/V
+            # is already (or will be, gated on the writer) resident, so
+            # chunking starts after them — the compute saving the
+            # Round-7 whole-bucket prefill could not take — but at least
+            # the prompt's LAST token must run to produce the
+            # next-token logits
+            shared_tokens = len(shared) * self.pool.block_size
+            act.n_filled = min(shared_tokens, n - 1)
+            act.n_diverted = shared_tokens
+            act.wait_writer = writer
+            # cache registration happens only when the last chunk lands
+            # (K/V written); until then our OWN unshared full blocks go
+            # into the in-flight map so same-round arrivals can share
+            # them under the progress gate
+            act.prefix_keys = keys
+            if self.prefix is not None:
+                for key, blk in zip(keys[len(shared):],
+                                    state.block_ids[len(shared):len(keys)]):
+                    self._inflight_prefix.setdefault(key, (act, blk))
+            running.append(act)
+            return "admitted"
+        # -- legacy whole-bucket prefill (chunked_prefill=False) ----------
         try:
             bucket = next(b for b in self.seq_buckets if b >= n)
             nb = bucket // self.pool.block_size
@@ -406,7 +550,7 @@ class PagedDecodeEngine:
             # perturb its remaining decode
             scatter_bt = self.pool.block_table(seq_id, nb)
             scatter_bt[: len(shared)] = 0
-            logits, self.pool.k, self.pool.v = self._prefill(
+            ids, self.pool.k, self.pool.v = self._prefill(
                 self.params, jnp.asarray(buf), jnp.asarray([n], jnp.int32),
                 self.pool.k, self.pool.v, jnp.asarray(scatter_bt[None, :]),
             )
@@ -421,8 +565,7 @@ class PagedDecodeEngine:
             # engine's (process-long) lifetime
             self.pool.free_sequence(seq_id)
             raise
-        first = int(np.argmax(np.asarray(logits[0])))
-        req.emitted.append(first)
+        self._emit(req, int(np.asarray(ids)[0]))
         act = _Active(seq_id, req)
         if self._is_done(req, seq_id):
             self.pool.free_sequence(seq_id)
@@ -439,11 +582,29 @@ class PagedDecodeEngine:
         # capacity: the next token's position must fit the table + pos_embed
         return self.pool.sequence(seq_id).n_tokens >= self.max_seq_tokens
 
-    # -- decode ------------------------------------------------------------
-    def _decode_round(self, running, pending, deliver) -> None:
-        reserved = self._reserve_slots(running, pending)
-        if not reserved:
-            return
+    # -- stepping ----------------------------------------------------------
+    def _step_round(self, running, pending, deliver) -> None:
+        """One engine step = ONE device program over the ragged in-flight
+        batch: decode rows (a reserved write slot each) plus prefill-chunk
+        runs sharing the ``mixed_tokens`` budget.  Rounds with no chunk in
+        flight dispatch the cheaper 1-token-per-row program."""
+        victims: list[_Active] = []
+        reserved = self._reserve_slots(running, pending, victims)
+        if victims:
+            # a preempted mid-prefill WRITER strands any sharer still
+            # reading through its half-written blocks — cascade those
+            # back to the queue too (recompute restores them)
+            self._cascade_preempt(victims, running, pending)
+        # chunk membership is decided AFTER slot reservation: reservation
+        # may preempt a mid-prefill sequence, which must then not be
+        # dispatched this round
+        chunks = [a for a in running if a.tokens is not None]
+        if chunks:
+            self._mixed_round(reserved, chunks, running, deliver)
+        elif reserved:
+            self._decode_round(reserved, running, deliver)
+
+    def _decode_round(self, reserved, running, deliver) -> None:
         B = self.max_batch_size
         NB = self.max_blocks_per_seq
         token = np.zeros(B, np.int32)
@@ -458,31 +619,214 @@ class PagedDecodeEngine:
             sb[i] = blk
             so[i] = off
             bt[i, : len(seq.block_ids)] = seq.block_ids
-        logits, self.pool.k, self.pool.v = self._step(
+        ids, self.pool.k, self.pool.v = self._step(
             self.params, self.pool.k, self.pool.v, jnp.asarray(token),
             jnp.asarray(positions), jnp.asarray(bt), jnp.asarray(sb),
             jnp.asarray(so),
         )
-        logits = np.asarray(logits)
+        ids = np.asarray(ids)
         for i, (act, _slot) in enumerate(reserved):
-            nxt = int(np.argmax(logits[i]))
-            act.req.emitted.append(nxt)
+            self._emit(act.req, int(ids[i]))
             if self._is_done(act.req, act.seq_id):
                 running.remove(act)
                 self.pool.free_sequence(act.seq_id)
                 deliver(act.req)
 
-    def _reserve_slots(self, running, pending
+    def _mixed_round(self, reserved, chunks, running, deliver) -> None:
+        """The ragged fused step over a token-PACKED stream: decode rows
+        contribute one token each, chunk rows a run of prompt tokens,
+        sharing a ``mixed_tokens`` budget — so the dispatch's cost scales
+        with the live token count (B + chunk headroom), never
+        B x chunk.  One dispatch serves both kinds; only the [B]
+        argmaxed ids come back."""
+        B = self.max_batch_size
+        C = self.prefill_chunk
+        T = self.mixed_tokens
+        NB = self.max_blocks_per_seq
+        bs = self.pool.block_size
+        tokens = np.zeros(T, np.int32)
+        positions = np.zeros(T, np.int32)
+        sb = np.zeros(T, np.int32)
+        so = np.zeros(T, np.int32)
+        row_tables = np.zeros((B, NB), np.int32)
+        row_start = np.zeros(B, np.int32)
+        row_nvalid = np.ones(B, np.int32)
+        row_token_idx = np.zeros((B, C), np.int32)
+        tok_row = np.zeros(T, np.int32)
+        tok_col = np.zeros(T, np.int32)
+        logit_idx = np.zeros(B, np.int32)
+        rows: list[tuple[_Active, int, int]] = []  # (act, row, n_filled|-1)
+        t = 0
+        row = 0
+        for act, (blk, off) in reserved:
+            seq = self.pool.sequence(act.seq_id)
+            tokens[t] = act.req.emitted[-1]
+            positions[t] = seq.n_tokens - 1  # append_slot already advanced
+            sb[t] = blk
+            so[t] = off
+            row_tables[row, : len(seq.block_ids)] = seq.block_ids
+            row_start[row] = positions[t]
+            row_token_idx[row, :] = t  # one valid column
+            tok_row[t] = row
+            logit_idx[row] = t
+            rows.append((act, row, -1))
+            t += 1
+            row += 1
+        proj: dict[int, int] = {}  # this round's projected n_filled
+        for act in chunks:
+            budget = T - t
+            if budget <= 0 or row >= B:
+                break  # later chunks wait a round (FIFO — no starvation)
+            seq = self.pool.sequence(act.seq_id)
+            s = act.n_filled
+            e = min(s + C, len(act.tokens), s + budget)
+            w = act.wait_writer
+            if w is not None:
+                if w.tokens is None:
+                    # the writer finished: the whole shared region is
+                    # resident, the gate is moot forever after
+                    act.wait_writer = None
+                else:
+                    # our queries up to e read every position < min(e,
+                    # n_diverted) of the shared region; the writer must
+                    # have written them by THIS dispatch (its same-round
+                    # run counts: per layer, all T tokens' K/V scatters
+                    # land before any token's attention gathers)
+                    wp = proj.get(id(w), w.n_filled)
+                    if min(e, act.n_diverted) > wp:
+                        e = min(e, wp)
+                    if e <= s:
+                        continue  # no safe progress: writer lags a round
+            nv = e - s
+            pos = np.arange(s, e)
+            tokens[t:t + nv] = act.tokens[s:e]
+            positions[t:t + nv] = pos
+            blocks = np.asarray(seq.block_ids, np.int32)
+            # prefix-shared leading blocks already hold the right K/V:
+            # divert their writes to the null block — a live sequence may
+            # be attending through them right now (same rule as the
+            # legacy whole-bucket scatter); the gather still READS the
+            # shared blocks' resident bytes through the table
+            sb[t:t + nv] = np.where(pos < act.n_diverted, 0,
+                                    blocks[pos // bs])
+            so[t:t + nv] = pos % bs
+            row_tables[row, : len(seq.block_ids)] = seq.block_ids
+            row_start[row] = s
+            row_nvalid[row] = nv
+            run = np.arange(t, t + nv)
+            row_token_idx[row, :nv] = run
+            row_token_idx[row, nv:] = t + nv - 1  # pad cols: masked anyway
+            tok_row[run] = row
+            tok_col[run] = np.arange(nv)
+            logit_idx[row] = t + nv - 1
+            rows.append((act, row, e))
+            proj[id(act)] = e
+            t += nv
+            row += 1
+        if not rows:
+            # unreachable by construction: gate dependencies are acyclic
+            # and rooted at an ungated writer, so at least one chunk run
+            # always dispatches — fail loudly rather than spin
+            raise RuntimeError(
+                "ragged step produced no rows (gated chunk cycle?)"
+            )
+        ids, self.pool.k, self.pool.v = self._mixed(
+            self.params, self.pool.k, self.pool.v, jnp.asarray(tokens),
+            jnp.asarray(positions), jnp.asarray(row_tables),
+            jnp.asarray(row_start), jnp.asarray(row_nvalid),
+            jnp.asarray(row_token_idx), jnp.asarray(tok_row),
+            jnp.asarray(tok_col), jnp.asarray(sb), jnp.asarray(so),
+            jnp.asarray(logit_idx),
+        )
+        ids = np.asarray(ids)
+        self.pool.stats.record_mixed_step(len(rows))
+        self.pool.stats.record_prefill_chunks(
+            sum(1 for _a, _r, f in rows if f >= 0)
+        )
+        for act, row, filled in rows:
+            if filled < 0:  # decode row
+                self._emit(act.req, int(ids[row]))
+            else:
+                act.n_filled = filled
+                if filled < len(act.tokens):
+                    continue  # mid-prefill: this row's logits are garbage
+                # prefill complete — register the prompt's full blocks for
+                # sharing only NOW that their K/V is actually written
+                # (registering at admission would hand still-empty blocks
+                # to a concurrent request), then emit the first token from
+                # the dispatch's device-side argmax
+                if self.prefix is not None and act.prefix_keys:
+                    self.prefix.insert(
+                        act.prefix_keys,
+                        self.pool.sequence(act.seq_id).block_ids,
+                    )
+                self._drop_inflight_keys(act)
+                act.tokens = None
+                act.prefix_keys = None
+                self._emit(act.req, int(ids[row]))
+            if self._is_done(act.req, act.seq_id):
+                running.remove(act)
+                self.pool.free_sequence(act.seq_id)
+                deliver(act.req)
+
+    def _drop_inflight_keys(self, act: _Active) -> None:
+        """Remove `act`'s registrations from the in-flight prefix map
+        (prefill completed -> the cache owns them now; or preempted ->
+        they are gone)."""
+        if self._inflight_prefix:
+            self._inflight_prefix = {
+                k: v for k, v in self._inflight_prefix.items()
+                if v[0] is not act
+            }
+
+    def _cascade_preempt(self, victims, running, pending) -> None:
+        """A preempted mid-prefill writer strands every sharer whose
+        shared region it had not finished writing: requeue those for
+        recompute too (transitively — a sharer can itself be a writer
+        for its unshared tail).  Safety is judged by the WRITER's
+        progress, not the sharer's: a sharer starts with ``n_filled ==
+        n_diverted`` (chunking begins after the shared region) yet has
+        read nothing until its first chunk runs.  Once the writer wrote
+        past ``n_diverted`` (or finished prefill entirely), the region
+        is resident and the sharer's own references keep those blocks
+        alive regardless of the writer's fate."""
+        queue = list(victims)
+        while queue:
+            w = queue.pop()
+            self._drop_inflight_keys(w)
+            for act in list(running):
+                if act.wait_writer is not w:
+                    continue
+                if w.tokens is None or w.n_filled >= act.n_diverted \
+                        or act.tokens is None:
+                    # region fully written (a completed sharer implies it
+                    # too — its gate required the writer to pass the
+                    # region before the last chunk could run)
+                    act.wait_writer = None
+                else:
+                    running.remove(act)
+                    self.pool.free_sequence(act.seq_id)
+                    self.pool.stats.record_preemption()
+                    self._requeue(pending, act.req)
+                    queue.append(act)
+
+    def _reserve_slots(self, running, pending, victims=None
                        ) -> list[tuple[_Active, tuple[int, int]]]:
-        """Reserve one write slot per running sequence, resolving pool
+        """Reserve one write slot per running DECODE sequence (mid-prefill
+        sequences own their blocks already and need none), resolving pool
         exhaustion by prefix eviction first, preemption second.  Victims
         are only taken from sequences that have NOT yet reserved this
-        round (a reserved slot is already in the outgoing device arrays)."""
+        round (a reserved slot is already in the outgoing device arrays);
+        mid-prefill sequences are legitimate victims — their recompute
+        re-streams the same chunks."""
         reserved: list[tuple[_Active, tuple[int, int]]] = []
         survivors = list(running)
         idx = 0
         while idx < len(survivors):
             act = survivors[idx]
+            if act.tokens is not None:
+                idx += 1  # mid-prefill: no decode slot this round
+                continue
             try:
                 slot = self.pool.append_slot(act.seq_id)
             except PoolExhausted:
@@ -515,6 +859,8 @@ class PagedDecodeEngine:
                     continue
                 survivors.remove(vact)
                 running.remove(vact)
+                if victims is not None:
+                    victims.append(vact)
                 # preemption-with-recompute: the request rejoins the queue
                 # carrying its emitted tokens; re-admission prefills over
                 # prompt + emitted (the last emitted token's K/V was never

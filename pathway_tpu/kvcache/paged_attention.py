@@ -1,4 +1,4 @@
-"""Paged decode attention: single-query attention through a block table.
+"""Ragged paged attention: multi-query attention through a block table.
 
 Two tiers with one contract:
 
@@ -7,13 +7,35 @@ Two tiers with one contract:
   einsum strings and masking EXACTLY, so when the gathered context length
   (``num_table_blocks * block_size``) equals the dense path's cache
   length, the logits are bit-identical to the dense batch-1 decode — the
-  token-identity guarantee tests/test_paged_decode.py pins.
+  token-identity guarantee tests/test_kvcache.py pins.
 - a Pallas TPU kernel (Ragged-Paged-Attention shape, arxiv 2604.15464):
   the block table rides in scalar-prefetch SMEM so each grid step DMAs
   one physical KV block straight into VMEM — the (B, L, H, D) gathered
   copy the reference path materializes in HBM never exists.  Online
   softmax is carried in VMEM scratch across the (sequential, innermost)
   block dimension, same (m, l, acc) recurrence as ops/attention_pallas.py.
+
+Round-8 raggedness (the fused mixed decode/prefill step):
+
+- every row carries ``C >= 1`` query tokens at CONSECUTIVE positions —
+  decode rows use C=1, prefill-chunk rows up to the chunk width.  Query
+  column ``c`` of row ``b`` attends to ``start_pos[b] + c + 1`` tokens
+  (its own position included), clamped at the row's true context
+  ``start_pos[b] + n_valid[b]`` for padding columns past ``n_valid``.
+- the grid is length-aware: blocks past a row's context are neither
+  DMA'd (the scalar-prefetched index map clamps to the row's last valid
+  block, and Pallas elides the copy when the block index repeats) nor
+  computed (``@pl.when`` guards), and the output is written at the
+  row's LAST VALID block instead of the grid edge — a 1-block row in a
+  64-block table costs one block of work, not 64.
+
+Contract: every row must attend to AT LEAST one token
+(``context_lens >= C`` in the consecutive form, ``start_pos >= 0`` and
+``n_valid >= 1`` in the ragged form).  A zero-length row would produce
+an all-masked softmax — NaNs from ``0/0`` in the reference path — so
+both entry points fail loudly on concrete (non-traced) violations
+instead of letting NaNs propagate; idle batch rows must be padded to
+context 1 against the null block (the engine does).
 
 Pool layout: ``(num_blocks, block_size, n_heads, head_dim)`` per layer
 (the per-layer slice of BlockPool's stacked arrays).
@@ -40,37 +62,102 @@ except Exception:  # pragma: no cover
     _HAVE_PALLAS = False
 
 
-def paged_attention_reference(q, k_pool, v_pool, block_tables, context_lens):
-    """Gather-based paged attention.
+def _query_context(C: int, context_lens, start_pos, n_valid):
+    """Resolve the two calling conventions to per-row ``(c0, cl_last)``:
+    column ``c`` attends to ``min(c0 + c, cl_last)`` tokens.
 
-    q: (B, 1, H, hd) single decode query per sequence;
+    - consecutive form: ``context_lens`` (B,) is the LAST column's context
+      (the decode case at C=1 — unchanged from round 7);
+    - ragged form: ``start_pos``/``n_valid`` (B,) — chunk rows whose
+      valid queries stop at ``n_valid`` (padding columns clamp).
+    """
+    if context_lens is not None:
+        cl_last = jnp.asarray(context_lens, jnp.int32)
+        c0 = cl_last - (C - 1)
+    else:
+        sp = jnp.asarray(start_pos, jnp.int32)
+        cl_last = sp + jnp.asarray(n_valid, jnp.int32)
+        c0 = sp + 1
+    return c0, cl_last
+
+
+def _require_positive_context(C: int, context_lens, start_pos, n_valid):
+    """Fail-loud ``context >= 1`` contract on CONCRETE inputs (inside a
+    jit the values are tracers and the check is skipped — the engine
+    satisfies the contract by construction, padding idle rows to context
+    1 against the null block)."""
+    def _concrete_min(x):
+        if x is None or isinstance(x, jax.core.Tracer):
+            return None
+        arr = np.asarray(x)
+        return int(arr.min()) if arr.size else None
+
+    cl = _concrete_min(context_lens)
+    if cl is not None and cl < C:
+        raise ValueError(
+            f"paged attention requires context_lens >= n_queries ({C}); "
+            f"got min {cl}. A zero-length row is an all-masked softmax "
+            "(0/0 -> NaN in the reference path) — pad idle rows to "
+            "context 1 against the null block instead."
+        )
+    nv = _concrete_min(n_valid)
+    if nv is not None and nv < 1:
+        raise ValueError(
+            f"paged attention requires n_valid >= 1 per row; got min {nv}."
+            " A zero-length row is an all-masked softmax (0/0 -> NaN in"
+            " the reference path) — pad idle rows to one null-block token."
+        )
+    sp = _concrete_min(start_pos)
+    if sp is not None and sp < 0:
+        raise ValueError(
+            f"paged attention requires start_pos >= 0; got min {sp}."
+        )
+
+
+def paged_attention_reference(q, k_pool, v_pool, block_tables,
+                              context_lens=None, *, start_pos=None,
+                              n_valid=None):
+    """Gather-based ragged paged attention.
+
+    q: (B, C, H, hd) — C consecutive query tokens per row (C=1 decode);
     k_pool/v_pool: (num_blocks, block_size, H, hd);
     block_tables: (B, NB) int32, padded with the null block;
-    context_lens: (B,) int32 — valid tokens per sequence (position + 1).
-    Returns (B, 1, H, hd).
+    context_lens: (B,) int32 — the LAST query column's context (position
+    of the last query + 1); earlier columns attend to one token less
+    each.  Alternatively pass ``start_pos``/``n_valid`` (B,) for ragged
+    rows: column ``c`` attends to ``start_pos + min(c, n_valid-1) + 1``
+    tokens (padding columns past ``n_valid`` clamp to the last valid
+    query's context — their output is garbage the caller masks).
+    Returns (B, C, H, hd).
     """
-    B = q.shape[0]
+    B, C = q.shape[:2]
+    _require_positive_context(C, context_lens, start_pos, n_valid)
     NB = block_tables.shape[1]
     BS, H, hd = k_pool.shape[1:]
+    c0, cl_last = _query_context(C, context_lens, start_pos, n_valid)
+    # per-(row, column) context: min(c0 + c, cl_last)
+    ctx = jnp.minimum(c0[:, None] + jnp.arange(C)[None, :], cl_last[:, None])
     k = k_pool[block_tables].reshape(B, NB * BS, H, hd)
     v = v_pool[block_tables].reshape(B, NB * BS, H, hd)
     # decode_step's exact math: same einsum strings, mask, f32 softmax
     scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(hd)
     valid = (
-        jnp.arange(NB * BS)[None, :] < context_lens[:, None]
-    )[:, None, None, :]
+        jnp.arange(NB * BS)[None, None, :] < ctx[:, :, None]
+    )[:, None, :, :]
     scores = jnp.where(valid, scores, _NEG)
     probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(q.dtype)
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
 
 
-def _paged_kernel(bt_ref, cl_ref, q_ref, k_ref, v_ref, o_ref,
-                  m_ref, l_ref, acc_ref, *, nb: int, block_size: int,
-                  scale: float):
+def _paged_kernel(bt_ref, c0_ref, cl_ref, q_ref, k_ref, v_ref, o_ref,
+                  m_ref, l_ref, acc_ref, *, block_size: int, scale: float):
     """Grid: (B, NB) — blocks innermost, so (m, l, acc) scratch carries the
-    online softmax across one sequence's blocks.  Blocks: q/o (H, Dp);
-    k/v (block_size, H, Dp) — the physical block the scalar-prefetched
-    table maps grid step j to."""
+    online softmax across one sequence's blocks.  Blocks: q (C, H, Dp);
+    o (H, C, Dp); k/v (block_size, H, Dp) — the physical block the
+    scalar-prefetched table maps grid step j to.  Blocks past the row's
+    context (``j > jlast``) are dead: the index map pins their DMA to the
+    last valid block (Pallas elides the repeated copy) and every
+    ``@pl.when`` below is false, so they cost nothing."""
     b = pl.program_id(0)
     j = pl.program_id(1)
 
@@ -80,89 +167,105 @@ def _paged_kernel(bt_ref, cl_ref, q_ref, k_ref, v_ref, o_ref,
         l_ref[:] = jnp.zeros_like(l_ref)
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
-    ctx = cl_ref[b]
+    c0 = c0_ref[b]       # column 0's context length
+    ctx = cl_ref[b]      # the row's full context (last valid column's)
+    jlast = (ctx - 1) // block_size  # last block holding attended tokens
 
-    @pl.when(j * block_size < ctx)  # skip blocks wholly past the context
+    @pl.when(j <= jlast)  # skip blocks wholly past the context
     def _visible():
-        qb = q_ref[:]  # (H, Dp)
+        qb = q_ref[:]  # (C, H, Dp)
         kb = k_ref[:]  # (BS, H, Dp)
-        # per-head dot: batch over H, contract Dp -> (H, BS)
+        # per-head dot: batch over H, contract Dp -> (H, C, BS)
         s = jax.lax.dot_general(
             qb, kb,
-            dimension_numbers=(((1,), (2,)), ((0,), (1,))),
+            dimension_numbers=(((2,), (2,)), ((1,), (1,))),
             preferred_element_type=jnp.float32,
         ) * scale
         k_pos = j * block_size + jax.lax.broadcasted_iota(
-            jnp.int32, s.shape, 1
+            jnp.int32, s.shape, 2
         )
-        valid = k_pos < ctx
+        # column c attends to min(c0 + c, ctx) tokens
+        col_ctx = jnp.minimum(
+            c0 + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1), ctx
+        )
+        valid = k_pos < col_ctx
         s = jnp.where(valid, s, _NEG)
-        m_prev = m_ref[:, :1]  # (H, 1)
-        m_cur = jnp.max(s, axis=1, keepdims=True)
+        m_prev = m_ref[:, :, :1]  # (H, C, 1)
+        m_cur = jnp.max(s, axis=2, keepdims=True)
         m_new = jnp.maximum(m_prev, m_cur)
         p = jnp.exp(s - m_new)
         p = jnp.where(valid, p, 0.0)
         corr = jnp.exp(m_prev - m_new)
         l_ref[:] = jnp.broadcast_to(
-            l_ref[:, :1] * corr + jnp.sum(p, axis=1, keepdims=True),
+            l_ref[:, :, :1] * corr + jnp.sum(p, axis=2, keepdims=True),
             l_ref.shape,
         )
         acc_ref[:] = acc_ref[:] * corr + jax.lax.dot_general(
             p.astype(v_ref.dtype), v_ref[:],
-            dimension_numbers=(((1,), (0,)), ((0,), (1,))),
+            dimension_numbers=(((2,), (0,)), ((0,), (1,))),
             preferred_element_type=jnp.float32,
         )
         m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
 
-    @pl.when(j == nb - 1)
+    # write at the row's LAST VALID block, not the grid edge: later grid
+    # steps touch nothing, and the (per-row) output block flushes when
+    # the grid leaves row b
+    @pl.when(j == jlast)
     def _final():
-        denom = jnp.maximum(l_ref[:, :1], 1e-20)
+        denom = jnp.maximum(l_ref[:, :, :1], 1e-20)
         o_ref[:] = (acc_ref[:] / denom).astype(o_ref.dtype)
 
 
 @functools.partial(jax.jit, static_argnames=("d_true", "interpret"))
-def _paged_bhd(q, k_pool, v_pool, block_tables, context_lens, *,
-               d_true: int, interpret: bool = False):
-    """q: (B, H, Dp); pools (num_blocks, BS, H, Dp), Dp lane-padded."""
-    B, H, Dp = q.shape
+def _paged_ragged(q, k_pool, v_pool, block_tables, c0, cl, *,
+                  d_true: int, interpret: bool = False):
+    """q: (B, C, H, Dp); pools (num_blocks, BS, H, Dp), Dp lane-padded;
+    c0/cl: (B,) per-row column-0 / last-column context lengths."""
+    B, C, H, Dp = q.shape
     BS = k_pool.shape[1]
     NB = block_tables.shape[1]
     kernel = functools.partial(
-        _paged_kernel, nb=NB, block_size=BS, scale=1.0 / np.sqrt(d_true)
+        _paged_kernel, block_size=BS, scale=1.0 / np.sqrt(d_true)
     )
+
+    def _kv_map(b, j, bt, c0, cl):
+        # ragged grid: clamp dead steps to the row's last valid block so
+        # their DMA is elided (same index as the previous step)
+        return (bt[b, jnp.minimum(j, (cl[b] - 1) // BS)], 0, 0, 0)
+
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,  # block_tables, context_lens
+        num_scalar_prefetch=3,  # block_tables, c0, cl
         grid=(B, NB),
         in_specs=[
-            pl.BlockSpec((None, H, Dp), lambda b, j, bt, cl: (b, 0, 0)),
-            pl.BlockSpec(
-                (None, BS, H, Dp), lambda b, j, bt, cl: (bt[b, j], 0, 0, 0)
-            ),
-            pl.BlockSpec(
-                (None, BS, H, Dp), lambda b, j, bt, cl: (bt[b, j], 0, 0, 0)
-            ),
+            pl.BlockSpec((None, C, H, Dp),
+                         lambda b, j, bt, c0, cl: (b, 0, 0, 0)),
+            pl.BlockSpec((None, BS, H, Dp), _kv_map),
+            pl.BlockSpec((None, BS, H, Dp), _kv_map),
         ],
-        out_specs=pl.BlockSpec((None, H, Dp), lambda b, j, bt, cl: (b, 0, 0)),
+        out_specs=pl.BlockSpec((None, H, C, Dp),
+                               lambda b, j, bt, c0, cl: (b, 0, 0, 0)),
         scratch_shapes=[
-            pltpu.VMEM((H, 128), jnp.float32),  # m
-            pltpu.VMEM((H, 128), jnp.float32),  # l
-            pltpu.VMEM((H, Dp), jnp.float32),   # acc
+            pltpu.VMEM((H, C, 128), jnp.float32),  # m
+            pltpu.VMEM((H, C, 128), jnp.float32),  # l
+            pltpu.VMEM((H, C, Dp), jnp.float32),   # acc
         ],
     )
-    return pl.pallas_call(
+    out = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((B, H, Dp), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((B, H, C, Dp), q.dtype),
         interpret=interpret,
-    )(block_tables, context_lens, q, k_pool, v_pool)
+    )(block_tables, c0, cl, q, k_pool, v_pool)
+    return out.transpose(0, 2, 1, 3)  # (B, C, H, Dp)
 
 
-def paged_attention(q, k_pool, v_pool, block_tables, context_lens, *,
+def paged_attention(q, k_pool, v_pool, block_tables, context_lens=None, *,
+                    start_pos=None, n_valid=None,
                     use_pallas: bool | None = None,
                     interpret: bool | None = None):
     """Dispatch: Pallas kernel on TPU, gather reference elsewhere (the
-    interpreted kernel is for tests).  Same signature/shape contract as
-    :func:`paged_attention_reference`.
+    interpreted kernel is for tests).  Same signature/shape/raggedness
+    contract as :func:`paged_attention_reference`.
 
     The kernel path lane-pads head_dim to 128 on the fly — production
     pools meant to live on the kernel path should be allocated with
@@ -172,17 +275,20 @@ def paged_attention(q, k_pool, v_pool, block_tables, context_lens, *,
         use_pallas = _HAVE_PALLAS and backend == "tpu"
     if not use_pallas or not _HAVE_PALLAS:
         return paged_attention_reference(
-            q, k_pool, v_pool, block_tables, context_lens
+            q, k_pool, v_pool, block_tables, context_lens,
+            start_pos=start_pos, n_valid=n_valid,
         )
-    B, _, H, hd = q.shape
-    qq = _pad_to(q[:, 0], 2, 128)
+    B, C, H, hd = q.shape
+    _require_positive_context(C, context_lens, start_pos, n_valid)
+    c0, cl_last = _query_context(C, context_lens, start_pos, n_valid)
+    qq = _pad_to(q, 3, 128)
     kk = _pad_to(k_pool, 3, 128)
     vv = _pad_to(v_pool, 3, 128)
-    out = _paged_bhd(
+    out = _paged_ragged(
         qq, kk, vv,
         jnp.asarray(block_tables, jnp.int32),
-        jnp.asarray(context_lens, jnp.int32),
+        c0.astype(jnp.int32), cl_last.astype(jnp.int32),
         d_true=hd,
         interpret=(backend != "tpu") if interpret is None else interpret,
     )
-    return out[:, None, :, :hd]
+    return out[:, :, :, :hd]

@@ -275,6 +275,100 @@ def paged_decode_step(params: dict, cfg: DecoderConfig, k_pool: jax.Array,
     return logits, k_pool, v_pool
 
 
+def paged_mixed_step(params: dict, cfg: DecoderConfig, k_pool: jax.Array,
+                     v_pool: jax.Array, tokens: jax.Array,
+                     positions: jax.Array, row_tables: jax.Array,
+                     row_start: jax.Array, row_nvalid: jax.Array,
+                     row_token_idx: jax.Array, tok_row: jax.Array,
+                     tok_col: jax.Array, slot_blocks: jax.Array,
+                     slot_offsets: jax.Array, logit_idx: jax.Array, *,
+                     attn: str = "reference"):
+    """One RAGGED fused step over a token-PACKED mixed batch (Round-8;
+    Ragged Paged Attention, arxiv 2604.15464).
+
+    The step consumes a flat stream of ``T`` tokens: each decode row
+    contributes ONE token, each prefill-chunk row a consecutive run of
+    prompt tokens — so an arriving prompt streams in as cheap chunk runs
+    interleaved with in-flight decodes instead of a monolithic
+    whole-bucket prefill that stalls the batch.  The layout is hybrid:
+
+    - embeddings / layer norms / projections / FFN run PACKED on the
+      (T, D) stream, so their cost scales with the live token count
+      (B + chunk headroom), never rows x chunk — a padded (B, C) matrix
+      would bill every decode row for a full chunk of dead compute;
+    - attention runs PER ROW through the ragged multi-query paged op
+      (``row_token_idx`` lifts each row's run to a (B, C) query block,
+      ``tok_row``/``tok_col`` scatter the outputs back), so the KV
+      gather/DMA happens once per SEQUENCE, not once per token — the
+      packed-form per-token gather would move the row's whole context
+      T times per layer.
+
+    Per layer, all T tokens' K/V is scattered into the pool slots FIRST,
+    then attention reads back masked to ``row_start + c + 1`` per query
+    column — a chunk token therefore sees every earlier chunk, the same
+    dispatch's earlier tokens of its own run, and itself: exactly the
+    causal set the dense prefill masks to.  The per-layer math mirrors
+    :func:`decode_step` line-for-line (same einsum strings / f32
+    softmax), so greedy outputs are token-identical to the dense path.
+
+    tokens/positions/slot_blocks/slot_offsets: (T,) int32 — the packed
+    stream; padding tokens use position 0 and the null block 0;
+    row_tables: (B, NB) int32 per-row block tables;
+    row_start/row_nvalid: (B,) int32 — each row's run start position and
+    length (>= 1; idle rows pad to one null-block token);
+    row_token_idx: (B, C) int32 — packed index of the row's c-th run
+    token (columns past ``row_nvalid`` may point anywhere valid);
+    tok_row/tok_col: (T,) int32 — each packed token's (row, column);
+    logit_idx: (B,) int32 — packed index of each output row's LAST run
+    token (its next-token query; garbage rows point anywhere).
+    Returns ``(logits, k_pool, v_pool)`` with ``logits`` (B, V): only
+    the B selected tokens feed the vocab head — one (B, V) matmul, not
+    (T, V); mid-prefill rows' logits are garbage the engine ignores.
+    """
+    from .encoder import _proj
+    from ..kvcache.paged_attention import (paged_attention,
+                                           paged_attention_reference)
+
+    dtype = _resolve_dtype(cfg.dtype)
+    T = tokens.shape[0]
+    H = cfg.n_heads
+    hd = cfg.d_model // H
+    # padding tokens may carry position 0 already; clamp defensively so a
+    # caller bug cannot index past the embedding table
+    pos = jnp.minimum(positions, cfg.max_len - 1)
+    x = params["embed"].astype(dtype)[tokens]  # (T, D)
+    x = x + params["pos_embed"].astype(dtype)[pos]
+    eps = cfg.ln_eps
+    act = _act_fn(cfg)
+    for li, layer in enumerate(params["layers"]):
+        h = _layer_norm(x, layer["ln1_scale"], layer["ln1_bias"], eps)
+        q = _proj(layer, h, "wq", "bq").reshape(T, H, hd)
+        k1 = _proj(layer, h, "wk", "bk").reshape(T, H, hd)
+        v1 = _proj(layer, h, "wv", "bv").reshape(T, H, hd)
+        k_pool = k_pool.at[li, slot_blocks, slot_offsets].set(k1)
+        v_pool = v_pool.at[li, slot_blocks, slot_offsets].set(v1)
+        q_rows = q[row_token_idx]  # (B, C, H, hd)
+        if attn == "pallas":
+            a_rows = paged_attention(
+                q_rows, k_pool[li], v_pool[li], row_tables,
+                start_pos=row_start, n_valid=row_nvalid,
+            )
+        else:
+            a_rows = paged_attention_reference(
+                q_rows, k_pool[li], v_pool[li], row_tables,
+                start_pos=row_start, n_valid=row_nvalid,
+            )
+        a = a_rows[tok_row, tok_col]  # back to the packed (T, H, hd)
+        x = x + _proj(layer, a.reshape(T, cfg.d_model), "wo", "bo")
+        h = _layer_norm(x, layer["ln2_scale"], layer["ln2_bias"], eps)
+        ff = act(_proj(layer, h, "w_up", "b_up"))
+        x = x + _proj(layer, ff, "w_down", "b_down")
+    x = _layer_norm(x, params["ln_f_scale"], params["ln_f_bias"], eps)
+    sel = x[logit_idx]  # (B, D)
+    logits = (sel @ params["embed"].astype(sel.dtype).T).astype(jnp.float32)
+    return logits, k_pool, v_pool
+
+
 def generate_tokens_fused(params: dict, cfg: DecoderConfig,
                           token_ids: jax.Array, n_valid: jax.Array,
                           max_new: int, stop_token: int | None):

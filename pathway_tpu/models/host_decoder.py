@@ -223,7 +223,12 @@ class Int8DecoderHost:
         the executor runs TRUE multi-sequence continuous batching —
         ``max_batch_size`` > 1 per device step, with queued requests
         admitted into the in-flight decode batch at step boundaries
-        (``RequestScheduler.poll_inflight``).
+        (``RequestScheduler.poll_inflight``).  Round-8: admissions
+        stream their prompts through the ragged fused step in chunks
+        (no whole-bucket prefill stalling in-flight decodes; N
+        same-round arrivals ride one dispatch) and sampling runs
+        device-side — pass ``chunked_prefill=False`` through
+        :meth:`paged_engine` kwargs for the round-7 behavior.
 
         ``paged=False`` keeps the legacy serialized tier: the int8 host
         cache (`self._K/_V/n_past`) is per-instance mutable state, so

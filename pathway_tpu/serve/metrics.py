@@ -108,9 +108,15 @@ class ServeStats:
             }
 
 
+# TTFT histogram bucket upper bounds (seconds): spans a warm CPU decode
+# (~ms) through a cold-compile TPU admission (~s); +Inf is implicit
+TTFT_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+                0.5, 1.0, 2.5, 5.0, 10.0)
+
+
 class KVCacheStats:
     """Thread-safe counter block for one paged KV-cache pool
-    (kvcache/block_pool.py + prefix_cache.py).
+    (kvcache/block_pool.py + prefix_cache.py + engine.py).
 
     Prometheus names (rendered by :func:`render_prometheus_lines`):
 
@@ -121,6 +127,13 @@ class KVCacheStats:
     - ``pathway_kv_preemptions_total{pool}``    counter
     - ``pathway_kv_cow_copies_total{pool}``     counter
     - ``pathway_kv_prefix_evictions_total{pool}`` counter
+    - ``pathway_kv_prefill_chunks_total{pool}`` counter (Round-8: prompt
+      chunks streamed through the ragged fused step)
+    - ``pathway_kv_mixed_steps_total{pool}``    counter (mixed dispatches)
+    - ``pathway_kv_mixed_step_occupancy_avg{pool}`` gauge (live rows —
+      decode + chunk — per mixed dispatch)
+    - ``pathway_kv_ttft_seconds{pool}``         histogram (time from
+      request arrival at the engine to its first emitted token)
     """
 
     def __init__(self, name: str, blocks_in_use_fn=None, blocks_total: int = 0):
@@ -133,6 +146,17 @@ class KVCacheStats:
         self.preemptions = 0
         self.cow_copies = 0
         self.prefix_evictions = 0
+        self.prefill_chunks = 0
+        self.mixed_steps = 0
+        self.mixed_step_rows = 0
+        self.ttft_count = 0
+        self.ttft_sum = 0.0
+        self.ttft_bucket_counts = [0] * len(TTFT_BUCKETS)
+        # bounded recent observations so callers (bench.py) can compute
+        # percentiles without a second instrumentation channel
+        from collections import deque as _deque
+
+        self.recent_ttfts = _deque(maxlen=256)
 
     def record_prefix_hit(self, n: int = 1) -> None:
         with self._lock:
@@ -154,6 +178,26 @@ class KVCacheStats:
         with self._lock:
             self.prefix_evictions += n
 
+    def record_prefill_chunks(self, n: int = 1) -> None:
+        with self._lock:
+            self.prefill_chunks += n
+
+    def record_mixed_step(self, occupancy: int) -> None:
+        """One ragged fused dispatch serving `occupancy` live rows."""
+        with self._lock:
+            self.mixed_steps += 1
+            self.mixed_step_rows += occupancy
+
+    def record_ttft(self, seconds: float) -> None:
+        with self._lock:
+            self.ttft_count += 1
+            self.ttft_sum += seconds
+            for i, ub in enumerate(TTFT_BUCKETS):
+                if seconds <= ub:
+                    self.ttft_bucket_counts[i] += 1
+                    break
+            self.recent_ttfts.append(seconds)
+
     @property
     def blocks_in_use(self) -> int:
         if self._blocks_in_use_fn is None:
@@ -162,6 +206,11 @@ class KVCacheStats:
             return int(self._blocks_in_use_fn())
         except Exception:
             return 0
+
+    @property
+    def mixed_step_occupancy_avg(self) -> float:
+        return self.mixed_step_rows / self.mixed_steps \
+            if self.mixed_steps else 0.0
 
     def snapshot(self) -> dict:
         with self._lock:
@@ -174,6 +223,14 @@ class KVCacheStats:
                 "preemptions": self.preemptions,
                 "cow_copies": self.cow_copies,
                 "prefix_evictions": self.prefix_evictions,
+                "prefill_chunks": self.prefill_chunks,
+                "mixed_steps": self.mixed_steps,
+                "mixed_step_rows": self.mixed_step_rows,
+                "mixed_step_occupancy_avg": self.mixed_step_occupancy_avg,
+                "ttft_count": self.ttft_count,
+                "ttft_sum": self.ttft_sum,
+                "ttft_buckets": list(self.ttft_bucket_counts),
+                "recent_ttfts": list(self.recent_ttfts),
             }
 
 
@@ -291,6 +348,10 @@ def _render_kv_lines() -> list[str]:
         "# TYPE pathway_kv_preemptions_total counter",
         "# TYPE pathway_kv_cow_copies_total counter",
         "# TYPE pathway_kv_prefix_evictions_total counter",
+        "# TYPE pathway_kv_prefill_chunks_total counter",
+        "# TYPE pathway_kv_mixed_steps_total counter",
+        "# TYPE pathway_kv_mixed_step_occupancy_avg gauge",
+        "# TYPE pathway_kv_ttft_seconds histogram",
     ]
     for s in stats:
         snap = s.snapshot()
@@ -310,6 +371,35 @@ def _render_kv_lines() -> list[str]:
         lines.append(
             f"pathway_kv_prefix_evictions_total{{{lbl}}} "
             f"{snap['prefix_evictions']}"
+        )
+        lines.append(
+            f"pathway_kv_prefill_chunks_total{{{lbl}}} "
+            f"{snap['prefill_chunks']}"
+        )
+        lines.append(
+            f"pathway_kv_mixed_steps_total{{{lbl}}} {snap['mixed_steps']}"
+        )
+        lines.append(
+            f"pathway_kv_mixed_step_occupancy_avg{{{lbl}}} "
+            f"{snap['mixed_step_occupancy_avg']:.3f}"
+        )
+        # Prometheus histogram convention: cumulative le buckets + +Inf,
+        # then _sum and _count
+        cum = 0
+        for ub, n in zip(TTFT_BUCKETS, snap["ttft_buckets"]):
+            cum += n
+            lines.append(
+                f'pathway_kv_ttft_seconds_bucket{{{lbl},le="{ub}"}} {cum}'
+            )
+        lines.append(
+            f'pathway_kv_ttft_seconds_bucket{{{lbl},le="+Inf"}} '
+            f"{snap['ttft_count']}"
+        )
+        lines.append(
+            f"pathway_kv_ttft_seconds_sum{{{lbl}}} {snap['ttft_sum']:.6f}"
+        )
+        lines.append(
+            f"pathway_kv_ttft_seconds_count{{{lbl}}} {snap['ttft_count']}"
         )
     return lines
 
@@ -343,7 +433,9 @@ def otlp_points(now_ns: str) -> list[dict]:
     for s in all_kv_stats():
         snap = s.snapshot()
         for key in ("prefix_hits", "prefix_misses", "preemptions",
-                    "cow_copies", "prefix_evictions", "blocks_in_use"):
+                    "cow_copies", "prefix_evictions", "blocks_in_use",
+                    "prefill_chunks", "mixed_steps", "mixed_step_rows",
+                    "ttft_count"):
             points.append({
                 "asInt": str(snap[key]),
                 "timeUnixNano": now_ns,
@@ -352,4 +444,12 @@ def otlp_points(now_ns: str) -> list[dict]:
                     {"key": "counter", "value": {"stringValue": key}},
                 ],
             })
+        points.append({
+            "asDouble": snap["ttft_sum"],
+            "timeUnixNano": now_ns,
+            "attributes": [
+                {"key": "pool", "value": {"stringValue": s.name}},
+                {"key": "counter", "value": {"stringValue": "ttft_sum"}},
+            ],
+        })
     return points

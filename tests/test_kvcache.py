@@ -434,9 +434,13 @@ def test_engine_failure_releases_inflight_waiters(params):
 
 
 def test_prefill_failure_does_not_leak_blocks(params):
+    # chunked_prefill=False: the legacy whole-bucket admission prefill is
+    # the only path that dispatches from INSIDE _try_admit (the chunked
+    # analog — a mid-prefill mixed-step failure — is pinned in
+    # tests/test_ragged_step.py)
     eng = PagedDecodeEngine(
         _CFG, params, num_blocks=16, block_size=8, max_batch_size=2,
-        seq_buckets=(16,), name="t_pfail",
+        seq_buckets=(16,), chunked_prefill=False, name="t_pfail",
     )
 
     def bad_prefill(*_a, **_k):

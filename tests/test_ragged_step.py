@@ -1,0 +1,592 @@
+"""Ragged fused-step paged decode (Round-8) — ISSUE 3 acceptance.
+
+Pins the four tentpole guarantees:
+
+- chunked-prefill token identity: greedy output through block-aligned
+  chunk streaming is identical to the dense batch-1 path AND to the
+  Round-7 whole-bucket prefill path — for mixed-length batches, prompts
+  that are not chunk-aligned (partial tail chunk), shared prefixes
+  (including same-round lockstep sharing), and across
+  preemption-with-recompute;
+- fused mixed step: same-round arrivals ride ONE dispatch (their first
+  tokens all come from that dispatch's device-side argmax);
+- device-side sampling: the jitted step returns [B] int32 ids, not
+  [B, vocab] logits;
+- recompile guard: a bucket-ladder workload compiles the step programs
+  once — the second pass triggers ZERO new XLA compilations
+  (jax_log_compiles capture), catching accidental shape polymorphism.
+
+Plus the paged-attention ``context >= 1`` contract (fail loudly instead
+of NaNs) and the Round-8 metrics surface (prefill chunks, mixed-step
+occupancy, TTFT histogram).
+"""
+
+import logging
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pathway_tpu.kvcache import BlockPool, PagedDecodeEngine
+from pathway_tpu.models.decoder import (
+    DecoderConfig, decode_step, init_decoder_params, paged_mixed_step,
+    prefill,
+)
+
+_CFG = DecoderConfig(
+    vocab_size=64, d_model=32, n_layers=2, n_heads=2, d_ff=64, max_len=128
+)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_decoder_params(_CFG, jax.random.PRNGKey(0))
+
+
+def _dense_greedy(params, prompt, n_new, bucket=64, cfg=_CFG):
+    """Oracle: the dense batch-1 prefill + decode_step path."""
+    n = len(prompt)
+    buf = np.zeros((1, bucket), np.int32)
+    buf[0, :n] = prompt
+    logits, cache = prefill(
+        params, cfg, jnp.asarray(buf), jnp.asarray([n], jnp.int32)
+    )
+    out = [int(np.argmax(np.asarray(logits[0])))]
+    pos = n
+    for _ in range(n_new - 1):
+        logits, cache = decode_step(
+            params, cfg, cache, jnp.asarray([[out[-1]]], jnp.int32), pos
+        )
+        out.append(int(np.argmax(np.asarray(logits[0]))))
+        pos += 1
+    return out
+
+
+# -- chunked-prefill token identity -----------------------------------------
+
+
+def test_chunked_identity_mixed_lengths_and_partial_tail(params):
+    # chunk=8 over block_size 4: lengths 3..31 cover prompts shorter than
+    # one chunk, exact multiples, and partial tail chunks (11, 17, 27)
+    eng = PagedDecodeEngine(
+        _CFG, params, num_blocks=96, block_size=4, max_batch_size=4,
+        seq_buckets=(16, 32, 64), prefill_chunk=8, name="t_r8_identity",
+    )
+    assert eng.chunked_prefill and eng.prefill_chunk == 8
+    rng = np.random.default_rng(7)
+    lengths = [3, 5, 8, 11, 16, 17, 27, 31]
+    prompts = [
+        [int(t) for t in rng.integers(0, _CFG.vocab_size, size=n)]
+        for n in lengths
+    ]
+    got = eng.generate_batch([(p, 8) for p in prompts])
+    want = [_dense_greedy(params, p, 8) for p in prompts]
+    assert got == want
+    # only the prefix cache's own holds survive the batch
+    eng.prefix.clear()
+    assert eng.pool.blocks_in_use == 0
+    # the prompts really were streamed chunkwise, not whole-bucket
+    assert eng.pool.stats.snapshot()["prefill_chunks"] >= sum(
+        -(-n // 8) for n in lengths if n > 8
+    )
+
+
+def test_chunked_matches_legacy_whole_bucket_path(params):
+    rng = np.random.default_rng(13)
+    prompts = [
+        [int(t) for t in rng.integers(0, _CFG.vocab_size, size=n)]
+        for n in (6, 13, 21, 30)
+    ]
+    outs = {}
+    for chunked in (True, False):
+        eng = PagedDecodeEngine(
+            _CFG, params, num_blocks=96, block_size=8, max_batch_size=4,
+            seq_buckets=(16, 32, 64), chunked_prefill=chunked,
+            name=f"t_r8_cmp_{chunked}",
+        )
+        outs[chunked] = eng.generate_batch([(p, 6) for p in prompts])
+    assert outs[True] == outs[False]
+    assert outs[True] == [_dense_greedy(params, p, 6) for p in prompts]
+
+
+def test_chunked_identity_under_shared_prefixes_same_round(params):
+    # every prompt shares a two-block header and ALL are admitted in the
+    # same round: later arrivals must map the first writer's IN-FLIGHT
+    # blocks (lockstep gate) — physical sharing from round one, token
+    # output untouched.  One prompt equals the header exactly (the
+    # fully-shared case recomputes only its final token)
+    eng = PagedDecodeEngine(
+        _CFG, params, num_blocks=96, block_size=8, max_batch_size=8,
+        seq_buckets=(32, 64), prefill_chunk=16, name="t_r8_prefix",
+    )
+    header = [11] * 8 + [13] * 8
+    prompts = [header + [20 + i, 30 + i] for i in range(5)] + [list(header)]
+    peak = {"blocks": 0}
+    orig_mixed = eng._mixed
+
+    def tracking_mixed(*a, **k):
+        peak["blocks"] = max(peak["blocks"], eng.pool.blocks_in_use)
+        return orig_mixed(*a, **k)
+
+    eng._mixed = tracking_mixed
+    got = eng.generate_batch([(p, 6) for p in prompts])
+    want = [_dense_greedy(params, p, 6) for p in prompts]
+    assert got == want
+    snap = eng.pool.stats.snapshot()
+    assert snap["prefix_hits"] > 0
+    naive = sum(eng.pool.blocks_for(len(p) + 6) for p in prompts)
+    assert peak["blocks"] < naive
+
+
+def test_chunked_identity_across_preemption(params):
+    # 12 usable blocks of 4 = 48 slots; four 10-token prompts + 10 new
+    # tokens each (80 slots) cannot coexist -> decode MUST preempt, and
+    # recompute re-streams the victim's chunks
+    eng = PagedDecodeEngine(
+        _CFG, params, num_blocks=13, block_size=4, max_batch_size=4,
+        seq_buckets=(12, 20), prefix_sharing=False, prefill_chunk=8,
+        name="t_r8_oom",
+    )
+    rng = np.random.default_rng(3)
+    prompts = [
+        [int(t) for t in rng.integers(0, _CFG.vocab_size, size=10)]
+        for _ in range(4)
+    ]
+    before = eng.pool.stats.snapshot()["preemptions"]
+    got = eng.generate_batch([(p, 10) for p in prompts])
+    assert eng.pool.stats.snapshot()["preemptions"] > before
+    assert got == [_dense_greedy(params, p, 10) for p in prompts]
+    assert eng.pool.blocks_in_use == 0
+
+
+def test_mid_prefill_failure_fails_cleanly(params):
+    # the chunked analog of the legacy prefill-failure test: a mixed-step
+    # device failure mid-prefill must fail the batch loudly AND free the
+    # admitted sequence's blocks (it IS in `running`, unlike the legacy
+    # admission-prefill case)
+    eng = PagedDecodeEngine(
+        _CFG, params, num_blocks=16, block_size=8, max_batch_size=2,
+        seq_buckets=(16,), name="t_r8_fail",
+    )
+
+    def boom(*_a, **_k):
+        raise RuntimeError("mixed step exploded")
+
+    eng._mixed = boom
+    with pytest.raises(RuntimeError, match="mixed step exploded"):
+        eng.generate_batch([([1, 2, 3], 4)])
+    assert eng.pool.blocks_in_use == 0
+    assert not eng._inflight_prefix
+
+
+def test_cascade_preempt_judges_by_writer_progress(params):
+    """A sharer starts with n_filled == n_diverted (chunking begins after
+    the shared region) yet has READ nothing until its first chunk runs —
+    safety on writer preemption must be judged by the WRITER's progress:
+    requeue the sharer when the writer had not written past the shared
+    region, keep it when it had."""
+    from collections import deque
+
+    from pathway_tpu.kvcache.engine import _Active, _Request
+
+    eng = PagedDecodeEngine(
+        _CFG, params, num_blocks=32, block_size=8, max_batch_size=4,
+        seq_buckets=(64,), name="t_r8_cascade",
+    )
+    pool = eng.pool
+
+    def make_pair(w_seq, s_seq, writer_filled):
+        wreq = _Request([1] * 40, 4)
+        w = _Active(w_seq, wreq)
+        pool.allocate(w_seq, 40)
+        w.tokens = list(wreq.prompt)
+        w.n_filled = writer_filled
+        sreq = _Request([1] * 32 + [2, 3], 4)
+        s = _Active(s_seq, sreq)
+        pool.allocate(
+            s_seq, 34,
+            shared_blocks=pool.sequence(w_seq).block_ids[:4],
+        )
+        s.tokens = list(sreq.prompt)
+        s.n_filled = s.n_diverted = 32  # admission state: nothing read yet
+        s.wait_writer = w
+        return w, s, sreq
+
+    # writer preempted having written only 16 of the 32 shared tokens:
+    # the sharer MUST be requeued (its future chunks would attend
+    # through never-written K/V)
+    w, s, sreq = make_pair(1, 2, writer_filled=16)
+    running, pending = [s], deque()
+    pool.free_sequence(1)  # what pool.preempt() does to the victim
+    eng._cascade_preempt([w], running, pending)
+    assert running == [] and list(pending) == [sreq]
+    assert pool.blocks_in_use == 0
+
+    # writer preempted AFTER writing past the shared region: the sharer
+    # keeps running (its refs keep the fully-written blocks alive)
+    w2, s2, _ = make_pair(3, 4, writer_filled=40)
+    running2, pending2 = [s2], deque()
+    pool.free_sequence(3)
+    eng._cascade_preempt([w2], running2, pending2)
+    assert running2 == [s2] and not pending2
+    assert s2.wait_writer is None
+    pool.free_sequence(4)
+    assert pool.blocks_in_use == 0
+
+
+# -- fused mixed step / device-side sampling --------------------------------
+
+
+def test_same_round_arrivals_share_one_dispatch(params):
+    # N same-round admissions with prompts <= one chunk finish their
+    # prefill in ONE mixed dispatch; first tokens come from that
+    # dispatch's device-side argmax — 1 dispatch, not N (the Round-7
+    # path ran one whole-bucket prefill per admission)
+    eng = PagedDecodeEngine(
+        _CFG, params, num_blocks=64, block_size=8, max_batch_size=4,
+        seq_buckets=(16, 32), prefix_sharing=False, prefill_chunk=16,
+        name="t_r8_oneshot",
+    )
+    rng = np.random.default_rng(5)
+    # 4+6+8 = 18 tokens fits one mixed_tokens budget (B=4 + chunk 16)
+    prompts = [
+        [int(t) for t in rng.integers(0, _CFG.vocab_size, size=n)]
+        for n in (4, 6, 8)
+    ]
+    assert sum(len(p) for p in prompts) <= eng.mixed_tokens
+    before = eng.pool.stats.snapshot()
+    got = eng.generate_batch([(p, 1) for p in prompts])
+    after = eng.pool.stats.snapshot()
+    assert after["mixed_steps"] - before["mixed_steps"] == 1
+    assert after["prefill_chunks"] - before["prefill_chunks"] == 3
+    assert got == [_dense_greedy(params, p, 1) for p in prompts]
+
+
+def test_device_side_sampling_returns_ids_not_logits(params):
+    eng = PagedDecodeEngine(
+        _CFG, params, num_blocks=32, block_size=8, max_batch_size=2,
+        seq_buckets=(16,), name="t_r8_ids",
+    )
+    seen = []
+    for attr in ("_step", "_mixed"):
+        orig = getattr(eng, attr)
+
+        def spy(*a, _orig=orig, _attr=attr):
+            out = _orig(*a)
+            seen.append((_attr, out[0].shape, out[0].dtype))
+            return out
+
+        setattr(eng, attr, spy)
+    eng.generate_batch([([1, 2, 3], 3)])
+    assert seen, "no step dispatched"
+    for _attr, shape, dtype in seen:
+        # [B] int32 ids cross the boundary — not [B, vocab] f32 logits
+        assert shape == (eng.max_batch_size,)
+        assert dtype == jnp.int32
+
+
+def test_mixed_step_chunk_stream_matches_dense_prefill(params):
+    """Unit-level: streaming one prompt through packed paged_mixed_step
+    runs reproduces dense prefill's next-token logits (allclose — the
+    engine tests pin argmax identity)."""
+    pool = BlockPool(
+        num_blocks=16, block_size=4, n_layers=_CFG.n_layers,
+        n_heads=_CFG.n_heads, head_dim=_CFG.d_model // _CFG.n_heads,
+        name="t_r8_unit",
+    )
+    prompt = [5, 9, 20, 3, 7, 41, 2, 8, 30, 12, 1]  # 11 tokens: tail run
+    n = len(prompt)
+    seq = pool.allocate(1, n)
+    C = 4  # packed stream width: padding tokens ride the null block
+    logits = None
+    for s in range(0, n, C):
+        e = min(s + C, n)
+        nv = e - s
+        tokens = np.zeros(C, np.int32)
+        tokens[:nv] = prompt[s:e]
+        positions = np.zeros(C, np.int32)
+        pos = np.arange(s, e)
+        positions[:nv] = pos
+        sb = np.zeros(C, np.int32)
+        so = np.zeros(C, np.int32)
+        sb[:nv] = np.asarray(seq.block_ids, np.int32)[pos // 4]
+        so[:nv] = pos % 4
+        row_tables = np.zeros((1, 8), np.int32)
+        row_tables[0, : len(seq.block_ids)] = seq.block_ids
+        row_token_idx = np.full((1, C), nv - 1, np.int32)
+        row_token_idx[0, :nv] = np.arange(nv)
+        tok_col = np.zeros(C, np.int32)
+        tok_col[:nv] = np.arange(nv)
+        logits, pool.k, pool.v = paged_mixed_step(
+            params, _CFG, pool.k, pool.v, jnp.asarray(tokens),
+            jnp.asarray(positions), jnp.asarray(row_tables),
+            jnp.asarray([s], jnp.int32), jnp.asarray([nv], jnp.int32),
+            jnp.asarray(row_token_idx),
+            jnp.zeros(C, jnp.int32), jnp.asarray(tok_col),
+            jnp.asarray(sb), jnp.asarray(so),
+            jnp.asarray([nv - 1], jnp.int32),
+        )
+    buf = np.zeros((1, 12), np.int32)
+    buf[0, :n] = prompt
+    want, _cache = prefill(
+        params, _CFG, jnp.asarray(buf), jnp.asarray([n], jnp.int32)
+    )
+    np.testing.assert_allclose(
+        np.asarray(logits[0]), np.asarray(want[0]), rtol=2e-4, atol=2e-4
+    )
+
+
+# -- recompile guard ---------------------------------------------------------
+
+
+def test_second_pass_triggers_zero_recompiles(params):
+    """Run a full bucket-ladder workload twice; the second pass must not
+    compile ANYTHING (jax_log_compiles capture) — the ragged step's
+    static (B, chunk) shape is the whole point, and an accidental
+    shape-polymorphic input would show up here as a per-length compile."""
+    eng = PagedDecodeEngine(
+        _CFG, params, num_blocks=96, block_size=8, max_batch_size=4,
+        seq_buckets=(16, 32, 64), name="t_r8_compile",
+    )
+    rng = np.random.default_rng(23)
+    # straddle every bucket, mix chunk-aligned and partial-tail lengths
+    reqs = [
+        ([int(t) for t in rng.integers(0, _CFG.vocab_size, size=n)], 5)
+        for n in (3, 9, 15, 16, 21, 33, 40, 60)
+    ]
+
+    class _Capture(logging.Handler):
+        def __init__(self):
+            super().__init__()
+            self.compiles = []
+
+        def emit(self, record):
+            msg = record.getMessage()
+            if msg.startswith("Compiling "):
+                self.compiles.append(msg)
+
+    jax_logger = logging.getLogger("jax")
+    old_level = jax_logger.level
+
+    def _run_captured():
+        handler = _Capture()
+        jax_logger.addHandler(handler)
+        jax_logger.setLevel(logging.WARNING)
+        try:
+            with jax.log_compiles(True):
+                eng.generate_batch(list(reqs))
+        finally:
+            jax_logger.removeHandler(handler)
+            jax_logger.setLevel(old_level)
+        return handler.compiles
+
+    first = _run_captured()
+    assert first, "capture mechanism saw no compiles on the cold pass"
+    second = _run_captured()
+    assert second == [], (
+        f"second pass recompiled {len(second)} programs: {second[:4]}"
+    )
+
+
+# -- paged-attention context contract ----------------------------------------
+
+
+def test_zero_length_context_fails_loudly():
+    from pathway_tpu.kvcache.paged_attention import (
+        paged_attention, paged_attention_reference,
+    )
+
+    rng = np.random.default_rng(1)
+    q = jnp.asarray(rng.standard_normal((2, 1, 2, 4)), jnp.float32)
+    kp = jnp.asarray(rng.standard_normal((4, 4, 2, 4)), jnp.float32)
+    vp = jnp.asarray(rng.standard_normal((4, 4, 2, 4)), jnp.float32)
+    bt = jnp.asarray([[1, 2], [3, 0]], jnp.int32)
+    with pytest.raises(ValueError, match="context_lens >= n_queries"):
+        paged_attention_reference(q, kp, vp, bt, jnp.asarray([0, 3]))
+    with pytest.raises(ValueError, match="n_valid >= 1"):
+        paged_attention_reference(
+            q, kp, vp, bt, start_pos=jnp.asarray([0, 0]),
+            n_valid=jnp.asarray([1, 0]),
+        )
+    with pytest.raises(ValueError, match="start_pos >= 0"):
+        paged_attention(
+            q, kp, vp, bt, start_pos=jnp.asarray([-1, 0]),
+            n_valid=jnp.asarray([1, 1]), use_pallas=False,
+        )
+    # the satisfied contract passes and yields finite output
+    out = paged_attention_reference(q, kp, vp, bt, jnp.asarray([1, 3]))
+    assert np.isfinite(np.asarray(out)).all()
+
+
+@pytest.mark.slow
+def test_ragged_kernel_matches_reference_interpreted():
+    """The length-aware multi-query kernel (interpret mode on CPU — slow)
+    must agree with the gather reference on every VALID query column."""
+    from pathway_tpu.kvcache.paged_attention import (
+        _HAVE_PALLAS, paged_attention, paged_attention_reference,
+    )
+
+    if not _HAVE_PALLAS:
+        pytest.skip("pallas unavailable")
+    rng = np.random.default_rng(5)
+    B, C, H, hd, BS, NBLK = 3, 4, 2, 16, 8, 12
+    q = jnp.asarray(rng.standard_normal((B, C, H, hd)), jnp.float32)
+    k_pool = jnp.asarray(rng.standard_normal((NBLK, BS, H, hd)), jnp.float32)
+    v_pool = jnp.asarray(rng.standard_normal((NBLK, BS, H, hd)), jnp.float32)
+    tables = jnp.asarray(
+        [[1, 2, 3, 4], [5, 6, 0, 0], [7, 8, 9, 10]], jnp.int32
+    )
+    # ragged: a full chunk deep in its sequence, a partial tail chunk,
+    # and a fresh 1-token decode-style row
+    sp = jnp.asarray([17, 4, 0], jnp.int32)
+    nv = jnp.asarray([4, 2, 1], jnp.int32)
+    want = paged_attention_reference(
+        q, k_pool, v_pool, tables, start_pos=sp, n_valid=nv
+    )
+    got = paged_attention(
+        q, k_pool, v_pool, tables, start_pos=sp, n_valid=nv,
+        use_pallas=True, interpret=True,
+    )
+    for b in range(B):
+        for c in range(int(nv[b])):
+            np.testing.assert_allclose(
+                np.asarray(got)[b, c], np.asarray(want)[b, c],
+                rtol=2e-5, atol=2e-5,
+            )
+
+
+# -- continuous batching: arrivals never stall in-flight decodes -------------
+
+
+def test_arrival_mid_decode_interleaves_and_matches(params):
+    """A long-prompt arrival injected mid-decode must complete correctly
+    AND the in-flight short decodes must keep making progress between
+    the arrival's chunk steps (no monolithic-prefill stall rounds)."""
+    eng = PagedDecodeEngine(
+        _CFG, params, num_blocks=96, block_size=8, max_batch_size=4,
+        seq_buckets=(16, 64), prefix_sharing=False, prefill_chunk=8,
+        name="t_r8_arrival",
+    )
+    rng = np.random.default_rng(17)
+    short = [
+        [int(t) for t in rng.integers(0, _CFG.vocab_size, size=4)]
+        for _ in range(2)
+    ]
+    longp = [int(t) for t in rng.integers(0, _CFG.vocab_size, size=40)]
+    got = {}
+    state = {"round": 0, "sent": False}
+
+    def poll(n):
+        state["round"] += 1
+        if state["round"] == 3 and not state["sent"]:
+            state["sent"] = True
+            return [((longp, 3), 1, lambda r: got.setdefault("long", r),
+                     lambda e: got.setdefault("err", e))]
+        return []
+
+    outs = eng.generate_batch([(p, 12) for p in short], poll=poll)
+    assert "err" not in got
+    assert outs == [_dense_greedy(params, p, 12) for p in short]
+    assert got["long"] == _dense_greedy(params, longp, 3)
+    # the 40-token prompt streamed as ceil(40/8)=5 chunks through the
+    # mixed step instead of one whole-bucket dispatch
+    assert eng.pool.stats.snapshot()["prefill_chunks"] >= 5
+
+
+def test_continuous_batching_through_scheduler_chunked(params):
+    from pathway_tpu.serve.scheduler import RequestScheduler
+
+    eng = PagedDecodeEngine(
+        _CFG, params, num_blocks=96, block_size=8, max_batch_size=4,
+        seq_buckets=(16, 32), prefill_chunk=16, name="t_r8_cbatch",
+    )
+    box = {}
+
+    def batch_fn(reqs):
+        return eng.serve_batch(reqs, scheduler=box["sched"])
+
+    box["sched"] = sched = RequestScheduler(
+        batch_fn, name="t_r8_cbatch_sched", max_batch_size=4,
+        batch_linger_ms=20.0, max_queue=32,
+    )
+    try:
+        rng = np.random.default_rng(11)
+        prompts = [
+            [int(t) for t in rng.integers(0, _CFG.vocab_size, size=5 + i)]
+            for i in range(6)
+        ]
+        results = [None] * 6
+
+        def submit(i):
+            results[i] = sched.submit((prompts[i], 10))
+
+        threads = [
+            threading.Thread(target=submit, args=(i,)) for i in range(6)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        assert results == [_dense_greedy(params, p, 10) for p in prompts]
+    finally:
+        sched.shutdown()
+
+
+# -- metrics surface ---------------------------------------------------------
+
+
+def test_round8_metrics_render_and_export(params):
+    from pathway_tpu.serve import metrics as M
+
+    eng = PagedDecodeEngine(
+        _CFG, params, num_blocks=64, block_size=8, max_batch_size=2,
+        seq_buckets=(16,), name="t_r8_metrics",
+    )
+    eng.generate_batch([([1, 2, 3, 4, 5], 4), ([6, 7], 3)])
+    snap = eng.pool.stats.snapshot()
+    assert snap["prefill_chunks"] >= 2
+    assert snap["mixed_steps"] >= 1
+    assert snap["mixed_step_occupancy_avg"] > 0
+    # one TTFT observation per request, histogram internally consistent
+    assert snap["ttft_count"] == 2
+    assert len(snap["recent_ttfts"]) == 2
+    assert snap["ttft_sum"] >= sum(snap["recent_ttfts"]) * 0.99
+    lines = "\n".join(M.render_prometheus_lines())
+    lbl = f'pool="{eng.pool.name}"'
+    assert f"pathway_kv_prefill_chunks_total{{{lbl}}}" in lines
+    assert f"pathway_kv_mixed_step_occupancy_avg{{{lbl}}}" in lines
+    assert f'pathway_kv_ttft_seconds_bucket{{{lbl},le="+Inf"}} 2' in lines
+    assert f"pathway_kv_ttft_seconds_count{{{lbl}}} 2" in lines
+    # cumulative bucket counts are monotone and end at the count
+    bucket_vals = [
+        int(line.rsplit(" ", 1)[1])
+        for line in lines.splitlines()
+        if line.startswith(f"pathway_kv_ttft_seconds_bucket{{{lbl}")
+    ]
+    assert bucket_vals == sorted(bucket_vals)
+    assert bucket_vals[-1] == 2
+    points = M.otlp_points("0")
+    counters = {
+        a["value"]["stringValue"]
+        for p in points for a in p["attributes"]
+        if a["key"] == "counter"
+    }
+    assert {"prefill_chunks", "mixed_steps", "ttft_count",
+            "ttft_sum"} <= counters
+    # dashboard renders the new columns without an engine scheduler
+    from pathway_tpu.engine import telemetry as T
+
+    class _FakeOp:
+        name, id, rows_in, rows_out = "op", 0, 1, 1
+
+    class _FakeSched:
+        operators = [_FakeOp()]
+        frontier = 0
+
+    ms = T.MetricsServer.__new__(T.MetricsServer)
+    ms.scheduler = _FakeSched()
+    ms.started_at = 0.0
+    html = ms.render_dashboard()
+    assert "ttft p50 ms" in html and "chunks" in html
