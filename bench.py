@@ -872,6 +872,15 @@ def bench_generation() -> dict:
     except Exception as exc:  # noqa: BLE001 - bench must not wedge
         print(f"[bench] batched paged decode skipped: {exc}", flush=True)
 
+    # ---- decode MFU: analytic FLOPs per token at the mean decode context
+    # of the batched workload, achieved rate / backend peak (spec sheet on
+    # TPU, measured matmul roofline on CPU — VERDICT item 6)
+    decode_mfu = decode_flops_per_token = None
+    peak, peak_src = _backend_peak()
+    if batched_tok_s and peak:
+        decode_flops_per_token = _decoder_flops_per_token(cfg, 96 + 16 // 2)
+        decode_mfu = round(batched_tok_s * decode_flops_per_token / peak, 4)
+
     # ---- round-8 mixed workload: 7 short decoders + 1 long-prompt arrival
     # injected mid-decode (poll_inflight).  TTFT is recorded by the engine
     # per REQUEST (arrival at the engine -> first token; the stats
@@ -1036,8 +1045,112 @@ def bench_generation() -> dict:
         "batched_speedup_vs_batch1": (
             round(batched_speedup, 2) if batched_speedup else None
         ),
+        # achieved decode FLOPs/s over the backend peak (paged batched
+        # decode, the serving path's hot loop)
+        "decode_mfu": decode_mfu,
+        "decode_flops_per_token": decode_flops_per_token,
+        "decode_mfu_peak_source": peak_src,
         "adaptive_rag_latency_s": round(adaptive_s, 2),
     }
+
+
+def _bench_tp_virtual_child() -> None:
+    """Subprocess body for the tp=8 virtual-mesh decode row (parent:
+    :func:`_bench_tp_virtual`).  Runs under JAX_PLATFORMS=cpu with
+    ``--xla_force_host_platform_device_count=8`` and prints ONE JSON
+    line: the decode_tokens_per_s_batched workload (8 x 96-token
+    prompts, 16 new tokens, decode-only by prefill subtraction) at tp=1
+    and tp=8 on the same weights.
+
+    Model note: the 12-head bench decoder cannot shard 8 ways
+    (n_heads % 8 != 0), so this row uses a 16-head variant of the same
+    124M-class shape — the tp8/tp1 ratio is measured on IDENTICAL
+    weights, and the self-history gate stays on the 12-head tp=1
+    ``decode_tokens_per_s_batched`` row only."""
+    import time as _t
+
+    import jax
+    import numpy as np
+
+    from pathway_tpu.kvcache.engine import PagedDecodeEngine
+    from pathway_tpu.models.decoder import DecoderConfig, init_decoder_params
+
+    cfg = DecoderConfig(
+        vocab_size=32768, d_model=768, n_layers=12, n_heads=16, d_ff=3072,
+        max_len=1024,
+    )
+    params = init_decoder_params(cfg, jax.random.PRNGKey(0))
+    rng = np.random.default_rng(0)
+    prompts = [
+        [int(t) for t in rng.integers(0, cfg.vocab_size, size=96)]
+        for _ in range(8)
+    ]
+    bn_new = 16
+    out = {
+        "devices": len(jax.devices()),
+        "model": "124M-class-16head",
+        "note": (
+            "8 VIRTUAL devices share one host core: this row records "
+            "shard_map collective/dispatch overhead at identical total "
+            "compute, NOT real-chip scaling; n_heads=16 variant because "
+            "the 12-head bench model has n_heads % 8 != 0"
+        ),
+    }
+    for tp in (1, 8):
+        eng = PagedDecodeEngine(
+            cfg, params, num_blocks=96, block_size=16, max_batch_size=8,
+            max_blocks_per_seq=7, seq_buckets=(112,), tp=tp,
+            name=f"bench_tp{tp}",
+        )
+        eng.generate_batch([(p, 1) for p in prompts])  # compile prefill
+        eng.generate_batch([(p, 2) for p in prompts])  # compile step
+        t0 = _t.perf_counter()
+        eng.generate_batch([(p, 1) for p in prompts])
+        t_prefill = _t.perf_counter() - t0
+        t0 = _t.perf_counter()
+        eng.generate_batch([(p, bn_new + 1) for p in prompts])
+        t_full = _t.perf_counter() - t0
+        out[f"decode_tokens_per_s_tp{tp}"] = round(
+            8 * bn_new / max(t_full - t_prefill, 1e-9), 1
+        )
+    out["tp8_vs_tp1"] = round(
+        out["decode_tokens_per_s_tp8"]
+        / max(out["decode_tokens_per_s_tp1"], 1e-9), 3,
+    )
+    print(json.dumps(out), flush=True)
+
+
+def _bench_tp_virtual(timeout_s: int = 600) -> dict:
+    """Tensor-parallel decode on the 8-way VIRTUAL mesh (Round-9), in a
+    subprocess so the forced 8-device CPU platform cannot leak into this
+    process's backend.  Returns the child's JSON (or a skip record) —
+    never raises, never gated (see the child's note)."""
+    left = _budget_left()
+    if left is not None and left < 240:
+        return {"skipped": f"budget: {left:.0f}s left < 240s"}
+    if left is not None:
+        timeout_s = int(min(timeout_s, max(left - 120, 120)))
+    env = dict(os.environ)
+    env["PW_BENCH_TP8_CHILD"] = "1"
+    env["JAX_PLATFORMS"] = "cpu"
+    xla = env.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in xla:
+        env["XLA_FLAGS"] = (
+            xla + " --xla_force_host_platform_device_count=8"
+        ).strip()
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)],
+            env=env, capture_output=True, timeout=timeout_s,
+        )
+        if proc.returncode != 0:
+            return {"skipped": f"child rc={proc.returncode}: "
+                               f"{proc.stderr.decode()[-300:]}"}
+        return json.loads(proc.stdout.decode().strip().splitlines()[-1])
+    except subprocess.TimeoutExpired:
+        return {"skipped": f"child wedged > {timeout_s}s"}
+    except Exception as exc:  # noqa: BLE001 - bench must not wedge
+        return {"skipped": f"{type(exc).__name__}: {exc}"}
 
 
 def _encoder_flops_per_batch(cfg, B: int, T: int) -> float:
@@ -1049,6 +1162,64 @@ def _encoder_flops_per_batch(cfg, B: int, T: int) -> float:
 
 # bf16 peak FLOPs/s per chip by TPU generation (public spec sheets)
 _TPU_PEAK = {"v5e": 197e12, "v5p": 459e12, "v4": 275e12, "v6e": 918e12}
+
+_PEAK_CACHE: dict = {}
+
+
+def _measured_matmul_peak(n: int = 1024, reps: int = 3) -> float:
+    """Best-of-reps f32 square-matmul throughput on the active backend —
+    the measured roofline used as the MFU denominator where no spec-sheet
+    peak exists (the CPU fallback).  ~2 GFLOP per rep, so the probe costs
+    well under a second even on the 1-core host."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    a = jnp.asarray(
+        np.random.default_rng(0).standard_normal((n, n)), jnp.float32
+    )
+    f = jax.jit(lambda x: x @ x)
+    f(a).block_until_ready()  # compile
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        f(a).block_until_ready()
+        best = min(best, time.perf_counter() - t0)
+    return 2.0 * n ** 3 / best
+
+
+def _backend_peak() -> tuple:
+    """(peak FLOPs/s | None, source) for the active backend: TPU spec
+    sheet by generation, else the measured matmul roofline — so MFU is
+    non-null on EVERY backend (VERDICT r5 weak #4 / next-round #6)."""
+    if "peak" in _PEAK_CACHE:
+        return _PEAK_CACHE["peak"]
+    import jax
+
+    result = (None, "unavailable")
+    if jax.default_backend() == "tpu":
+        gen = _tpu_generation()
+        spec = _TPU_PEAK.get(gen)
+        if spec:
+            result = (spec, f"spec:{gen}")
+    if result[0] is None:
+        try:
+            result = (_measured_matmul_peak(), "measured-matmul-roofline")
+        except Exception:  # noqa: BLE001 - MFU degrades to null, not a crash
+            pass
+    _PEAK_CACHE["peak"] = result
+    return result
+
+
+def _decoder_flops_per_token(cfg, ctx: int) -> float:
+    """Analytic FLOPs for ONE decode-step token: dense projections + FFN
+    (2 MACs per weight), attention score+mix against a ``ctx``-token
+    cache, and the vocab head."""
+    proj_ffn = 2 * (4 * cfg.d_model * cfg.d_model
+                    + 2 * cfg.d_model * cfg.d_ff) * cfg.n_layers
+    attn = 4 * ctx * cfg.d_model * cfg.n_layers
+    head = 2 * cfg.d_model * cfg.vocab_size
+    return proj_ffn + attn + head
 
 
 def _tpu_generation() -> str:
@@ -1243,10 +1414,38 @@ def _self_history_regressions(out: dict) -> list[dict]:
         if worse:
             regressions.append({
                 "metric": name, "current": cur, "best": best,
-                "best_source": src,
+                "best_source": src, "better": better,
                 "ratio": round(cur / best, 3) if best else None,
             })
     return regressions
+
+
+# metrics whose >10% regression FAILS the bench (nonzero exit) instead of
+# merely landing in the regressions report — opt out for exploratory runs
+# with PATHWAY_BENCH_NO_GATE=1.  The tp8 virtual row is deliberately NOT
+# gated: virtual shards share one host core, so that row records
+# collective overhead, not real scaling.
+_GATED_METRICS = {
+    "generation.decode_tokens_per_s_batched",
+    "generation.ttft_ms_p99",
+    "data_plane.cold_rows_per_sec",
+}
+_GATE_TOLERANCE = 0.10
+
+
+def _gate_failures(regressions: list[dict]) -> list[dict]:
+    fails = []
+    for r in regressions:
+        if r.get("metric") not in _GATED_METRICS or not r.get("best"):
+            continue
+        ratio = r["current"] / r["best"]
+        worse = (
+            ratio > 1.0 + _GATE_TOLERANCE if r.get("better") == "min"
+            else ratio < 1.0 - _GATE_TOLERANCE
+        )
+        if worse:
+            fails.append(r)
+    return fails
 
 
 def _stage(msg: str) -> None:
@@ -1576,11 +1775,21 @@ def main() -> None:
         flops = _encoder_flops_per_batch(enc.cfg, B_mfu, seq_T) * N_scan
         achieved = flops / (t5 - t4)
         mfu = round(achieved / peak, 4)
+        mfu_note = "device-compute (scan probe) vs spec-sheet peak; " \
+                   "embed_tokens_per_sec is end-to-end"
     else:
-        # MFU is a TPU metric; the 34-TFLOP scan probe takes ~30min on the
-        # 1-core CPU fallback for a number that would be null anyway
-        achieved = 0.0
-        mfu = None
+        # CPU fallback: the 34-TFLOP scan probe would take ~30min on one
+        # core, so the analytic-FLOPs MFU is computed from the measured
+        # end-to-end embed rate against the measured matmul roofline —
+        # non-null on every backend (VERDICT r5 weak #4 / item 6)
+        peak_cpu, peak_src = _backend_peak()
+        per_token_flops = _encoder_flops_per_batch(enc.cfg, 1, seq_T) / seq_T
+        achieved = embed_tokens_per_sec * per_token_flops
+        mfu = round(achieved / peak_cpu, 4) if peak_cpu else None
+        mfu_note = (
+            f"analytic FLOPs at the e2e embed rate vs {peak_src} "
+            "(tokenize/h2d included, so this lower-bounds device compute)"
+        )
     _PARTIAL["embed_mfu"] = mfu
     _PARTIAL["embed_tokens_per_sec"] = round(embed_tokens_per_sec)
 
@@ -1624,6 +1833,13 @@ def main() -> None:
     _PARTIAL["wordcount_cold_rows_per_sec"] = round(wordcount_cold_rps)
     _stage("generation")
     generation = bench_generation()
+    _PARTIAL["generation"] = generation
+    _stage("tp virtual decode")
+    tp_virtual = _bench_tp_virtual()
+    generation["decode_tokens_per_s_tp8_virtual"] = tp_virtual.get(
+        "decode_tokens_per_s_tp8"
+    )
+    generation["tp_virtual"] = tp_virtual
     _PARTIAL["generation"] = generation
     _stage("retrieval quality")
     retrieval_quality = bench_retrieval_quality()
@@ -1670,9 +1886,9 @@ def main() -> None:
         "wordcount_cold_rows_per_sec": round(wordcount_cold_rps),
         "embed_tokens_per_sec": round(embed_tokens_per_sec),
         "embed_mfu": mfu,
-        "embed_mfu_note": "device-compute (scan probe); "
-                          "embed_tokens_per_sec is end-to-end",
+        "embed_mfu_note": mfu_note,
         "embed_gflops_per_sec": round(achieved / 1e9, 1),
+        "decode_mfu": generation.get("decode_mfu"),
         "stages": stages,
         "generation": generation,
         "retrieval_quality": retrieval_quality,
@@ -1689,6 +1905,17 @@ def main() -> None:
     if tpu_evidence:
         out["tpu_evidence"] = tpu_evidence
     out["regressions"] = _self_history_regressions(out)
+    # hard self-history gate (VERDICT item 3): >10% regression on a gated
+    # metric exits nonzero — but only AFTER the JSON line and self-report
+    # land, so the evidence of the regression is never lost to the exit
+    gate_off = bool(os.environ.get("PATHWAY_BENCH_NO_GATE"))
+    gate_fails = _gate_failures(out["regressions"])
+    out["gate"] = {
+        "metrics": sorted(_GATED_METRICS),
+        "tolerance": _GATE_TOLERANCE,
+        "failures": gate_fails,
+        "enforced": not gate_off,
+    }
     # the full record — including the verbose probe log — lives in the
     # committed self-report; the printed line stays small enough that a
     # bounded tail capture keeps every headline field
@@ -1699,7 +1926,22 @@ def main() -> None:
     global _DONE
     _DONE = True
     print(json.dumps(out), flush=True)
+    if gate_fails and not gate_off:
+        print(
+            "[bench] GATE FAILED (>10% regression vs best committed "
+            "history): "
+            + "; ".join(
+                f"{r['metric']} {r['current']} vs best {r['best']} "
+                f"({r['best_source']})" for r in gate_fails
+            )
+            + " — set PATHWAY_BENCH_NO_GATE=1 for exploratory runs",
+            file=sys.stderr, flush=True,
+        )
+        sys.exit(4)
 
 
 if __name__ == "__main__":
-    main()
+    if os.environ.get("PW_BENCH_TP8_CHILD"):
+        _bench_tp_virtual_child()
+    else:
+        main()

@@ -104,6 +104,8 @@ class MetricsServer:
             kv_rows = "".join(
                 f"<tr><td>{s['name']}</td>"
                 f"<td>{s['blocks_in_use']}/{s['blocks_total']}</td>"
+                f"<td>{s.get('shards', 1)}&times;"
+                f"{s.get('shard_hbm_bytes', 0) / 1e6:.1f}MB</td>"
                 f"<td>{s['prefix_hits']}/{s['prefix_hits'] + s['prefix_misses']}</td>"
                 f"<td>{s['preemptions']}</td><td>{s['cow_copies']}</td>"
                 f"<td>{s['prefix_evictions']}</td>"
@@ -114,7 +116,8 @@ class MetricsServer:
             )
             kv_html = (
                 "<h3>kv cache</h3><table><tr><th>pool</th>"
-                "<th>blocks</th><th>prefix hit/lookup</th>"
+                "<th>blocks</th><th>tp&times;shard HBM</th>"
+                "<th>prefix hit/lookup</th>"
                 "<th>preempt</th><th>cow</th><th>evict</th>"
                 "<th>chunks</th><th>mixed occ</th>"
                 f"<th>ttft p50 ms</th></tr>{kv_rows}</table>"

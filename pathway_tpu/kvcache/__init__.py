@@ -19,12 +19,23 @@ prefills, greedy argmax runs inside the jitted step (only [B] int32 ids
 cross to host per round), and the Pallas kernel's grid is length-aware
 (blocks past a row's context are neither DMA'd nor computed).
 
+Round-9 (ARCHITECTURE.md "Round-9: Tensor-parallel paged decode") shards
+the whole serving path over a (dp=1, tp=N) device mesh: the pool's K/V
+arrays split on the head axis (n_kv_heads/tp per shard — N x aggregate
+KV HBM, so N x more live sequences at fixed model size), every step
+program runs under shard_map with Megatron column/row-parallel
+projections and ONE psum per layer pair, and sampling stays device-side
+(greedy argmax fused into the sharded vocab head as an exact two-stage
+reduction — no replicated [B, vocab] gather ever materializes).
+``PagedDecodeEngine(tp=...)``; tp=1 degenerates to the exact
+single-device programs.
+
 Kernel shape follows Ragged Paged Attention (arxiv 2604.15464); the
 managed-resource framing follows arxiv 2603.09555.
 """
 
 from .block_pool import BlockPool, PoolExhausted, SequenceState
-from .engine import PagedDecodeEngine
+from .engine import PagedDecodeEngine, resolve_tp
 from .paged_attention import paged_attention, paged_attention_reference
 from .prefix_cache import PrefixCache
 
@@ -34,6 +45,7 @@ __all__ = [
     "SequenceState",
     "PrefixCache",
     "PagedDecodeEngine",
+    "resolve_tp",
     "paged_attention",
     "paged_attention_reference",
 ]

@@ -78,7 +78,7 @@ class BlockPool:
 
     def __init__(self, *, num_blocks: int, block_size: int, n_layers: int,
                  n_heads: int, head_dim: int, dtype=jnp.float32,
-                 name: str = "kvcache"):
+                 name: str = "kvcache", mesh=None, tp_axis: str = "tp"):
         if num_blocks < 2:
             raise ValueError("num_blocks must be >= 2 (block 0 is reserved)")
         self.num_blocks = int(num_blocks)
@@ -88,8 +88,37 @@ class BlockPool:
         self.head_dim = int(head_dim)
         self.dtype = dtype
         shape = (n_layers, num_blocks, block_size, n_heads, head_dim)
-        self.k = jnp.zeros(shape, dtype)
-        self.v = jnp.zeros(shape, dtype)
+        # Round-9 tensor parallelism: with a mesh, the K/V arrays are laid
+        # out [L, NB, BS, n_kv_heads/tp, hd] PER SHARD via NamedSharding on
+        # the head axis — N x aggregate KV HBM across the mesh.  Block
+        # tables, the free list, refcounts and every piece of allocation
+        # bookkeeping below stay host-side and replicated: a block id means
+        # the same (head-split) physical block on every shard, so the
+        # allocator logic is untouched by sharding.
+        self.mesh = mesh
+        self.tp_axis = tp_axis
+        self.tp = 1
+        if mesh is not None:
+            self.tp = int(mesh.shape[tp_axis])
+            if self.n_heads % self.tp:
+                raise ValueError(
+                    f"cannot shard the KV pool: n_kv_heads={self.n_heads} "
+                    f"% tp={self.tp} != 0. Legal tp values: "
+                    f"{[t for t in range(1, self.n_heads + 1) if self.n_heads % t == 0]}"
+                )
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            sharding = NamedSharding(
+                mesh, P(None, None, None, tp_axis, None)
+            )
+            zeros = jax.jit(
+                lambda: jnp.zeros(shape, dtype), out_shardings=sharding
+            )
+            self.k = zeros()
+            self.v = zeros()
+        else:
+            self.k = jnp.zeros(shape, dtype)
+            self.v = jnp.zeros(shape, dtype)
         # block 0 reserved: never allocated, target of padded writes
         self._free: list[int] = list(range(num_blocks - 1, 0, -1))
         self._ref = np.zeros(num_blocks, np.int32)
@@ -118,9 +147,16 @@ class BlockPool:
 
         self.stats = kv_stats(
             name, blocks_in_use_fn=_in_use, blocks_total=num_blocks - 1,
+            shards=self.tp, shard_hbm_bytes=self.per_shard_bytes,
         )
 
     # -- capacity ----------------------------------------------------------
+    @property
+    def per_shard_bytes(self) -> int:
+        """K + V HBM held by EACH shard (the whole pool when tp=1)."""
+        total = int(self.k.size) + int(self.v.size)
+        return total * self.k.dtype.itemsize // self.tp
+
     @property
     def num_free(self) -> int:
         return len(self._free)

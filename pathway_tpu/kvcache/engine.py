@@ -117,6 +117,32 @@ def build_engine(cfg, params, fallback_msg: str, logger_name: str,
         return None
 
 
+def resolve_tp(cfg, tp: int | None) -> int:
+    """Resolve the tensor-parallel degree for a decoder config.
+
+    ``tp=None`` (auto) picks all local devices on a TPU backend —
+    stepping down to the largest degree that divides both ``n_heads``
+    (the KV heads) and ``vocab_size`` — and 1 on the CPU fallback, where
+    virtual shards share one core and collectives only add overhead.  An
+    EXPLICIT ``tp`` is validated loudly instead
+    (:func:`pathway_tpu.parallel.mesh.validate_decoder_tp`): requesting
+    an impossible shard is a configuration error, not a preference."""
+    n_dev = len(jax.devices())
+    d_ff = getattr(cfg, "d_ff", None)
+    if tp is None:
+        if jax.default_backend() != "tpu":
+            return 1
+        from ..parallel.mesh import legal_tp_values
+
+        legal = legal_tp_values(cfg.n_heads, cfg.vocab_size, n_dev, d_ff)
+        return max(legal) if legal else 1
+    tp = int(tp)
+    from ..parallel.mesh import validate_decoder_tp
+
+    validate_decoder_tp(cfg.n_heads, cfg.vocab_size, tp, n_dev, d_ff)
+    return tp
+
+
 class PagedDecodeEngine:
     """Batched greedy decoding through BlockPool + PrefixCache."""
 
@@ -125,22 +151,33 @@ class PagedDecodeEngine:
                  max_batch_size: int = 8, seq_buckets=(64, 256, 1024),
                  prefix_sharing: bool = True, stop_token: int | None = None,
                  attn: str | None = None, chunked_prefill: bool = True,
-                 prefill_chunk: int | None = None,
+                 prefill_chunk: int | None = None, tp: int | None = None,
                  name: str = "paged_decoder"):
         from ..models.encoder import _resolve_dtype
 
         self.cfg = cfg
-        self.params = params
         self.max_batch_size = int(max_batch_size)
         self.stop_token = stop_token
         if attn is None:
             attn = "pallas" if jax.default_backend() == "tpu" else "reference"
         self.attn = attn
+        # Round-9 tensor parallelism: tp > 1 lays the K/V pool out over a
+        # (dp=1, tp) mesh (n_kv_heads/tp per shard — N x aggregate KV HBM)
+        # and shard_maps every step program; tp == 1 keeps the EXACT
+        # single-device round-8 programs (no mesh, no shard_map wrapper)
+        self.tp = resolve_tp(cfg, tp)
+        self.mesh = None
+        if self.tp > 1:
+            from ..parallel.mesh import shard_decoder_params, tp_mesh
+
+            self.mesh = tp_mesh(self.tp)
+            params = shard_decoder_params(params, self.mesh)
+        self.params = params
         head_dim = cfg.d_model // cfg.n_heads
         self.pool = BlockPool(
             num_blocks=num_blocks, block_size=block_size,
             n_layers=cfg.n_layers, n_heads=cfg.n_heads, head_dim=head_dim,
-            dtype=_resolve_dtype(cfg.dtype), name=name,
+            dtype=_resolve_dtype(cfg.dtype), name=name, mesh=self.mesh,
         )
         self.prefix = PrefixCache(self.pool) if prefix_sharing else None
         bs = self.pool.block_size
@@ -182,13 +219,23 @@ class PagedDecodeEngine:
         self._inflight_prefix: dict = {}
         _cfg = cfg
         _attn = self.attn
+        _mesh = self.mesh
 
         # device-side sampling: every step/prefill wrapper argmaxes INSIDE
         # the jitted program, so only [B] int32 ids (not [B, vocab]
-        # logits) cross the device->host boundary per round
+        # logits) cross the device->host boundary per round.  Under tp the
+        # shard_map variants return ids directly — greedy sampling is
+        # fused into the sharded vocab head as an exact two-stage argmax
+        # (decoder._head_out), so the full [B, vocab] logits are never
+        # materialized on any device either.
         def _step_fn(p, k_pool, v_pool, token, positions, bt, sb, so):
-            from ..models.decoder import paged_decode_step
+            from ..models.decoder import paged_decode_step, paged_decode_step_tp
 
+            if _mesh is not None:
+                return paged_decode_step_tp(
+                    p, _cfg, _mesh, k_pool, v_pool, token, positions, bt,
+                    sb, so, attn=_attn,
+                )
             logits, k_pool, v_pool = paged_decode_step(
                 p, _cfg, k_pool, v_pool, token, positions, bt, sb, so,
                 attn=_attn,
@@ -199,8 +246,14 @@ class PagedDecodeEngine:
         def _mixed_fn(p, k_pool, v_pool, tokens, positions, row_tables,
                       row_start, row_nvalid, row_token_idx, tok_row,
                       tok_col, sb, so, logit_idx):
-            from ..models.decoder import paged_mixed_step
+            from ..models.decoder import paged_mixed_step, paged_mixed_step_tp
 
+            if _mesh is not None:
+                return paged_mixed_step_tp(
+                    p, _cfg, _mesh, k_pool, v_pool, tokens, positions,
+                    row_tables, row_start, row_nvalid, row_token_idx,
+                    tok_row, tok_col, sb, so, logit_idx, attn=_attn,
+                )
             logits, k_pool, v_pool = paged_mixed_step(
                 p, _cfg, k_pool, v_pool, tokens, positions, row_tables,
                 row_start, row_nvalid, row_token_idx, tok_row, tok_col,
@@ -210,8 +263,12 @@ class PagedDecodeEngine:
                 k_pool, v_pool
 
         def _prefill_fn(p, token_ids, n_valid, k_pool, v_pool, bt):
-            from ..models.decoder import paged_prefill
+            from ..models.decoder import paged_prefill, paged_prefill_tp
 
+            if _mesh is not None:
+                return paged_prefill_tp(
+                    p, _cfg, _mesh, token_ids, n_valid, k_pool, v_pool, bt
+                )
             logits, k_pool, v_pool = paged_prefill(
                 p, _cfg, token_ids, n_valid, k_pool, v_pool, bt
             )

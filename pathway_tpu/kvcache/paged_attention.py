@@ -39,6 +39,13 @@ context 1 against the null block (the engine does).
 
 Pool layout: ``(num_blocks, block_size, n_heads, head_dim)`` per layer
 (the per-layer slice of BlockPool's stacked arrays).
+
+Round-9 tensor parallelism: heads are fully independent here, so the op
+needs NO collectives and no tp-specific code — inside a shard_map over
+the (dp=1, tp=N) mesh each shard simply passes its
+``n_kv_heads/tp``-head pool slice and query slice (the H axis is just
+smaller, the kernel grid is unchanged).  The psum/all-gather points live
+in the projections around the op (models/decoder.py).
 """
 
 from __future__ import annotations

@@ -44,6 +44,74 @@ def init_decoder_params(cfg: DecoderConfig, rng: jax.Array) -> dict:
     return init_params(cfg.as_encoder_cfg(), rng)
 
 
+# -- tensor-parallel building blocks (Round-9) -------------------------------
+#
+# The paged step functions take an optional ``tp_axis``: None (default)
+# leaves every op EXACTLY as the single-device round-8 program — the same
+# jitted code, no collectives — while "tp" (inside a shard_map over
+# parallel/mesh.py's (dp=1, tp=N) mesh, params laid out by
+# ``decoder_param_sharding_rules``) makes each shard run its n_heads/tp
+# heads and vocab/tp embedding rows with ONE psum per row-parallel
+# projection and an exact two-stage argmax over the sharded vocab head
+# (the step functions then return ids, not logits — see _head_out).
+
+
+def _psum_if(x, tp_axis):
+    return x if tp_axis is None else jax.lax.psum(x, tp_axis)
+
+
+def _embed_rows(embed, tokens, tp_axis):
+    """Tied-embedding lookup.  Sharded-vocab form: each token's row lives
+    on exactly one shard; the psum of one exact row plus zeros is exact,
+    so tp output is bit-identical to the replicated lookup."""
+    if tp_axis is None:
+        return embed[tokens]
+    v_loc = embed.shape[0]
+    local = tokens - jax.lax.axis_index(tp_axis) * v_loc
+    ok = (local >= 0) & (local < v_loc)
+    rows = jnp.where(ok[..., None], embed[jnp.clip(local, 0, v_loc - 1)], 0)
+    return jax.lax.psum(rows, tp_axis)
+
+
+def _row_proj(layer, x, w_name: str, b_name: str, tp_axis):
+    """Row-parallel projection: the tp contraction is split across shards,
+    so partial products are psum'd BEFORE the (replicated) bias is added
+    once.  tp_axis=None is byte-for-byte encoder._proj."""
+    out = _psum_if(x @ layer[w_name].astype(x.dtype), tp_axis)
+    b = layer.get(b_name)
+    if b is not None:
+        out = out + b.astype(x.dtype)
+    return out
+
+
+def _head_out(embed, x, tp_axis):
+    """Vocab head.  tp_axis=None: (B, D) @ embed.T -> (B, V) f32 logits,
+    the caller samples (the round-8 contract, unchanged).
+
+    Sharded vocab: greedy sampling is FUSED here as an exact two-stage
+    argmax — each shard argmaxes its local (B, V/tp) logits slice, then
+    only the (value, global index) pairs cross shards (O(B*tp) floats,
+    vs O(B*V) for gathering replicated logits: materializing the full
+    vocab on-device would re-pay, on ICI, the very transfer device-side
+    sampling exists to avoid).  Ties break to the SMALLEST global index,
+    and the local logits slices are the same bytes a full-vocab matmul
+    would produce (the head contraction is over the unsharded D axis),
+    so the result equals ``jnp.argmax`` of the gathered logits exactly.
+    Returns (B,) int32 ids."""
+    logits = (x @ embed.astype(x.dtype).T).astype(jnp.float32)
+    if tp_axis is None:
+        return logits
+    v_loc = logits.shape[-1]
+    loc = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # (B,)
+    val = jnp.take_along_axis(logits, loc[:, None], axis=-1)[:, 0]
+    gidx = loc + jax.lax.axis_index(tp_axis).astype(jnp.int32) * v_loc
+    vals = jax.lax.all_gather(val, tp_axis)    # (tp, B)
+    idxs = jax.lax.all_gather(gidx, tp_axis)   # (tp, B)
+    best = jnp.max(vals, axis=0)
+    cand = jnp.where(vals == best[None, :], idxs, jnp.iinfo(jnp.int32).max)
+    return jnp.min(cand, axis=0).astype(jnp.int32)
+
+
 def _causal_attention(layer, x, n_heads: int):
     from .encoder import _proj
 
@@ -88,7 +156,8 @@ def forward_logits(params: dict, cfg: DecoderConfig, token_ids: jax.Array) -> ja
 
 
 def prefill(params: dict, cfg: DecoderConfig, token_ids: jax.Array,
-            n_valid: jax.Array, *, flash: bool | None = None):
+            n_valid: jax.Array, *, flash: bool | None = None,
+            tp_axis: str | None = None):
     """Full-context forward over the (padded) prompt, emitting the KV cache
     and the logits at position n_valid-1 (the next-token distribution).
 
@@ -104,11 +173,10 @@ def prefill(params: dict, cfg: DecoderConfig, token_ids: jax.Array,
 
     dtype = _resolve_dtype(cfg.dtype)
     B, T = token_ids.shape
-    H = cfg.n_heads
-    hd = cfg.d_model // H
+    hd = cfg.d_model // cfg.n_heads
     if flash is None:
         flash = jax.default_backend() == "tpu" and T >= 256
-    x = params["embed"].astype(dtype)[token_ids]
+    x = _embed_rows(params["embed"].astype(dtype), token_ids, tp_axis)
     x = x + params["pos_embed"].astype(dtype)[:T][None, :, :]
     eps = cfg.ln_eps
     act = _act_fn(cfg)
@@ -116,35 +184,31 @@ def prefill(params: dict, cfg: DecoderConfig, token_ids: jax.Array,
     cache = []
     for layer in params["layers"]:
         h = _layer_norm(x, layer["ln1_scale"], layer["ln1_bias"], eps)
-        q = _proj(layer, h, "wq", "bq").reshape(B, T, H, hd)
-        k = _proj(layer, h, "wk", "bk").reshape(B, T, H, hd)
-        v = _proj(layer, h, "wv", "bv").reshape(B, T, H, hd)
+        q = _proj(layer, h, "wq", "bq").reshape(B, T, -1, hd)
+        k = _proj(layer, h, "wk", "bk").reshape(B, T, -1, hd)
+        v = _proj(layer, h, "wv", "bv").reshape(B, T, -1, hd)
         cache.append({"k": k, "v": v})
         if flash:
             from ..ops.attention_pallas import flash_attention
 
-            a = flash_attention(q, k, v, causal=True).reshape(
-                B, T, cfg.d_model
-            )
+            a = flash_attention(q, k, v, causal=True).reshape(B, T, -1)
         else:
             scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(hd)
             scores = jnp.where(causal[None, None, :, :], scores, -1e9)
             probs = jax.nn.softmax(
                 scores.astype(jnp.float32), axis=-1
             ).astype(h.dtype)
-            a = jnp.einsum("bhqk,bkhd->bqhd", probs, v).reshape(
-                B, T, cfg.d_model
-            )
-        x = x + _proj(layer, a, "wo", "bo")
+            a = jnp.einsum("bhqk,bkhd->bqhd", probs, v).reshape(B, T, -1)
+        x = x + _row_proj(layer, a, "wo", "bo", tp_axis)
         h = _layer_norm(x, layer["ln2_scale"], layer["ln2_bias"], eps)
         ff = act(_proj(layer, h, "w_up", "b_up"))
-        x = x + _proj(layer, ff, "w_down", "b_down")
+        x = x + _row_proj(layer, ff, "w_down", "b_down", tp_axis)
     x = _layer_norm(x, params["ln_f_scale"], params["ln_f_bias"], eps)
     last = jnp.take_along_axis(
         x, (n_valid - 1)[:, None, None].astype(jnp.int32), axis=1
     )[:, 0, :]
-    logits = (last @ params["embed"].astype(last.dtype).T).astype(jnp.float32)
-    return logits, cache
+    out = _head_out(params["embed"], last, tp_axis)
+    return out, cache
 
 
 def decode_step(params: dict, cfg: DecoderConfig, cache: list[dict],
@@ -189,7 +253,8 @@ def decode_step(params: dict, cfg: DecoderConfig, cache: list[dict],
 
 def paged_prefill(params: dict, cfg: DecoderConfig, token_ids: jax.Array,
                   n_valid: jax.Array, k_pool: jax.Array, v_pool: jax.Array,
-                  block_tables: jax.Array, *, flash: bool | None = None):
+                  block_tables: jax.Array, *, flash: bool | None = None,
+                  tp_axis: str | None = None):
     """Prefill through the paged KV cache (kvcache/block_pool.py).
 
     Runs the exact dense :func:`prefill` (so prompt logits are bit-identical
@@ -203,14 +268,15 @@ def paged_prefill(params: dict, cfg: DecoderConfig, token_ids: jax.Array,
     context length) and are overwritten slot-by-slot as decoding proceeds.
     Returns ``(logits, k_pool, v_pool)``.
     """
-    logits, cache = prefill(params, cfg, token_ids, n_valid, flash=flash)
+    logits, cache = prefill(params, cfg, token_ids, n_valid, flash=flash,
+                            tp_axis=tp_axis)
     B, T = token_ids.shape
     BS = k_pool.shape[2]
     nb = T // BS
-    H = cfg.n_heads
-    hd = cfg.d_model // H
-    k_new = jnp.stack([c["k"] for c in cache])  # (L, B, T, H, hd)
+    hd = cfg.d_model // cfg.n_heads
+    k_new = jnp.stack([c["k"] for c in cache])  # (L, B, T, H[/tp], hd)
     v_new = jnp.stack([c["v"] for c in cache])
+    H = k_new.shape[3]  # per-shard head count under tp_axis
     k_blocks = k_new.reshape(cfg.n_layers, B, nb, BS, H, hd)
     v_blocks = v_new.reshape(cfg.n_layers, B, nb, BS, H, hd)
     k_pool = k_pool.at[:, block_tables].set(k_blocks)
@@ -222,7 +288,7 @@ def paged_decode_step(params: dict, cfg: DecoderConfig, k_pool: jax.Array,
                       v_pool: jax.Array, token: jax.Array,
                       positions: jax.Array, block_tables: jax.Array,
                       slot_blocks: jax.Array, slot_offsets: jax.Array, *,
-                      attn: str = "reference"):
+                      attn: str = "reference", tp_axis: str | None = None):
     """One batched incremental token through the paged cache.
 
     Unlike :func:`decode_step` (one shared scalar ``pos`` — the
@@ -236,7 +302,8 @@ def paged_decode_step(params: dict, cfg: DecoderConfig, k_pool: jax.Array,
     token/positions/slot_blocks/slot_offsets: (B,) int32;
     block_tables: (B, NB) int32.  ``attn``: "reference" (gather, tier-1) or
     "pallas" (kvcache/paged_attention.py kernel).
-    Returns ``(logits, k_pool, v_pool)``.
+    Returns ``(logits, k_pool, v_pool)`` — under ``tp_axis`` the first
+    element is the greedily sampled (B,) int32 ids instead (_head_out).
     """
     from .encoder import _proj
     from ..kvcache.paged_attention import (paged_attention,
@@ -244,18 +311,17 @@ def paged_decode_step(params: dict, cfg: DecoderConfig, k_pool: jax.Array,
 
     dtype = _resolve_dtype(cfg.dtype)
     B = token.shape[0]
-    H = cfg.n_heads
-    hd = cfg.d_model // H
-    x = params["embed"].astype(dtype)[token][:, None, :]  # (B, 1, D)
+    hd = cfg.d_model // cfg.n_heads
+    x = _embed_rows(params["embed"].astype(dtype), token, tp_axis)[:, None, :]
     x = x + params["pos_embed"].astype(dtype)[positions][:, None, :]
     eps = cfg.ln_eps
     act = _act_fn(cfg)
     context_lens = (positions + 1).astype(jnp.int32)
     for li, layer in enumerate(params["layers"]):
         h = _layer_norm(x, layer["ln1_scale"], layer["ln1_bias"], eps)
-        q = _proj(layer, h, "wq", "bq").reshape(B, 1, H, hd)
-        k1 = _proj(layer, h, "wk", "bk").reshape(B, 1, H, hd)
-        v1 = _proj(layer, h, "wv", "bv").reshape(B, 1, H, hd)
+        q = _proj(layer, h, "wq", "bq").reshape(B, 1, -1, hd)
+        k1 = _proj(layer, h, "wk", "bk").reshape(B, 1, -1, hd)
+        v1 = _proj(layer, h, "wv", "bv").reshape(B, 1, -1, hd)
         k_pool = k_pool.at[li, slot_blocks, slot_offsets].set(k1[:, 0])
         v_pool = v_pool.at[li, slot_blocks, slot_offsets].set(v1[:, 0])
         if attn == "pallas":
@@ -266,13 +332,13 @@ def paged_decode_step(params: dict, cfg: DecoderConfig, k_pool: jax.Array,
             a = paged_attention_reference(
                 q, k_pool[li], v_pool[li], block_tables, context_lens
             )
-        x = x + _proj(layer, a.reshape(B, 1, cfg.d_model), "wo", "bo")
+        x = x + _row_proj(layer, a.reshape(B, 1, -1), "wo", "bo", tp_axis)
         h = _layer_norm(x, layer["ln2_scale"], layer["ln2_bias"], eps)
         ff = act(_proj(layer, h, "w_up", "b_up"))
-        x = x + _proj(layer, ff, "w_down", "b_down")
+        x = x + _row_proj(layer, ff, "w_down", "b_down", tp_axis)
     x = _layer_norm(x, params["ln_f_scale"], params["ln_f_bias"], eps)
-    logits = (x[:, 0, :] @ params["embed"].astype(x.dtype).T).astype(jnp.float32)
-    return logits, k_pool, v_pool
+    out = _head_out(params["embed"], x[:, 0, :], tp_axis)
+    return out, k_pool, v_pool
 
 
 def paged_mixed_step(params: dict, cfg: DecoderConfig, k_pool: jax.Array,
@@ -282,7 +348,7 @@ def paged_mixed_step(params: dict, cfg: DecoderConfig, k_pool: jax.Array,
                      row_token_idx: jax.Array, tok_row: jax.Array,
                      tok_col: jax.Array, slot_blocks: jax.Array,
                      slot_offsets: jax.Array, logit_idx: jax.Array, *,
-                     attn: str = "reference"):
+                     attn: str = "reference", tp_axis: str | None = None):
     """One RAGGED fused step over a token-PACKED mixed batch (Round-8;
     Ragged Paged Attention, arxiv 2604.15464).
 
@@ -324,6 +390,8 @@ def paged_mixed_step(params: dict, cfg: DecoderConfig, k_pool: jax.Array,
     Returns ``(logits, k_pool, v_pool)`` with ``logits`` (B, V): only
     the B selected tokens feed the vocab head — one (B, V) matmul, not
     (T, V); mid-prefill rows' logits are garbage the engine ignores.
+    Under ``tp_axis`` the first element is the greedily sampled (B,)
+    int32 ids instead (_head_out).
     """
     from .encoder import _proj
     from ..kvcache.paged_attention import (paged_attention,
@@ -331,23 +399,22 @@ def paged_mixed_step(params: dict, cfg: DecoderConfig, k_pool: jax.Array,
 
     dtype = _resolve_dtype(cfg.dtype)
     T = tokens.shape[0]
-    H = cfg.n_heads
-    hd = cfg.d_model // H
+    hd = cfg.d_model // cfg.n_heads
     # padding tokens may carry position 0 already; clamp defensively so a
     # caller bug cannot index past the embedding table
     pos = jnp.minimum(positions, cfg.max_len - 1)
-    x = params["embed"].astype(dtype)[tokens]  # (T, D)
+    x = _embed_rows(params["embed"].astype(dtype), tokens, tp_axis)  # (T, D)
     x = x + params["pos_embed"].astype(dtype)[pos]
     eps = cfg.ln_eps
     act = _act_fn(cfg)
     for li, layer in enumerate(params["layers"]):
         h = _layer_norm(x, layer["ln1_scale"], layer["ln1_bias"], eps)
-        q = _proj(layer, h, "wq", "bq").reshape(T, H, hd)
-        k1 = _proj(layer, h, "wk", "bk").reshape(T, H, hd)
-        v1 = _proj(layer, h, "wv", "bv").reshape(T, H, hd)
+        q = _proj(layer, h, "wq", "bq").reshape(T, -1, hd)
+        k1 = _proj(layer, h, "wk", "bk").reshape(T, -1, hd)
+        v1 = _proj(layer, h, "wv", "bv").reshape(T, -1, hd)
         k_pool = k_pool.at[li, slot_blocks, slot_offsets].set(k1)
         v_pool = v_pool.at[li, slot_blocks, slot_offsets].set(v1)
-        q_rows = q[row_token_idx]  # (B, C, H, hd)
+        q_rows = q[row_token_idx]  # (B, C, H[/tp], hd)
         if attn == "pallas":
             a_rows = paged_attention(
                 q_rows, k_pool[li], v_pool[li], row_tables,
@@ -358,15 +425,107 @@ def paged_mixed_step(params: dict, cfg: DecoderConfig, k_pool: jax.Array,
                 q_rows, k_pool[li], v_pool[li], row_tables,
                 start_pos=row_start, n_valid=row_nvalid,
             )
-        a = a_rows[tok_row, tok_col]  # back to the packed (T, H, hd)
-        x = x + _proj(layer, a.reshape(T, cfg.d_model), "wo", "bo")
+        a = a_rows[tok_row, tok_col]  # back to the packed (T, H[/tp], hd)
+        x = x + _row_proj(layer, a.reshape(T, -1), "wo", "bo", tp_axis)
         h = _layer_norm(x, layer["ln2_scale"], layer["ln2_bias"], eps)
         ff = act(_proj(layer, h, "w_up", "b_up"))
-        x = x + _proj(layer, ff, "w_down", "b_down")
+        x = x + _row_proj(layer, ff, "w_down", "b_down", tp_axis)
     x = _layer_norm(x, params["ln_f_scale"], params["ln_f_bias"], eps)
     sel = x[logit_idx]  # (B, D)
-    logits = (sel @ params["embed"].astype(sel.dtype).T).astype(jnp.float32)
-    return logits, k_pool, v_pool
+    out = _head_out(params["embed"], sel, tp_axis)
+    return out, k_pool, v_pool
+
+
+# -- shard_map wrappers: the tensor-parallel serving path (Round-9) ----------
+
+
+def _tp_shard_map(fn, mesh, params, n_pool: int, n_rep: int):
+    """shard_map a paged step: params by decoder rules, ``n_pool`` K/V pool
+    arrays on the head axis, ``n_rep`` replicated host-built index arrays;
+    outputs are (replicated sampled ids, *sharded pools)."""
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel.mesh import KV_POOL_PSPEC, decoder_param_specs
+
+    return shard_map(
+        fn, mesh=mesh,
+        in_specs=(decoder_param_specs(params),)
+        + (KV_POOL_PSPEC,) * n_pool + (P(),) * n_rep,
+        out_specs=(P(),) + (KV_POOL_PSPEC,) * n_pool,
+        check_rep=False,
+    )
+
+
+def paged_decode_step_tp(params: dict, cfg: DecoderConfig, mesh,
+                         k_pool: jax.Array, v_pool: jax.Array,
+                         token: jax.Array, positions: jax.Array,
+                         block_tables: jax.Array, slot_blocks: jax.Array,
+                         slot_offsets: jax.Array, *,
+                         attn: str = "reference"):
+    """:func:`paged_decode_step` sharded over ``mesh``'s tp axis: each
+    shard scatters/gathers its n_kv_heads/tp slice of the pool and runs
+    the same ragged attention on fewer heads; QKV is column-parallel, the
+    output projection row-parallel with one psum, and greedy sampling is
+    fused into the sharded vocab head (an exact two-stage argmax — see
+    :func:`_head_out`), so the first return value is the (B,) int32
+    sampled ids, NOT logits: the full [B, vocab] array never exists on
+    any device.  ``params``/pools must be laid out by
+    ``parallel.mesh.shard_decoder_params`` / ``kv_pool_sharding``."""
+
+    def fn(p, k_pool, v_pool, token, positions, bt, sb, so):
+        return paged_decode_step(
+            p, cfg, k_pool, v_pool, token, positions, bt, sb, so,
+            attn=attn, tp_axis="tp",
+        )
+
+    return _tp_shard_map(fn, mesh, params, 2, 5)(
+        params, k_pool, v_pool, token, positions, block_tables,
+        slot_blocks, slot_offsets,
+    )
+
+
+def paged_mixed_step_tp(params: dict, cfg: DecoderConfig, mesh,
+                        k_pool: jax.Array, v_pool: jax.Array,
+                        tokens: jax.Array, positions: jax.Array,
+                        row_tables: jax.Array, row_start: jax.Array,
+                        row_nvalid: jax.Array, row_token_idx: jax.Array,
+                        tok_row: jax.Array, tok_col: jax.Array,
+                        slot_blocks: jax.Array, slot_offsets: jax.Array,
+                        logit_idx: jax.Array, *, attn: str = "reference"):
+    """:func:`paged_mixed_step` over the tp mesh — same collective
+    placement as :func:`paged_decode_step_tp` (the packed FFN/projection
+    stream is column/row-parallel, attention per shard on its heads)."""
+
+    def fn(p, k_pool, v_pool, *rest):
+        return paged_mixed_step(
+            p, cfg, k_pool, v_pool, *rest, attn=attn, tp_axis="tp"
+        )
+
+    return _tp_shard_map(fn, mesh, params, 2, 11)(
+        params, k_pool, v_pool, tokens, positions, row_tables, row_start,
+        row_nvalid, row_token_idx, tok_row, tok_col, slot_blocks,
+        slot_offsets, logit_idx,
+    )
+
+
+def paged_prefill_tp(params: dict, cfg: DecoderConfig, mesh,
+                     token_ids: jax.Array, n_valid: jax.Array,
+                     k_pool: jax.Array, v_pool: jax.Array,
+                     block_tables: jax.Array, *, flash: bool | None = None):
+    """:func:`paged_prefill` over the tp mesh: the dense prefill runs with
+    per-shard heads (same kernel, fewer heads) and each shard scatters its
+    own K/V slice into its pool shard."""
+
+    def fn(p, k_pool, v_pool, token_ids, n_valid, bt):
+        return paged_prefill(
+            p, cfg, token_ids, n_valid, k_pool, v_pool, bt,
+            flash=flash, tp_axis="tp",
+        )
+
+    return _tp_shard_map(fn, mesh, params, 2, 3)(
+        params, k_pool, v_pool, token_ids, n_valid, block_tables,
+    )
 
 
 def generate_tokens_fused(params: dict, cfg: DecoderConfig,

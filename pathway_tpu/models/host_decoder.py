@@ -214,7 +214,8 @@ class Int8DecoderHost:
         return self._paged_engine or None
 
     def serving_executor(self, *, paged: bool | None = None,
-                         max_batch_size: int | None = None, **kwargs):
+                         max_batch_size: int | None = None,
+                         tp: int | None = None, **kwargs):
         """Single shared executor for this decode tier (serve/scheduler.py).
 
         ``paged=True`` (default when the kvcache engine is constructible)
@@ -229,6 +230,15 @@ class Int8DecoderHost:
         same-round arrivals ride one dispatch) and sampling runs
         device-side — pass ``chunked_prefill=False`` through
         :meth:`paged_engine` kwargs for the round-7 behavior.
+
+        ``tp=`` (Round-9) shards the paged engine over the local device
+        mesh — the KV block pool's head axis and every step program split
+        tensor-parallel, so aggregate KV HBM (and therefore the number of
+        live sequences) scales with the mesh.  Default (None): all local
+        devices on a TPU backend (stepping down to the largest degree
+        that divides n_kv_heads and vocab), 1 elsewhere; an explicit tp
+        that cannot shard the model raises ValueError naming the
+        offending dims and the legal values.
 
         ``paged=False`` keeps the legacy serialized tier: the int8 host
         cache (`self._K/_V/n_past`) is per-instance mutable state, so
@@ -245,14 +255,15 @@ class Int8DecoderHost:
         instance)."""
         sched = getattr(self, "_serve_executor", None)
         if sched is not None and not sched._closed:
-            if paged is not None or max_batch_size is not None:
+            if paged is not None or max_batch_size is not None \
+                    or tp is not None:
                 import logging
 
                 logging.getLogger(__name__).warning(
-                    "serving_executor(paged=%r, max_batch_size=%r) "
+                    "serving_executor(paged=%r, max_batch_size=%r, tp=%r) "
                     "ignored: the shared executor already exists; shut it "
                     "down first to rebuild with different settings",
-                    paged, max_batch_size,
+                    paged, max_batch_size, tp,
                 )
             return sched
         from ..serve.scheduler import RequestScheduler
@@ -269,6 +280,8 @@ class Int8DecoderHost:
             engine_kwargs = {}
             if max_batch_size is not None:
                 engine_kwargs["max_batch_size"] = max_batch_size
+            if tp is not None:
+                engine_kwargs["tp"] = tp
             engine = self.paged_engine(**engine_kwargs)
             if engine is None and paged:
                 raise RuntimeError("paged=True but the KV engine is "

@@ -86,6 +86,108 @@ def shard_params(params, mesh: Mesh):
     return jax.tree_util.tree_map_with_path(place, params)
 
 
+# -- decoder / paged-KV tensor parallelism (Round-9) ------------------------
+#
+# The serving path shards over a (dp=1, tp=N) mesh: K/V pool arrays split
+# on the head axis, decoder params follow Megatron column/row rules with
+# ONE psum per row-parallel projection, and the vocab axis of the tied
+# embedding is sharded so logits are all-gathered before the in-jit
+# argmax.  Unlike the encoder rules above, the decoder keeps ``pos_embed``
+# replicated (positions are gathered per token inside shard_map) and
+# shards the column-parallel BIASES alongside their weights.
+
+# [n_layers, num_blocks, block_size, n_kv_heads, head_dim]: heads over tp
+KV_POOL_PSPEC = P(None, None, None, "tp", None)
+
+
+def kv_pool_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, KV_POOL_PSPEC)
+
+
+def tp_mesh(tp: int) -> Mesh:
+    """A (dp=1, tp=tp) mesh over the first ``tp`` local devices."""
+    return make_mesh(n_devices=tp, dp=1, tp=tp)
+
+
+def legal_tp_values(n_kv_heads: int, vocab_size: int,
+                    n_devices: int | None = None,
+                    d_ff: int | None = None) -> list[int]:
+    cap = min(n_kv_heads, n_devices) if n_devices else n_kv_heads
+    return [
+        t for t in range(1, cap + 1)
+        if n_kv_heads % t == 0 and vocab_size % t == 0
+        and (d_ff is None or d_ff % t == 0)
+    ]
+
+
+def validate_decoder_tp(n_kv_heads: int, vocab_size: int, tp: int,
+                        n_devices: int | None = None,
+                        d_ff: int | None = None) -> None:
+    """Fail loudly — naming the offending dims and the legal tp values —
+    when a requested tensor-parallel degree cannot shard the decoder.
+    Every tp-split dimension is checked: the KV heads (attention shard +
+    d_model, which is n_heads*head_dim), the vocab (tied embedding), and
+    d_ff (column-parallel FFN-up / row-parallel FFN-down)."""
+    problems = []
+    if tp < 1:
+        problems.append(f"tp={tp} must be >= 1")
+    else:
+        if n_kv_heads % tp:
+            problems.append(f"n_kv_heads={n_kv_heads} % tp={tp} != 0")
+        if vocab_size % tp:
+            problems.append(f"vocab_size={vocab_size} % tp={tp} != 0")
+        if d_ff is not None and d_ff % tp:
+            problems.append(f"d_ff={d_ff} % tp={tp} != 0")
+        if n_devices is not None and tp > n_devices:
+            problems.append(f"tp={tp} > {n_devices} local devices")
+    if problems:
+        legal = legal_tp_values(n_kv_heads, vocab_size, n_devices, d_ff)
+        raise ValueError(
+            "cannot shard the paged decode path: "
+            + "; ".join(problems)
+            + f". Legal tp values for this model/host: {legal}"
+        )
+
+
+def decoder_param_sharding_rules(path: tuple[str, ...],
+                                 leaf_shape: tuple[int, ...]) -> P:
+    """Tensor-parallel layout for the DECODER param pytree (models/decoder):
+    - wq/wk/wv/w_up: shard the output dim (column parallel), their biases
+      shard with them;
+    - wo/w_down: shard the input dim (row parallel; one psum after, so the
+      replicated bo/b_down is added ONCE, post-reduction);
+    - embed: shard the vocab dim (tied input lookup + output head);
+    - pos_embed / layer norms / everything else: replicated.
+    """
+    name = path[-1] if path else ""
+    if name in ("wq", "wk", "wv", "w_up", "w_gate"):
+        return P(None, "tp")
+    if name in ("bq", "bk", "bv", "b_up", "b_gate"):
+        return P("tp")
+    if name in ("wo", "w_down"):
+        return P("tp", None)
+    if name == "embed":
+        return P("tp", None)
+    return P()
+
+
+def decoder_param_specs(params):
+    def spec(path, leaf):
+        return decoder_param_sharding_rules(_path_names(path), leaf.shape)
+
+    return jax.tree_util.tree_map_with_path(spec, params)
+
+
+def shard_decoder_params(params, mesh: Mesh):
+    """Place a decoder param pytree per :func:`decoder_param_sharding_rules`."""
+
+    def place(path, leaf):
+        spec = decoder_param_sharding_rules(_path_names(path), leaf.shape)
+        return jax.device_put(leaf, NamedSharding(mesh, spec))
+
+    return jax.tree_util.tree_map_with_path(place, params)
+
+
 def _path_names(path) -> tuple[str, ...]:
     out = []
     for p in path:

@@ -134,13 +134,21 @@ class KVCacheStats:
       decode + chunk — per mixed dispatch)
     - ``pathway_kv_ttft_seconds{pool}``         histogram (time from
       request arrival at the engine to its first emitted token)
+    - ``pathway_kv_shard_hbm_bytes{pool,shard}``     gauge (Round-9: K+V
+      HBM held by each tensor-parallel shard)
+    - ``pathway_kv_shard_blocks_in_use{pool,shard}`` gauge (block
+      occupancy per shard — allocation is replicated bookkeeping, so the
+      same block count occupies every shard's head-slice)
     """
 
-    def __init__(self, name: str, blocks_in_use_fn=None, blocks_total: int = 0):
+    def __init__(self, name: str, blocks_in_use_fn=None, blocks_total: int = 0,
+                 shards: int = 1, shard_hbm_bytes: int = 0):
         self.name = name
         self._lock = threading.Lock()
         self._blocks_in_use_fn = blocks_in_use_fn
         self.blocks_total = blocks_total
+        self.shards = shards
+        self.shard_hbm_bytes = shard_hbm_bytes
         self.prefix_hits = 0
         self.prefix_misses = 0
         self.preemptions = 0
@@ -218,6 +226,8 @@ class KVCacheStats:
                 "name": self.name,
                 "blocks_in_use": self.blocks_in_use,
                 "blocks_total": self.blocks_total,
+                "shards": self.shards,
+                "shard_hbm_bytes": self.shard_hbm_bytes,
                 "prefix_hits": self.prefix_hits,
                 "prefix_misses": self.prefix_misses,
                 "preemptions": self.preemptions,
@@ -251,7 +261,8 @@ def serve_stats(name: str, depth_fn=None) -> ServeStats:
         return stats
 
 
-def kv_stats(name: str, blocks_in_use_fn=None, blocks_total: int | None = None
+def kv_stats(name: str, blocks_in_use_fn=None, blocks_total: int | None = None,
+             shards: int | None = None, shard_hbm_bytes: int | None = None
              ) -> KVCacheStats:
     """Get-or-create the KV-cache stats block for `name` (same contract as
     :func:`serve_stats`: counters stay monotonic across pool restarts)."""
@@ -259,13 +270,18 @@ def kv_stats(name: str, blocks_in_use_fn=None, blocks_total: int | None = None
         stats = _kv_registry.get(name)
         if stats is None:
             stats = _kv_registry[name] = KVCacheStats(
-                name, blocks_in_use_fn, blocks_total or 0
+                name, blocks_in_use_fn, blocks_total or 0,
+                shards or 1, shard_hbm_bytes or 0,
             )
         else:
             if blocks_in_use_fn is not None:
                 stats._blocks_in_use_fn = blocks_in_use_fn
             if blocks_total is not None:
                 stats.blocks_total = blocks_total
+            if shards is not None:
+                stats.shards = shards
+            if shard_hbm_bytes is not None:
+                stats.shard_hbm_bytes = shard_hbm_bytes
         return stats
 
 
@@ -351,6 +367,8 @@ def _render_kv_lines() -> list[str]:
         "# TYPE pathway_kv_prefill_chunks_total counter",
         "# TYPE pathway_kv_mixed_steps_total counter",
         "# TYPE pathway_kv_mixed_step_occupancy_avg gauge",
+        "# TYPE pathway_kv_shard_hbm_bytes gauge",
+        "# TYPE pathway_kv_shard_blocks_in_use gauge",
         "# TYPE pathway_kv_ttft_seconds histogram",
     ]
     for s in stats:
@@ -383,6 +401,18 @@ def _render_kv_lines() -> list[str]:
             f"pathway_kv_mixed_step_occupancy_avg{{{lbl}}} "
             f"{snap['mixed_step_occupancy_avg']:.3f}"
         )
+        # per-shard pool HBM + occupancy (tp=1 pools export one shard 0
+        # line, so dashboards need no special single-device case)
+        for shard in range(max(snap.get("shards", 1), 1)):
+            slbl = f'{lbl},shard="{shard}"'
+            lines.append(
+                f"pathway_kv_shard_hbm_bytes{{{slbl}}} "
+                f"{snap.get('shard_hbm_bytes', 0)}"
+            )
+            lines.append(
+                f"pathway_kv_shard_blocks_in_use{{{slbl}}} "
+                f"{snap['blocks_in_use']}"
+            )
         # Prometheus histogram convention: cumulative le buckets + +Inf,
         # then _sum and _count
         cum = 0
@@ -452,4 +482,19 @@ def otlp_points(now_ns: str) -> list[dict]:
                 {"key": "counter", "value": {"stringValue": "ttft_sum"}},
             ],
         })
+        for shard in range(max(snap.get("shards", 1), 1)):
+            shard_attr = {"key": "shard", "value": {"stringValue": str(shard)}}
+            for key, val in (
+                ("shard_hbm_bytes", snap.get("shard_hbm_bytes", 0)),
+                ("shard_blocks_in_use", snap["blocks_in_use"]),
+            ):
+                points.append({
+                    "asInt": str(val),
+                    "timeUnixNano": now_ns,
+                    "attributes": [
+                        {"key": "pool", "value": {"stringValue": s.name}},
+                        {"key": "counter", "value": {"stringValue": key}},
+                        shard_attr,
+                    ],
+                })
     return points
