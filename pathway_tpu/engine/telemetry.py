@@ -111,7 +111,10 @@ class MetricsServer:
                 f"<td>{s['prefix_evictions']}</td>"
                 f"<td>{s.get('prefill_chunks', 0)}</td>"
                 f"<td>{s.get('mixed_step_occupancy_avg', 0.0):.2f}</td>"
-                f"<td>{_ttft_p50_ms(s)}</td></tr>"
+                f"<td>{_ttft_p50_ms(s)}</td>"
+                f"<td>{s.get('chain_count', 0)}</td>"
+                f"<td>{s.get('chain_occupancy', 0.0):.2f}</td>"
+                f"<td>{s.get('host_gap_s', 0.0) * 1e3:.1f}</td></tr>"
                 for s in kv_snaps
             )
             kv_html = (
@@ -120,7 +123,9 @@ class MetricsServer:
                 "<th>prefix hit/lookup</th>"
                 "<th>preempt</th><th>cow</th><th>evict</th>"
                 "<th>chunks</th><th>mixed occ</th>"
-                f"<th>ttft p50 ms</th></tr>{kv_rows}</table>"
+                "<th>ttft p50 ms</th><th>chains</th>"
+                "<th>chain occ</th><th>host gap ms</th></tr>"
+                f"{kv_rows}</table>"
             )
         return (
             "<html><head><title>pathway-tpu</title>"

@@ -256,21 +256,51 @@ class BlockPool:
         so writes never touch blocks other sequences still reference.
         Raises :class:`PoolExhausted` with no state change when a needed
         block cannot be allocated."""
+        return self.extend_slots(seq_id, 1)[0]
+
+    def extend_slots(self, seq_id: int, k: int) -> list[tuple[int, int]]:
+        """Pre-extend a sequence by ``k`` write slots in one call — the
+        Round-10 chained-decode contract: the engine reserves a whole
+        chain's slots BEFORE dispatch, so the device program can scatter
+        K tokens' K/V without any host round trip in between.
+
+        Returns the ``k`` ``(block_id, offset)`` slots in append order
+        and advances ``n_tokens`` by ``k``.  ATOMIC: the needed block
+        count (a COW of a shared tail + one fresh block per crossed
+        boundary) is checked up front, and :class:`PoolExhausted` is
+        raised with NO state change when the free list cannot cover it —
+        so a failed chain reservation leaves the sequence exactly as it
+        was (the engine then evicts/preempts and retries).
+
+        Invariant note (check_invariants): reserved-but-not-yet-written
+        slots count toward ``n_tokens`` immediately — the table/token
+        partition invariant covers in-flight chains the same way it
+        covered the single reserved slot of a per-step round."""
+        if k <= 0:
+            return []
         with self._lock:
             seq = self._seqs[seq_id]
-            offset = seq.n_tokens % self.block_size
-            if offset == 0:
-                if not self._free:
-                    raise PoolExhausted(needed=1, free=0)
-                seq.block_ids.append(self._pop_free())
-            else:
-                tail = seq.block_ids[-1]
-                if self._ref[tail] > 1:
-                    if not self._free:
-                        raise PoolExhausted(needed=1, free=0)
-                    seq.block_ids[-1] = self._cow_block(tail)
-            seq.n_tokens += 1
-            return seq.block_ids[-1], offset
+            offset0 = seq.n_tokens % self.block_size
+            need = -(-(offset0 + k) // self.block_size) - (1 if offset0 else 0)
+            if offset0 and self._ref[seq.block_ids[-1]] > 1:
+                need += 1  # COW of the shared tail block
+            if need > len(self._free):
+                raise PoolExhausted(
+                    f"need {need} blocks, {len(self._free)} free",
+                    needed=need, free=len(self._free),
+                )
+            slots: list[tuple[int, int]] = []
+            for _ in range(k):
+                offset = seq.n_tokens % self.block_size
+                if offset == 0:
+                    seq.block_ids.append(self._pop_free())
+                else:
+                    tail = seq.block_ids[-1]
+                    if self._ref[tail] > 1:
+                        seq.block_ids[-1] = self._cow_block(tail)
+                seq.n_tokens += 1
+                slots.append((seq.block_ids[-1], offset))
+            return slots
 
     def fork(self, parent_id: int, child_id: int, *,
              priority: int | None = None) -> SequenceState:

@@ -27,6 +27,16 @@ decodes MANY sequences per device step:
   ``[B]`` int32 token ids cross the device->host boundary per round
   (the done-mask is a host compare on those ids), shrinking the
   per-token sync by ~vocab x vs shipping ``[B, vocab]`` logits;
+- chained decode (Round-10): when the queue is quiet the engine chains
+  up to ``chain_steps`` greedy steps into ONE device program
+  (lax.scan feeding step t's ids into step t+1, KV scattered in-loop
+  into host-PRE-EXTENDED block tables) and syncs once per chain on a
+  ``[B, K]`` ids array; rounds are double-buffered — chain N+1 is
+  dispatched before chain N's completion callbacks/polling run, so
+  host bookkeeping overlaps device execution.  K adapts back to 1
+  whenever arrivals or preemption are pending (admission semantics
+  unchanged); emitted tokens truncate at EOS/max_new host-side with
+  the per-step done rule, so greedy output is token-identical;
 - continuous batching: between steps the engine polls its scheduler for
   new arrivals and admits them into the in-flight batch (step-boundary
   admission, serve/scheduler.py `poll_inflight`).  N same-round
@@ -152,7 +162,7 @@ class PagedDecodeEngine:
                  prefix_sharing: bool = True, stop_token: int | None = None,
                  attn: str | None = None, chunked_prefill: bool = True,
                  prefill_chunk: int | None = None, tp: int | None = None,
-                 name: str = "paged_decoder"):
+                 chain_steps: int = 8, name: str = "paged_decoder"):
         from ..models.encoder import _resolve_dtype
 
         self.cfg = cfg
@@ -208,6 +218,17 @@ class PagedDecodeEngine:
         # costs one token, the rest is chunk headroom — so the mixed
         # program's cost scales with B + chunk, never B x chunk
         self.mixed_tokens = self.max_batch_size + self.prefill_chunk
+        # Round-10 device-resident multi-step decode: when the queue is
+        # quiet (no pending admissions, no mid-prefill chunks) the engine
+        # chains up to `chain_steps` greedy steps into ONE dispatch and
+        # syncs once per chain on a [B, K] ids array — K adapts back to 1
+        # the moment arrivals or preemption are pending, so TTFT and the
+        # step-boundary admission semantics are unchanged
+        self.chain_steps = max(1, int(chain_steps))
+        # host-gap accounting: perf_counter of the last device->host sync
+        # (the device has nothing queued past it) — the next dispatch
+        # closes the window and records it (see _note_sync/_note_dispatch)
+        self._t_device_idle: float | None = None
         self._seq_counter = 0
         self._lock = threading.RLock()
         # chain key -> (writer _Active, physical block) for blocks an
@@ -262,6 +283,20 @@ class PagedDecodeEngine:
             return jnp.argmax(logits, axis=-1).astype(jnp.int32), \
                 k_pool, v_pool
 
+        def _chained_fn(p, k_pool, v_pool, token, positions, bt, sb, so):
+            from ..models.decoder import (paged_chained_decode,
+                                          paged_chained_decode_tp)
+
+            if _mesh is not None:
+                return paged_chained_decode_tp(
+                    p, _cfg, _mesh, k_pool, v_pool, token, positions, bt,
+                    sb, so, attn=_attn,
+                )
+            return paged_chained_decode(
+                p, _cfg, k_pool, v_pool, token, positions, bt, sb, so,
+                attn=_attn,
+            )
+
         def _prefill_fn(p, token_ids, n_valid, k_pool, v_pool, bt):
             from ..models.decoder import paged_prefill, paged_prefill_tp
 
@@ -283,6 +318,10 @@ class PagedDecodeEngine:
         # whole-bucket prefill specializes per (1, bucket) as before
         self._step = jax.jit(_step_fn, donate_argnums=(1, 2))
         self._mixed = jax.jit(_mixed_fn, donate_argnums=(1, 2))
+        # the chained program's (B, chain_steps) shape is static, so the
+        # whole multi-step hot loop is ONE additional compile on top of
+        # the round-8 pair (K=1 rounds reuse the plain step program)
+        self._chained = jax.jit(_chained_fn, donate_argnums=(1, 2))
         self._prefill = jax.jit(_prefill_fn, donate_argnums=(3, 4))
 
     # -- public API --------------------------------------------------------
@@ -398,6 +437,9 @@ class PagedDecodeEngine:
     def _run_loop(self, pending, deliver, poll, stop):
         running: list[_Active] = []
         self._inflight_prefix.clear()
+        # a dangling idle mark from the PREVIOUS batch's last sync would
+        # bill the whole inter-batch wait to this batch's first dispatch
+        self._t_device_idle = None
         try:
             self._loop_body(running, pending, deliver, poll, stop)
         except BaseException as exc:
@@ -417,19 +459,26 @@ class PagedDecodeEngine:
             raise
         return running
 
+    def _admit_arrivals(self, running, pending, poll, stop) -> None:
+        """Step-boundary admission of newly arrived requests into the
+        pending queue (the chained path also calls this in its overlap
+        window, so arrivals discovered mid-chain adapt the NEXT round
+        back to K=1)."""
+        if poll is None or len(running) >= self.max_batch_size:
+            return
+        budget = self.max_batch_size - len(running) - len(pending)
+        for item in (poll(budget) if budget > 0 else ()):
+            payload, priority, on_done, on_error = item
+            # priority-ordered like _requeue: an urgent arrival
+            # must not queue behind a lower-priority victim
+            self._requeue(pending, _Request(
+                payload[0], payload[1], priority=priority,
+                stop_token=stop, on_done=on_done, on_error=on_error,
+            ))
+
     def _loop_body(self, running, pending, deliver, poll, stop):
         while pending or running:
-            # step-boundary admission of newly arrived requests
-            if poll is not None and len(running) < self.max_batch_size:
-                budget = self.max_batch_size - len(running) - len(pending)
-                for item in (poll(budget) if budget > 0 else ()):
-                    payload, priority, on_done, on_error = item
-                    # priority-ordered like _requeue: an urgent arrival
-                    # must not queue behind a lower-priority victim
-                    self._requeue(pending, _Request(
-                        payload[0], payload[1], priority=priority,
-                        stop_token=stop, on_done=on_done, on_error=on_error,
-                    ))
+            self._admit_arrivals(running, pending, poll, stop)
             while pending and len(running) < self.max_batch_size:
                 req = pending[0]
                 status = self._try_admit(req, running, pending, deliver)
@@ -441,7 +490,7 @@ class PagedDecodeEngine:
                 # _try_admit only returns "wait" while others run, and the
                 # admission loop above drains pending otherwise
                 break
-            self._step_round(running, pending, deliver)
+            self._step_round(running, pending, deliver, poll, stop)
         return running
 
     def _readmit_len(self, req: _Request) -> int:
@@ -463,6 +512,23 @@ class PagedDecodeEngine:
             len(pending),
         )
         pending.insert(idx, req)
+
+    def _note_sync(self) -> None:
+        """A device->host sync just returned with nothing queued behind
+        it: the device is idle until the next dispatch.  Every dispatch
+        site calls :meth:`_note_dispatch` to close (and record) the
+        window, so ``pathway_kv_host_gap_seconds_total`` measures exactly
+        the host-on-critical-path time the device spends waiting — on the
+        double-buffered chained path the bookkeeping that runs AFTER the
+        next dispatch is correctly excluded."""
+        self._t_device_idle = time.perf_counter()
+
+    def _note_dispatch(self) -> None:
+        if self._t_device_idle is not None:
+            self.pool.stats.record_host_gap(
+                time.perf_counter() - self._t_device_idle
+            )
+            self._t_device_idle = None
 
     def _emit(self, req: _Request, token_id: int) -> None:
         """Record one emitted token; the FIRST token of a request closes
@@ -607,6 +673,7 @@ class PagedDecodeEngine:
             # perturb its remaining decode
             scatter_bt = self.pool.block_table(seq_id, nb)
             scatter_bt[: len(shared)] = 0
+            self._note_dispatch()
             ids, self.pool.k, self.pool.v = self._prefill(
                 self.params, jnp.asarray(buf), jnp.asarray([n], jnp.int32),
                 self.pool.k, self.pool.v, jnp.asarray(scatter_bt[None, :]),
@@ -622,7 +689,9 @@ class PagedDecodeEngine:
             # engine's (process-long) lifetime
             self.pool.free_sequence(seq_id)
             raise
-        self._emit(req, int(np.asarray(ids)[0]))
+        first_id = int(np.asarray(ids)[0])
+        self._note_sync()
+        self._emit(req, first_id)
         act = _Active(seq_id, req)
         if self._is_done(req, seq_id):
             self.pool.free_sequence(seq_id)
@@ -640,11 +709,20 @@ class PagedDecodeEngine:
         return self.pool.sequence(seq_id).n_tokens >= self.max_seq_tokens
 
     # -- stepping ----------------------------------------------------------
-    def _step_round(self, running, pending, deliver) -> None:
+    def _step_round(self, running, pending, deliver, poll=None,
+                    stop=None) -> None:
         """One engine step = ONE device program over the ragged in-flight
         batch: decode rows (a reserved write slot each) plus prefill-chunk
         runs sharing the ``mixed_tokens`` budget.  Rounds with no chunk in
-        flight dispatch the cheaper 1-token-per-row program."""
+        flight dispatch the cheaper 1-token-per-row program — or, when the
+        queue is quiet, the Round-10 CHAINED program: up to ``chain_steps``
+        greedy steps per dispatch with host bookkeeping overlapped against
+        device execution (one sync per chain, not per token)."""
+        if self._can_chain(running, pending):
+            if self._chained_rounds(running, pending, deliver, poll, stop):
+                return
+            if not running:
+                return  # every row was preempted into pending; re-admit
         victims: list[_Active] = []
         reserved = self._reserve_slots(running, pending, victims)
         if victims:
@@ -661,6 +739,163 @@ class PagedDecodeEngine:
         elif reserved:
             self._decode_round(reserved, running, deliver)
 
+    # -- Round-10: device-resident chained decode --------------------------
+    def _can_chain(self, running, pending) -> bool:
+        """Adaptive-K policy: chain only when the queue is QUIET — no
+        pending admissions (arrivals and preemption victims force the
+        round back to K=1 so step-boundary admission/TTFT semantics are
+        unchanged), no mid-prefill chunk rows (those stream through the
+        ragged mixed step), and at least one row with >= 2 tokens of
+        budget left (an all-tail batch just runs the plain step)."""
+        if self.chain_steps <= 1 or pending or not running:
+            return False
+        if any(a.tokens is not None for a in running):
+            return False
+        return self._chain_headroom(running) >= 2
+
+    def _chain_headroom(self, running) -> int:
+        out = 0
+        for a in running:
+            seq = self.pool.sequence(a.seq_id)
+            out = max(out, min(a.req.max_new - len(a.req.emitted),
+                               self.max_seq_tokens - seq.n_tokens))
+        return out
+
+    def _dispatch_chain(self, running, pending):
+        """Pre-extend every decode row's block table by its chain budget
+        and dispatch ONE K-step device program.  Returns ``(acts, kreal,
+        ids)`` with ``ids`` the un-synced [B, K] device array (its host
+        copy is started asynchronously), or None when nothing could be
+        reserved (every row was preempted into pending)."""
+        K = self.chain_steps
+        pool = self.pool
+
+        def k_for(act):
+            seq = pool.sequence(act.seq_id)
+            rem = min(act.req.max_new - len(act.req.emitted),
+                      self.max_seq_tokens - seq.n_tokens)
+            # rows with less budget than K still ride the chain: their
+            # surplus steps write to the null block and their post-budget
+            # ids are truncated host-side (wasted compute bounded by K)
+            return min(K, max(rem, 1))
+
+        victims: list[_Active] = []
+        reserved = self._reserve_slots(running, pending, victims,
+                                       k_for=k_for)
+        if victims:
+            self._cascade_preempt(victims, running, pending)
+        if not reserved:
+            return None
+        B = self.max_batch_size
+        NB = self.max_blocks_per_seq
+        token = np.zeros(B, np.int32)
+        positions = np.zeros(B, np.int32)
+        sb = np.zeros((B, K), np.int32)
+        so = np.zeros((B, K), np.int32)
+        bt = np.zeros((B, NB), np.int32)
+        acts: list[_Active] = []
+        kreal: list[int] = []
+        for i, (act, slots) in enumerate(reserved):
+            seq = pool.sequence(act.seq_id)
+            token[i] = act.req.emitted[-1]
+            # extend_slots already advanced n_tokens by len(slots): the
+            # chain's first token writes at the first reserved position
+            positions[i] = seq.n_tokens - len(slots)
+            for t, (blk, off) in enumerate(slots):
+                sb[i, t] = blk
+                so[i, t] = off
+            bt[i, : len(seq.block_ids)] = seq.block_ids
+            acts.append(act)
+            kreal.append(len(slots))
+        self._note_dispatch()
+        ids, pool.k, pool.v = self._chained(
+            self.params, pool.k, pool.v, jnp.asarray(token),
+            jnp.asarray(positions), jnp.asarray(bt), jnp.asarray(sb),
+            jnp.asarray(so),
+        )
+        try:
+            # start the device->host copy NOW so it overlaps the chain's
+            # tail and the host's bookkeeping; np.asarray later just
+            # collects it instead of blocking on a cold transfer
+            ids.copy_to_host_async()
+        except Exception:  # noqa: BLE001 - optional fast path (CPU arrays)
+            pass
+        return acts, kreal, ids
+
+    def _scan_chain(self, acts, kreal, ids_np, running
+                    ) -> tuple[list[_Active], int]:
+        """Truncating emit of one synced chain: each row's ids are taken
+        in order until EOS / max_new / capacity closes the request (the
+        per-step done rule, applied token by token — so the emitted
+        stream is token-identical to K separate rounds).  Returns the
+        finished rows and the total emitted-token count."""
+        done: list[_Active] = []
+        n_emitted = 0
+        for i, act in enumerate(acts):
+            if not any(a is act for a in running):
+                continue  # preempted after dispatch; results are void
+            req = act.req
+            finished = False
+            for t in range(kreal[i]):
+                self._emit(req, int(ids_np[i, t]))
+                n_emitted += 1
+                if len(req.emitted) >= req.max_new or (
+                    req.stop_token is not None
+                    and req.emitted[-1] == req.stop_token
+                ):
+                    finished = True
+                    break
+            if not finished and self.pool.sequence(
+                    act.seq_id).n_tokens >= self.max_seq_tokens:
+                finished = True
+            if finished:
+                done.append(act)
+        return done, n_emitted
+
+    def _chained_rounds(self, running, pending, deliver, poll, stop) -> bool:
+        """The Round-10 hot loop: double-buffered chained rounds.
+
+        The blocking per-token sync is gone — each iteration dispatches
+        chain N+1 (its input token is chain N's last emitted id, already
+        on the host from the ONE [B, K] sync) BEFORE doing chain N's
+        heavy bookkeeping: completion callbacks, scheduler polling and
+        metrics run in the overlap window while the device executes
+        chain N+1.  The loop drops back to the per-step path (returns)
+        the moment anything disturbs the quiet window: an arrival, a
+        preemption, a finished row that leaves no chainable headroom."""
+        inflight = self._dispatch_chain(running, pending)
+        if inflight is None:
+            return False
+        while True:
+            # overlap: poll the scheduler while the chain runs — an
+            # arrival discovered here lands in pending and adapts the
+            # NEXT round to K=1 (this chain is the bounded latency cost)
+            self._admit_arrivals(running, pending, poll, stop)
+            acts, kreal, ids_dev = inflight
+            ids_np = np.asarray(ids_dev)  # ONE sync per K-token chain
+            self._note_sync()
+            done, n_emitted = self._scan_chain(acts, kreal, ids_np, running)
+            for act in done:
+                running.remove(act)
+                self.pool.free_sequence(act.seq_id)
+            nxt = None
+            if running and not pending \
+                    and self._chain_headroom(running) >= 2:
+                nxt = self._dispatch_chain(running, pending)
+            # overlap: chain N's completion bookkeeping runs while the
+            # device executes chain N+1 (the _note_sync/_note_dispatch
+            # pair above already closed the device-idle window, so this
+            # work is correctly NOT counted as host gap)
+            for act in done:
+                deliver(act.req)
+            self.pool.stats.record_chain(
+                steps=self.chain_steps, slots=len(acts) * self.chain_steps,
+                emitted=n_emitted,
+            )
+            if nxt is None:
+                return True
+            inflight = nxt
+
     def _decode_round(self, reserved, running, deliver) -> None:
         B = self.max_batch_size
         NB = self.max_blocks_per_seq
@@ -669,19 +904,28 @@ class PagedDecodeEngine:
         sb = np.zeros(B, np.int32)
         so = np.zeros(B, np.int32)
         bt = np.zeros((B, NB), np.int32)
-        for i, (act, (blk, off)) in enumerate(reserved):
+        for i, (act, slots) in enumerate(reserved):
+            blk, off = slots[0]
             seq = self.pool.sequence(act.seq_id)
             token[i] = act.req.emitted[-1]
             positions[i] = seq.n_tokens - 1  # append_slot already advanced
             sb[i] = blk
             so[i] = off
             bt[i, : len(seq.block_ids)] = seq.block_ids
+        self._note_dispatch()
         ids, self.pool.k, self.pool.v = self._step(
             self.params, self.pool.k, self.pool.v, jnp.asarray(token),
             jnp.asarray(positions), jnp.asarray(bt), jnp.asarray(sb),
             jnp.asarray(so),
         )
         ids = np.asarray(ids)
+        self._note_sync()
+        # a per-step round IS a K=1 chain: recording it keeps the
+        # pathway_kv_chain_steps histogram's le=1 bucket meaningful —
+        # admission pressure forcing K back to 1 is visible there
+        self.pool.stats.record_chain(
+            steps=1, slots=len(reserved), emitted=len(reserved)
+        )
         for i, (act, _slot) in enumerate(reserved):
             self._emit(act.req, int(ids[i]))
             if self._is_done(act.req, act.seq_id):
@@ -715,7 +959,8 @@ class PagedDecodeEngine:
         rows: list[tuple[_Active, int, int]] = []  # (act, row, n_filled|-1)
         t = 0
         row = 0
-        for act, (blk, off) in reserved:
+        for act, slots in reserved:
+            blk, off = slots[0]
             seq = self.pool.sequence(act.seq_id)
             tokens[t] = act.req.emitted[-1]
             positions[t] = seq.n_tokens - 1  # append_slot already advanced
@@ -787,6 +1032,7 @@ class PagedDecodeEngine:
             raise RuntimeError(
                 "ragged step produced no rows (gated chunk cycle?)"
             )
+        self._note_dispatch()
         ids, self.pool.k, self.pool.v = self._mixed(
             self.params, self.pool.k, self.pool.v, jnp.asarray(tokens),
             jnp.asarray(positions), jnp.asarray(row_tables),
@@ -796,7 +1042,15 @@ class PagedDecodeEngine:
             jnp.asarray(logit_idx),
         )
         ids = np.asarray(ids)
+        self._note_sync()
         self.pool.stats.record_mixed_step(len(rows))
+        n_decode = sum(1 for _a, _r, f in rows if f < 0)
+        if n_decode:
+            # mixed rounds advance decode rows one token: a K=1 entry in
+            # the chain histogram (adaptive-K observability)
+            self.pool.stats.record_chain(
+                steps=1, slots=n_decode, emitted=n_decode
+            )
         self.pool.stats.record_prefill_chunks(
             sum(1 for _a, _r, f in rows if f >= 0)
         )
@@ -867,16 +1121,21 @@ class PagedDecodeEngine:
                     self._requeue(pending, act.req)
                     queue.append(act)
 
-    def _reserve_slots(self, running, pending, victims=None
-                       ) -> list[tuple[_Active, tuple[int, int]]]:
-        """Reserve one write slot per running DECODE sequence (mid-prefill
+    def _reserve_slots(self, running, pending, victims=None, k_for=None
+                       ) -> list[tuple[_Active, list[tuple[int, int]]]]:
+        """Reserve write slots per running DECODE sequence (mid-prefill
         sequences own their blocks already and need none), resolving pool
         exhaustion by prefix eviction first, preemption second.  Victims
         are only taken from sequences that have NOT yet reserved this
         round (a reserved slot is already in the outgoing device arrays);
         mid-prefill sequences are legitimate victims — their recompute
-        re-streams the same chunks."""
-        reserved: list[tuple[_Active, tuple[int, int]]] = []
+        re-streams the same chunks.
+
+        ``k_for(act)`` gives the number of slots to pre-extend per row
+        (the Round-10 chain reservation; default 1), atomically via
+        BlockPool.extend_slots — so preemption, when it happens, happens
+        at a CHAIN boundary with no half-reserved row."""
+        reserved: list[tuple[_Active, list[tuple[int, int]]]] = []
         survivors = list(running)
         idx = 0
         while idx < len(survivors):
@@ -885,9 +1144,13 @@ class PagedDecodeEngine:
                 idx += 1  # mid-prefill: no decode slot this round
                 continue
             try:
-                slot = self.pool.append_slot(act.seq_id)
-            except PoolExhausted:
-                if self.prefix is not None and self.prefix.evict(1) > 0:
+                slots = self.pool.extend_slots(
+                    act.seq_id, k_for(act) if k_for is not None else 1
+                )
+            except PoolExhausted as exc:
+                if self.prefix is not None and self.prefix.evict(
+                    max(exc.needed - exc.free, 1)
+                ) > 0:
                     continue
                 # never preempt a sequence whose RE-ADMISSION prefill would
                 # not fit the largest bucket (it would have to truncate,
@@ -928,6 +1191,6 @@ class PagedDecodeEngine:
                 # emitted since
                 self._requeue(pending, vact.req)
                 continue  # same idx: list shifted or retry current
-            reserved.append((act, slot))
+            reserved.append((act, slots))
             idx += 1
         return reserved

@@ -436,6 +436,64 @@ def paged_mixed_step(params: dict, cfg: DecoderConfig, k_pool: jax.Array,
     return out, k_pool, v_pool
 
 
+def paged_chained_decode(params: dict, cfg: DecoderConfig, k_pool: jax.Array,
+                         v_pool: jax.Array, token: jax.Array,
+                         positions: jax.Array, block_tables: jax.Array,
+                         slot_blocks: jax.Array, slot_offsets: jax.Array, *,
+                         attn: str = "reference",
+                         tp_axis: str | None = None):
+    """K greedy decode steps in ONE device program (Round-10).
+
+    :func:`paged_decode_step` is the loop BODY: a ``lax.scan`` feeds step
+    t's argmaxed ids into step t+1 and scatters each step's K/V into the
+    pre-reserved pool slot — so a chain of K tokens costs one dispatch
+    and one [B, K] ids sync instead of K dispatches and K [B] syncs.
+    The host pre-extends every row's block table by the chain's slots
+    BEFORE dispatch (kvcache/block_pool.py ``extend_slots``), which is
+    why the whole chain can run without host involvement: block tables
+    and write slots are position-deterministic, only the token VALUES
+    flow device-side.
+
+    token: (B,) int32 input ids for step 0 (each row's last emitted
+    token); positions: (B,) the position step 0's token is written at;
+    slot_blocks/slot_offsets: (B, K) per-step write slots — rows whose
+    remaining budget is < K point the surplus steps at the null block 0
+    (their post-budget ids are garbage the engine truncates host-side);
+    block_tables: (B, NB) covering the pre-extended tables.
+    Returns ``(ids, k_pool, v_pool)`` with ids (B, K) int32 — ALWAYS
+    sampled ids, in both the single-device and ``tp_axis`` forms (the
+    scan carry must be ids either way).
+
+    Token identity with the per-step path is exact: step t's pool
+    scatter lands before step t+1's gather reads it (scan order), the
+    per-step math is :func:`paged_decode_step` itself, and greedy
+    sampling is the same argmax (two-stage under tp, see _head_out).
+    """
+    K = slot_blocks.shape[1]
+    maxp = cfg.max_len - 1
+
+    def body(carry, xs):
+        tok, kp, vp = carry
+        sb, so, t = xs
+        # surplus steps of a budget-exhausted row run at a clamped
+        # position (their output is discarded host-side); real steps
+        # never hit the clamp — positions + k_real - 1 < max_len
+        pos = jnp.minimum(positions + t, maxp)
+        out, kp, vp = paged_decode_step(
+            params, cfg, kp, vp, tok, pos, block_tables, sb, so,
+            attn=attn, tp_axis=tp_axis,
+        )
+        ids = out if tp_axis is not None \
+            else jnp.argmax(out, axis=-1).astype(jnp.int32)
+        return (ids, kp, vp), ids
+
+    (_last, k_pool, v_pool), ids = jax.lax.scan(
+        body, (token.astype(jnp.int32), k_pool, v_pool),
+        (slot_blocks.T, slot_offsets.T, jnp.arange(K, dtype=jnp.int32)),
+    )
+    return ids.T, k_pool, v_pool  # (B, K)
+
+
 # -- shard_map wrappers: the tensor-parallel serving path (Round-9) ----------
 
 
@@ -506,6 +564,31 @@ def paged_mixed_step_tp(params: dict, cfg: DecoderConfig, mesh,
         params, k_pool, v_pool, tokens, positions, row_tables, row_start,
         row_nvalid, row_token_idx, tok_row, tok_col, slot_blocks,
         slot_offsets, logit_idx,
+    )
+
+
+def paged_chained_decode_tp(params: dict, cfg: DecoderConfig, mesh,
+                            k_pool: jax.Array, v_pool: jax.Array,
+                            token: jax.Array, positions: jax.Array,
+                            block_tables: jax.Array, slot_blocks: jax.Array,
+                            slot_offsets: jax.Array, *,
+                            attn: str = "reference"):
+    """:func:`paged_chained_decode` over the tp mesh.  The chain adds
+    ZERO collectives beyond the per-step set: the scan runs per shard
+    (each shard chains its own n_kv_heads/tp pool slice), and the only
+    cross-shard traffic per step is the existing one-psum-per-row-
+    parallel-projection plus the two-stage argmax — whose (B,) ids ARE
+    the replicated scan carry every shard feeds its next step."""
+
+    def fn(p, k_pool, v_pool, token, positions, bt, sb, so):
+        return paged_chained_decode(
+            p, cfg, k_pool, v_pool, token, positions, bt, sb, so,
+            attn=attn, tp_axis="tp",
+        )
+
+    return _tp_shard_map(fn, mesh, params, 2, 5)(
+        params, k_pool, v_pool, token, positions, block_tables,
+        slot_blocks, slot_offsets,
     )
 
 

@@ -215,7 +215,8 @@ class Int8DecoderHost:
 
     def serving_executor(self, *, paged: bool | None = None,
                          max_batch_size: int | None = None,
-                         tp: int | None = None, **kwargs):
+                         tp: int | None = None,
+                         chain_steps: int | None = None, **kwargs):
         """Single shared executor for this decode tier (serve/scheduler.py).
 
         ``paged=True`` (default when the kvcache engine is constructible)
@@ -240,6 +241,13 @@ class Int8DecoderHost:
         that cannot shard the model raises ValueError naming the
         offending dims and the legal values.
 
+        ``chain_steps=`` (Round-10) bounds the device-resident decode
+        chain: when the queue is quiet the engine runs up to this many
+        greedy steps per dispatch (one [B, K] ids sync per chain, host
+        bookkeeping overlapped with device execution), adapting back to
+        1 the moment arrivals or preemption are pending.  Default 8;
+        ``chain_steps=1`` restores the per-step round-9 hot loop.
+
         ``paged=False`` keeps the legacy serialized tier: the int8 host
         cache (`self._K/_V/n_past`) is per-instance mutable state, so
         concurrent `generate` callers would interleave prefill/decode
@@ -256,14 +264,15 @@ class Int8DecoderHost:
         sched = getattr(self, "_serve_executor", None)
         if sched is not None and not sched._closed:
             if paged is not None or max_batch_size is not None \
-                    or tp is not None:
+                    or tp is not None or chain_steps is not None:
                 import logging
 
                 logging.getLogger(__name__).warning(
-                    "serving_executor(paged=%r, max_batch_size=%r, tp=%r) "
-                    "ignored: the shared executor already exists; shut it "
-                    "down first to rebuild with different settings",
-                    paged, max_batch_size, tp,
+                    "serving_executor(paged=%r, max_batch_size=%r, tp=%r, "
+                    "chain_steps=%r) ignored: the shared executor already "
+                    "exists; shut it down first to rebuild with different "
+                    "settings",
+                    paged, max_batch_size, tp, chain_steps,
                 )
             return sched
         from ..serve.scheduler import RequestScheduler
@@ -282,6 +291,8 @@ class Int8DecoderHost:
                 engine_kwargs["max_batch_size"] = max_batch_size
             if tp is not None:
                 engine_kwargs["tp"] = tp
+            if chain_steps is not None:
+                engine_kwargs["chain_steps"] = chain_steps
             engine = self.paged_engine(**engine_kwargs)
             if engine is None and paged:
                 raise RuntimeError("paged=True but the KV engine is "

@@ -13,9 +13,11 @@ process.  Exchange edges (groupby/join re-key, centralized ops) are "wait
 positions": before processing one, a process sends a mark ("I finished every
 earlier position at this time; my data for you is on the wire") and waits
 for all peers' marks — per-connection FIFO turns the mark into a data
-barrier.  After each logical time an eot exchange closes the cross-time
-race, and the coordinator (process 0) agrees the next time via an
-allreduce-min over pending times.  Output/capture operators are centralized
+barrier.  The coordinator (process 0) agrees the next time via an
+allreduce-min over pending times; the min round carries each process's
+in-flight send counts/target-times, closing the cross-time race that a
+separate per-time eot barrier used to close with one extra rendezvous
+per time (round-10).  Output/capture operators are centralized
 on shard 0 (process 0), so sink effects happen exactly once.
 
 With n_processes == 1 there is no fabric and the same walk degrades to the
@@ -115,9 +117,6 @@ class ClusterRunner:
         # times that must run even with no data (flush-only ticks so async
         # completions and temporal-behavior flushes fire)
         self._force_times: set[int] = set()
-        # symmetric barrier id allocator: every process consumes ids in the
-        # same order, and ids never collide with real logical times (>= -2)
-        self._barrier_n = 0
         self.captures: dict[int, CapturedStream] = dict(base.captures)
         self.fabric: Fabric | None = None
         if nprocs > 1:
@@ -263,8 +262,13 @@ class ClusterRunner:
         self.frontier = max(self.frontier, t)
         self.cur_t = None
         if self.fabric is not None:
-            self.fabric.send_eot(t)
-            self.fabric.wait_eot(t)
+            # the per-time EOT barrier is gone (round-10): sends stamped
+            # during `t` stay visible through the sender's unconfirmed-
+            # send report until a min-agreement round count-confirms
+            # their delivery (_agree_min), so no rendezvous is needed
+            # here.  Only the mark bookkeeping cleanup the barrier used
+            # to do remains.
+            self.fabric.prune_marks(t)
 
     def _local_min_pending(self) -> int | None:
         times = [t for t, b in self.pending.items() if b]
@@ -275,23 +279,48 @@ class ClusterRunner:
 
     # -- control plane -----------------------------------------------------
     def _agree_min(self, local: int | None) -> int | None:
+        """Allreduce-min over pending times WITH the EOT guarantee folded
+        in (round-10): each report carries the process's cumulative
+        data-frame send counts per destination and includes its
+        unconfirmed sends' minimum target time in the local min, and the
+        coordinator's reply tells every process how many frames to
+        expect from each peer.  Count-waiting on those totals proves (by
+        per-connection FIFO) that every in-flight frame has landed —
+        the guarantee the separate per-time/per-tick EOT BARRIERS used
+        to provide with an extra full rendezvous each."""
         if self.fabric is None:
             return local
+        # cross-time sends only (time > frontier): same-time sends were
+        # delivered under their time's mark barrier, and re-reporting
+        # them would re-agree an already-processed time
+        counts, sent_min = self.fabric.sent_report(above=self.frontier)
+        if sent_min is not None:
+            local = sent_min if local is None else min(local, sent_min)
         if self.pid == 0:
-            mins = [local]
+            reports: dict[int, tuple] = {0: (local, counts)}
             for _ in range(self.nprocs - 1):
-                tag, _pid, m = self.fabric.recv_ctl()
+                tag, pid, m, cnts = self.fabric.recv_ctl()
                 assert tag == "min", tag
-                mins.append(m)
-            vals = [m for m in mins if m is not None]
+                reports[pid] = (m, cnts)
+            vals = [m for m, _c in reports.values() if m is not None]
             agreed = min(vals) if vals else None
-            self.fabric.broadcast_ctl(("adv", agreed))
-            return agreed
+            for peer in self.fabric.peers:
+                expected = {
+                    src: cnts.get(peer, 0)
+                    for src, (_m, cnts) in reports.items() if src != peer
+                }
+                self.fabric.send_ctl(peer, ("adv", agreed, expected))
+            my_expected = {
+                src: cnts.get(0, 0)
+                for src, (_m, cnts) in reports.items() if src != 0
+            }
         else:
-            self.fabric.send_ctl(0, ("min", self.pid, local))
-            tag, agreed = self.fabric.recv_ctl()
+            self.fabric.send_ctl(0, ("min", self.pid, local, counts))
+            tag, agreed, my_expected = self.fabric.recv_ctl()
             assert tag == "adv", tag
-            return agreed
+        self.fabric.wait_data_counts(my_expected)
+        self.fabric.confirm_sent(counts)
+        return agreed
 
     def _gather(self, payload: tuple) -> list | None:
         """Workers send payload to pid0; pid0 returns the list (incl. own)."""
@@ -327,16 +356,15 @@ class ClusterRunner:
             self._run_time(m)
 
     def _input_barrier(self) -> None:
-        """Rendezvous ensuring injected/on_end emissions shipped to peers
-        have arrived before the next agreed drain decides there is no work.
-        Barrier ids live below every real logical time, and every process
-        allocates them in the same order."""
-        if self.fabric is None:
-            return
-        self._barrier_n += 1
-        bid = -10 - self._barrier_n
-        self.fabric.send_eot(bid)
-        self.fabric.wait_eot(bid)
+        """Formerly an EOT rendezvous ensuring injected/on_end emissions
+        shipped to peers arrived before the next agreed drain decided
+        there was no work.  Round-10: the drain's min-agreement now sees
+        in-flight sends directly (the sender reports their target times
+        and per-peer counts until delivery is count-confirmed —
+        :meth:`_agree_min`), so the extra full round trip per tick/phase
+        is gone.  Kept as an explicit no-op so the call sites still mark
+        the protocol points where the guarantee is consumed."""
+        return
 
     def _end_phase(self) -> None:
         """Graceful shutdown mirroring Scheduler.finish: interior operators'

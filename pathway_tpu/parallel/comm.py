@@ -13,8 +13,11 @@ three message families over one full TCP mesh:
     makes the mark a barrier: receiving it guarantees the data arrived) —
     the deterministic replacement for timely's frontier gossip
   - eot(time) — "all sends stamped during `time`, including to later logical
-    times, are on the wire" (closes the cross-time race before the
-    coordinator advances the global frontier)
+    times, are on the wire".  Round-10: the per-time/per-tick eot BARRIER is
+    gone — the cluster's min-agreement round piggybacks per-peer data-frame
+    counts and unconfirmed sends' target times (sent_report/wait_data_counts/
+    confirm_sent), which closes the same cross-time race without an extra
+    rendezvous; explicit eot frames remain only for the shutdown barrier
   - ctl(payload) — worker->coordinator reports and coordinator broadcasts
     (advance/tick/endphase/rescale), the jax.distributed-style host control
     plane promised in SURVEY.md §2c
@@ -83,7 +86,16 @@ class Fabric:
             "recv_count": 0, "recv_bytes": 0,
             "data_msgs_out": 0, "mark_msgs_out": 0, "ctl_msgs_out": 0,
             "wait_marks_s": 0.0, "wait_eot_s": 0.0, "wait_ctl_s": 0.0,
+            "wait_data_s": 0.0,
         }
+        # counted-delivery bookkeeping (round-10 EOT batching): data
+        # frames are counted per peer in both directions, and unconfirmed
+        # sends remember their target logical time — the cluster's min
+        # agreement piggybacks these so the per-time/per-tick EOT BARRIER
+        # round trips are gone (see cluster._agree_min)
+        self._sent_counts: dict[int, int] = defaultdict(int)
+        self._recv_counts: dict[int, int] = defaultdict(int)
+        self._sent_unconfirmed: list[tuple[int, int, int]] = []  # (dst, idx, t)
         self._secret = _fabric_secret()
         if self._secret is None:
             logging.getLogger(__name__).warning(
@@ -242,27 +254,47 @@ class Fabric:
         st["send_bytes"] += len(blob) + _LEN.size
         st["send_s"] += _time.perf_counter() - t0
 
+    def _send_all(self, msg: tuple) -> None:
+        """One pickle, every peer: protocol fan-outs (marks, eot, ctl
+        broadcasts) share the serialized blob instead of re-pickling per
+        peer."""
+        t0 = _time.perf_counter()
+        blob = pickle.dumps(msg, protocol=pickle.HIGHEST_PROTOCOL)
+        framed = _LEN.pack(len(blob)) + blob
+        for peer in self.peers:
+            with self._send_locks[peer]:
+                try:
+                    self._socks[peer].sendall(framed)
+                except OSError as exc:
+                    raise FabricError(f"peer {peer} unreachable: {exc}")
+        st = self.stats
+        st["send_count"] += len(self.peers)
+        st["send_bytes"] += len(framed) * len(self.peers)
+        st["send_s"] += _time.perf_counter() - t0
+
     def send_data(self, peer: int, time: int, pos: int, port: int, shard: int,
                   seq: int, updates: list) -> None:
         self.stats["data_msgs_out"] += 1
+        with self._cond:
+            self._sent_counts[peer] += 1
+            self._sent_unconfirmed.append(
+                (peer, self._sent_counts[peer], time)
+            )
         self._send(peer, ("d", time, pos, port, shard, self.pid, seq, updates))
 
     def send_mark(self, time: int, pos: int) -> None:
         self.stats["mark_msgs_out"] += 1
-        for peer in self.peers:
-            self._send(peer, ("m", time, pos))
+        self._send_all(("m", time, pos))
 
     def send_eot(self, time: int) -> None:
-        for peer in self.peers:
-            self._send(peer, ("e", time))
+        self._send_all(("e", time))
 
     def send_ctl(self, peer: int, payload: Any) -> None:
         self.stats["ctl_msgs_out"] += 1
         self._send(peer, ("c", payload))
 
     def broadcast_ctl(self, payload: Any) -> None:
-        for peer in self.peers:
-            self._send(peer, ("c", payload))
+        self._send_all(("c", payload))
 
     # -- receive -----------------------------------------------------------
     def _recv_loop(self, peer: int, sock: socket.socket) -> None:
@@ -298,6 +330,7 @@ class Fabric:
                     self._data[(t, pos)].append(
                         (producer, seq, port, shard, updates)
                     )
+                    self._recv_counts[peer] += 1
                     self._cond.notify_all()
             elif kind == "m":
                 _, t, pos = msg
@@ -364,6 +397,85 @@ class Fabric:
                         raise FabricError(
                             f"pid {self.pid}: eot barrier timeout at t={time}"
                         )
+
+    # -- counted delivery (round-10: EOT piggybacked on the min round) -----
+    def sent_report(self, above: int | None = None
+                    ) -> tuple[dict[int, int], int | None]:
+        """Snapshot for the cluster's min-agreement round: cumulative data
+        frames sent per peer, plus the minimum target logical time among
+        sends not yet globally confirmed.  Reporting unconfirmed sends'
+        times is what lets the agreement see in-flight work WITHOUT a
+        separate EOT barrier: the sender vouches for a frame until the
+        round that confirms every receiver has caught up to the counts
+        (:meth:`confirm_sent`), after which the receiver's own pending
+        report carries it.
+
+        ``above`` (the caller's processed frontier) filters the reported
+        minimum to CROSS-TIME sends only: a frame stamped at an
+        already-processed time was delivered under that time's mark
+        barrier (per-position rendezvous inside ``_run_time``), and
+        reporting it would drag the agreed minimum back to a finished
+        time — every exchanging time would be agreed and run twice.
+        Only sends targeting times past the frontier are in the
+        cross-time race the old EOT barrier closed.  The COUNTS stay
+        unfiltered, so delivery of every frame is still confirmed."""
+        with self._cond:
+            counts = dict(self._sent_counts)
+            tmin = min(
+                (t for _dst, _idx, t in self._sent_unconfirmed
+                 if above is None or t > above),
+                default=None,
+            )
+            return counts, tmin
+
+    def confirm_sent(self, snapshot: dict[int, int]) -> None:
+        """Drop unconfirmed-send records covered by ``snapshot`` (the
+        counts reported in a completed agreement round): every receiver
+        has count-waited past them, so from the next round on the data
+        appears in the receivers' own pending reports."""
+        with self._cond:
+            self._sent_unconfirmed = [
+                e for e in self._sent_unconfirmed
+                if e[1] > snapshot.get(e[0], 0)
+            ]
+
+    def wait_data_counts(self, expected: dict[int, int],
+                         timeout_s: float = 120.0) -> None:
+        """Block until at least ``expected[src]`` data frames have arrived
+        from each ``src`` — the counted-delivery replacement for the EOT
+        barrier: per-connection FIFO means matching the sender-reported
+        count proves every frame it vouched for is in ``self._data``."""
+        if not expected:
+            return
+        deadline = _time.monotonic() + timeout_s
+        t0 = _time.perf_counter()
+        with self._cond:
+            while True:
+                if all(self._recv_counts[p] >= n
+                       for p, n in expected.items()):
+                    self.stats["wait_data_s"] += _time.perf_counter() - t0
+                    return
+                self._check()
+                if not self._cond.wait(
+                    timeout=min(1.0, deadline - _time.monotonic())
+                ):
+                    if _time.monotonic() > deadline:
+                        raise FabricError(
+                            f"pid {self.pid}: data-count barrier timeout "
+                            f"(expected {expected}, have "
+                            f"{dict(self._recv_counts)})"
+                        )
+
+    def prune_marks(self, below_time: int) -> None:
+        """Drop mark bookkeeping for logical times < ``below_time`` (they
+        were previously cleaned by the per-time EOT barrier; times are
+        processed in ascending order, so older marks can never gate a
+        future wait — a late straggler recreates at most one small entry,
+        pruned by the next call)."""
+        with self._cond:
+            for marks in self._marks.values():
+                for t in [t for t in marks if t < below_time]:
+                    del marks[t]
 
     def pending_times(self) -> set[int]:
         """Times with stashed remote data not yet taken."""

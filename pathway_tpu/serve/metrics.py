@@ -113,6 +113,10 @@ class ServeStats:
 TTFT_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
                 0.5, 1.0, 2.5, 5.0, 10.0)
 
+# chained-decode K histogram bucket upper bounds (steps per dispatch):
+# covers K=1 (busy queue) through the deepest plausible chain; +Inf implicit
+CHAIN_BUCKETS = (1, 2, 4, 8, 16, 32)
+
 
 class KVCacheStats:
     """Thread-safe counter block for one paged KV-cache pool
@@ -134,6 +138,18 @@ class KVCacheStats:
       decode + chunk — per mixed dispatch)
     - ``pathway_kv_ttft_seconds{pool}``         histogram (time from
       request arrival at the engine to its first emitted token)
+    - ``pathway_kv_chain_steps{pool}``          histogram (Round-10: K of
+      each decode-advancing dispatch — 1 for per-step/mixed rounds
+      (admission pressure), ``chain_steps`` for quiet-queue chains, so
+      the le=1 bucket shows the adaptive-K policy working)
+    - ``pathway_kv_chain_slots_total{pool}``    counter (dispatched chain
+      slots, rows x K — occupancy denominator)
+    - ``pathway_kv_chain_emitted_total{pool}``  counter (tokens actually
+      emitted from chains; emitted/slots = chain occupancy)
+    - ``pathway_kv_host_gap_seconds_total{pool}`` counter (host-critical-
+      path seconds between a chain's results landing and the next chain
+      being queued — the window the device may sit idle; ~0 when the
+      double-buffered overlap is working)
     - ``pathway_kv_shard_hbm_bytes{pool,shard}``     gauge (Round-9: K+V
       HBM held by each tensor-parallel shard)
     - ``pathway_kv_shard_blocks_in_use{pool,shard}`` gauge (block
@@ -160,6 +176,12 @@ class KVCacheStats:
         self.ttft_count = 0
         self.ttft_sum = 0.0
         self.ttft_bucket_counts = [0] * len(TTFT_BUCKETS)
+        self.chain_count = 0
+        self.chain_steps_sum = 0
+        self.chain_bucket_counts = [0] * len(CHAIN_BUCKETS)
+        self.chain_slots = 0
+        self.chain_emitted = 0
+        self.host_gap_s = 0.0
         # bounded recent observations so callers (bench.py) can compute
         # percentiles without a second instrumentation channel
         from collections import deque as _deque
@@ -196,6 +218,26 @@ class KVCacheStats:
             self.mixed_steps += 1
             self.mixed_step_rows += occupancy
 
+    def record_chain(self, steps: int, slots: int, emitted: int) -> None:
+        """One chained multi-step dispatch of ``steps`` greedy steps over
+        ``slots`` row-step slots, of which ``emitted`` produced tokens the
+        engine kept (EOS/max_new truncation wastes the rest)."""
+        with self._lock:
+            self.chain_count += 1
+            self.chain_steps_sum += steps
+            for i, ub in enumerate(CHAIN_BUCKETS):
+                if steps <= ub:
+                    self.chain_bucket_counts[i] += 1
+                    break
+            self.chain_slots += slots
+            self.chain_emitted += emitted
+
+    def record_host_gap(self, seconds: float) -> None:
+        """Host-critical-path time between a chain's sync completing and
+        the next chain being queued on the device."""
+        with self._lock:
+            self.host_gap_s += seconds
+
     def record_ttft(self, seconds: float) -> None:
         with self._lock:
             self.ttft_count += 1
@@ -220,6 +262,14 @@ class KVCacheStats:
         return self.mixed_step_rows / self.mixed_steps \
             if self.mixed_steps else 0.0
 
+    @property
+    def chain_occupancy(self) -> float:
+        """Fraction of dispatched chain slots that produced an emitted
+        token (EOS/max_new truncation and short-budget rows waste the
+        rest — bounded by K per row per chain)."""
+        return self.chain_emitted / self.chain_slots \
+            if self.chain_slots else 0.0
+
     def snapshot(self) -> dict:
         with self._lock:
             return {
@@ -241,6 +291,13 @@ class KVCacheStats:
                 "ttft_sum": self.ttft_sum,
                 "ttft_buckets": list(self.ttft_bucket_counts),
                 "recent_ttfts": list(self.recent_ttfts),
+                "chain_count": self.chain_count,
+                "chain_steps_sum": self.chain_steps_sum,
+                "chain_buckets": list(self.chain_bucket_counts),
+                "chain_slots": self.chain_slots,
+                "chain_emitted": self.chain_emitted,
+                "chain_occupancy": self.chain_occupancy,
+                "host_gap_s": self.host_gap_s,
             }
 
 
@@ -370,6 +427,11 @@ def _render_kv_lines() -> list[str]:
         "# TYPE pathway_kv_shard_hbm_bytes gauge",
         "# TYPE pathway_kv_shard_blocks_in_use gauge",
         "# TYPE pathway_kv_ttft_seconds histogram",
+        "# TYPE pathway_kv_chain_steps histogram",
+        "# TYPE pathway_kv_chain_slots_total counter",
+        "# TYPE pathway_kv_chain_emitted_total counter",
+        "# TYPE pathway_kv_chain_occupancy gauge",
+        "# TYPE pathway_kv_host_gap_seconds_total counter",
     ]
     for s in stats:
         snap = s.snapshot()
@@ -431,6 +493,38 @@ def _render_kv_lines() -> list[str]:
         lines.append(
             f"pathway_kv_ttft_seconds_count{{{lbl}}} {snap['ttft_count']}"
         )
+        # Round-10 chained-decode K histogram + occupancy + host gap
+        cum = 0
+        for ub, n in zip(CHAIN_BUCKETS, snap["chain_buckets"]):
+            cum += n
+            lines.append(
+                f'pathway_kv_chain_steps_bucket{{{lbl},le="{ub}"}} {cum}'
+            )
+        lines.append(
+            f'pathway_kv_chain_steps_bucket{{{lbl},le="+Inf"}} '
+            f"{snap['chain_count']}"
+        )
+        lines.append(
+            f"pathway_kv_chain_steps_sum{{{lbl}}} {snap['chain_steps_sum']}"
+        )
+        lines.append(
+            f"pathway_kv_chain_steps_count{{{lbl}}} {snap['chain_count']}"
+        )
+        lines.append(
+            f"pathway_kv_chain_slots_total{{{lbl}}} {snap['chain_slots']}"
+        )
+        lines.append(
+            f"pathway_kv_chain_emitted_total{{{lbl}}} "
+            f"{snap['chain_emitted']}"
+        )
+        lines.append(
+            f"pathway_kv_chain_occupancy{{{lbl}}} "
+            f"{snap['chain_occupancy']:.3f}"
+        )
+        lines.append(
+            f"pathway_kv_host_gap_seconds_total{{{lbl}}} "
+            f"{snap['host_gap_s']:.6f}"
+        )
     return lines
 
 
@@ -465,7 +559,8 @@ def otlp_points(now_ns: str) -> list[dict]:
         for key in ("prefix_hits", "prefix_misses", "preemptions",
                     "cow_copies", "prefix_evictions", "blocks_in_use",
                     "prefill_chunks", "mixed_steps", "mixed_step_rows",
-                    "ttft_count"):
+                    "ttft_count", "chain_count", "chain_slots",
+                    "chain_emitted"):
             points.append({
                 "asInt": str(snap[key]),
                 "timeUnixNano": now_ns,
@@ -474,14 +569,15 @@ def otlp_points(now_ns: str) -> list[dict]:
                     {"key": "counter", "value": {"stringValue": key}},
                 ],
             })
-        points.append({
-            "asDouble": snap["ttft_sum"],
-            "timeUnixNano": now_ns,
-            "attributes": [
-                {"key": "pool", "value": {"stringValue": s.name}},
-                {"key": "counter", "value": {"stringValue": "ttft_sum"}},
-            ],
-        })
+        for dkey in ("ttft_sum", "host_gap_s"):
+            points.append({
+                "asDouble": snap[dkey],
+                "timeUnixNano": now_ns,
+                "attributes": [
+                    {"key": "pool", "value": {"stringValue": s.name}},
+                    {"key": "counter", "value": {"stringValue": dkey}},
+                ],
+            })
         for shard in range(max(snap.get("shards", 1), 1)):
             shard_attr = {"key": "shard", "value": {"stringValue": str(shard)}}
             for key, val in (

@@ -1,0 +1,374 @@
+"""Device-resident chained decode (Round-10) — ISSUE 5 acceptance.
+
+Pins the tentpole guarantees:
+
+- K-step chain token identity: a chain of up to ``chain_steps`` greedy
+  steps in ONE device program (lax.scan feeding step t's ids into step
+  t+1, KV scattered in-loop into host-pre-extended block tables) emits
+  EXACTLY the tokens the per-step path emits — for mixed lengths, chains
+  spanning block boundaries, EOS inside a chain, max_new inside a chain,
+  and across preemption at chain boundaries;
+- adaptive K: a pending arrival forces the round back to K=1 (the next
+  dispatch after an arrival is never a chain), so step-boundary
+  admission and TTFT semantics are unchanged;
+- pre-extension contract: BlockPool.extend_slots reserves a whole
+  chain's slots ATOMICALLY (PoolExhausted leaves no partial state) and
+  keeps the table/token invariants;
+- tp=8 on the tier-1 virtual mesh is token-identical to tp=1, chained
+  and per-step;
+- the chained program compiles ONCE — a second pass over the same
+  workload triggers zero new XLA compilations (jax_log_compiles);
+- observability: pathway_kv_chain_steps histogram, chain occupancy, and
+  pathway_kv_host_gap_seconds_total export through /metrics + OTLP +
+  the dashboard kv table.
+"""
+
+import logging
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pathway_tpu.kvcache import BlockPool, PagedDecodeEngine, PoolExhausted
+from pathway_tpu.models.decoder import (
+    DecoderConfig, decode_step, init_decoder_params, prefill,
+)
+
+# 8 KV heads / 64 vocab: tp=8 divides both on the virtual 8-device mesh
+_CFG = DecoderConfig(
+    vocab_size=64, d_model=64, n_layers=2, n_heads=8, d_ff=128, max_len=128
+)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_decoder_params(_CFG, jax.random.PRNGKey(0))
+
+
+def _dense_greedy(params, prompt, n_new, bucket=64, cfg=_CFG):
+    """Oracle: the dense batch-1 prefill + decode_step path."""
+    n = len(prompt)
+    buf = np.zeros((1, bucket), np.int32)
+    buf[0, :n] = prompt
+    logits, cache = prefill(
+        params, cfg, jnp.asarray(buf), jnp.asarray([n], jnp.int32)
+    )
+    out = [int(np.argmax(np.asarray(logits[0])))]
+    pos = n
+    for _ in range(n_new - 1):
+        logits, cache = decode_step(
+            params, cfg, cache, jnp.asarray([[out[-1]]], jnp.int32), pos
+        )
+        out.append(int(np.argmax(np.asarray(logits[0]))))
+        pos += 1
+    return out
+
+
+def _engine(params, name, chain_steps, **kw):
+    kw.setdefault("num_blocks", 96)
+    kw.setdefault("block_size", 4)
+    kw.setdefault("max_batch_size", 4)
+    kw.setdefault("seq_buckets", (16, 32, 64))
+    kw.setdefault("prefill_chunk", 8)
+    return PagedDecodeEngine(
+        _CFG, params, chain_steps=chain_steps, name=name, **kw
+    )
+
+
+def _prompts(lengths, seed=7):
+    rng = np.random.default_rng(seed)
+    return [
+        [int(t) for t in rng.integers(0, _CFG.vocab_size, size=n)]
+        for n in lengths
+    ]
+
+
+# -- token identity ----------------------------------------------------------
+
+
+def test_chained_identity_mixed_lengths_spanning_blocks(params):
+    # block_size=4 with chain_steps=8: every chain crosses at least one
+    # block boundary, and lengths straddle chunk width and block size
+    prompts = _prompts((3, 5, 8, 11, 16, 17, 27, 31))
+    e1 = _engine(params, "t_ch_id1", 1)
+    e8 = _engine(params, "t_ch_id8", 8)
+    got1 = e1.generate_batch([(p, 11) for p in prompts])
+    got8 = e8.generate_batch([(p, 11) for p in prompts])
+    assert got8 == got1
+    assert got8 == [_dense_greedy(params, p, 11) for p in prompts]
+    snap = e8.pool.stats.snapshot()
+    # chain_steps_sum > chain_count proves a genuine multi-step dispatch
+    # ran (K=1 per-step/mixed rounds also land in the histogram now)
+    assert snap["chain_steps_sum"] > snap["chain_count"], \
+        "quiet workload never chained"
+    assert snap["chain_emitted"] > 0
+    e8.pool.check_invariants(external_refs=e8.prefix.external_refs())
+
+
+def test_eos_inside_chain(params):
+    prompts = _prompts((5, 9, 14, 23), seed=11)
+    ref = _engine(params, "t_ch_eosr", 1)
+    base = ref.generate_batch([(p, 12) for p in prompts])
+    # a token the greedy stream emits mid-chain (position 4 of row 0):
+    # the chained engine must truncate at it exactly like the per-step
+    # path, discarding the chain's post-EOS garbage tail
+    stop = base[0][4]
+    a = _engine(params, "t_ch_eos1", 1).generate_batch(
+        [(p, 12) for p in prompts], stop_token=stop
+    )
+    e8 = _engine(params, "t_ch_eos8", 8)
+    b = e8.generate_batch([(p, 12) for p in prompts], stop_token=stop)
+    assert a == b
+    assert stop in b[0] and len(b[0]) <= 12
+    # truncation shows up as chain occupancy < 1 (dispatched slots whose
+    # ids were discarded)
+    assert e8.pool.stats.snapshot()["chain_occupancy"] < 1.0
+    e8.pool.check_invariants(external_refs=e8.prefix.external_refs())
+
+
+def test_max_new_inside_chain(params):
+    prompts = _prompts((5, 9, 14), seed=13)
+    for n_new in (1, 3, 5, 7):
+        a = _engine(params, f"t_ch_mn1_{n_new}", 1).generate_batch(
+            [(p, n_new) for p in prompts]
+        )
+        b = _engine(params, f"t_ch_mn8_{n_new}", 8).generate_batch(
+            [(p, n_new) for p in prompts]
+        )
+        assert a == b
+        assert all(len(o) == n_new for o in b)
+
+
+def test_preemption_at_chain_boundaries(params):
+    # pool too small for 4 growing sequences: chain pre-extension must
+    # trigger preemption-with-recompute, and the result must still be
+    # token-identical to the per-step path under the same pressure
+    prompts = _prompts((3, 5, 8, 11))
+    outs, preempts = {}, {}
+    for k in (1, 8):
+        eng = _engine(params, f"t_ch_pre{k}", k, num_blocks=14)
+        outs[k] = eng.generate_batch([(p, 12) for p in prompts])
+        preempts[k] = eng.pool.stats.snapshot()["preemptions"]
+        eng.pool.check_invariants(
+            external_refs=eng.prefix.external_refs()
+        )
+    assert outs[8] == outs[1]
+    assert preempts[8] > 0, "pool pressure never forced a preemption"
+
+
+# -- adaptive K ---------------------------------------------------------------
+
+
+def test_arrival_forces_k1(params):
+    """After the poll hands the engine an arrival, the NEXT dispatch must
+    not be a chain: pending admissions adapt K back to 1 so the arrival
+    is admitted at the next step boundary (mixed dispatch), not after a
+    full quiet-mode chain."""
+    prompts = _prompts((6, 9, 13, 30), seed=17)
+    events_by_k = {}
+    results_by_k = {}
+    for k in (1, 8):
+        eng = _engine(params, f"t_ch_arr{k}", k)
+        events = []
+
+        def spy(fn, kind, _ev=events):
+            def run(*a):
+                _ev.append(kind)
+                return fn(*a)
+            return run
+
+        eng._chained = spy(eng._chained, "chain")
+        eng._mixed = spy(eng._mixed, "mixed")
+        eng._step = spy(eng._step, "step")
+        got = []
+        state = {"rounds": 0}
+
+        def poll(n, _s=state, _ev=events):
+            _s["rounds"] += 1
+            if _s["rounds"] == 3:
+                _ev.append("arrival")
+                return [((prompts[3], 6), 1, got.append,
+                         lambda e: got.append(e))]
+            return []
+
+        base = eng.generate_batch([(p, 14) for p in prompts[:3]], poll=poll)
+        events_by_k[k] = list(events)
+        results_by_k[k] = (base, got)
+    assert results_by_k[8] == results_by_k[1]
+    ev = events_by_k[8]
+    assert "chain" in ev, "quiet prefix of the workload never chained"
+    i_arr = ev.index("arrival")
+    assert "mixed" in ev[i_arr:], "arrival was never admitted"
+    i_mixed = i_arr + ev[i_arr:].index("mixed")
+    assert "chain" not in ev[i_arr:i_mixed], (
+        "a chain was dispatched while the arrival was pending: "
+        f"{ev[i_arr:i_mixed]}"
+    )
+
+
+# -- pre-extension contract ---------------------------------------------------
+
+
+def test_extend_slots_atomic_and_invariant_clean():
+    pool = BlockPool(num_blocks=6, block_size=4, n_layers=1, n_heads=2,
+                     head_dim=4, name="t_ext")
+    pool.allocate(1, 6)  # 2 blocks
+    slots = pool.extend_slots(1, 5)  # offset 2 -> needs 1 fresh block
+    assert len(slots) == 5
+    assert [off for _b, off in slots] == [2, 3, 0, 1, 2]
+    assert pool.sequence(1).n_tokens == 11
+    pool.check_invariants()
+    # 2 blocks left free but a 10-slot chain needs 3 fresh -> ATOMIC fail
+    state = ([list(pool._free)], pool.sequence(1).n_tokens,
+             list(pool.sequence(1).block_ids))
+    with pytest.raises(PoolExhausted):
+        pool.extend_slots(1, 10)
+    assert pool.sequence(1).n_tokens == state[1]
+    assert pool.sequence(1).block_ids == state[2]
+    assert list(pool._free) == state[0][0]
+    pool.check_invariants()
+    # COW: extending through a shared tail copies it first, preserving
+    # the parent's bytes/refcounts
+    pool.fork(1, 2)
+    slots2 = pool.extend_slots(2, 1)
+    assert slots2[0][0] != pool.sequence(1).block_ids[-1]
+    pool.check_invariants()
+    assert pool.stats.snapshot()["cow_copies"] >= 1
+
+
+def test_extend_slots_matches_repeated_append():
+    a = BlockPool(num_blocks=16, block_size=4, n_layers=1, n_heads=2,
+                  head_dim=4, name="t_ext_a")
+    b = BlockPool(num_blocks=16, block_size=4, n_layers=1, n_heads=2,
+                  head_dim=4, name="t_ext_b")
+    a.allocate(1, 3)
+    b.allocate(1, 3)
+    got = a.extend_slots(1, 7)
+    want = [b.append_slot(1) for _ in range(7)]
+    assert got == want
+    assert a.sequence(1).block_ids == b.sequence(1).block_ids
+    a.check_invariants()
+
+
+# -- tensor parallel ----------------------------------------------------------
+
+
+def test_tp8_chained_identity(params):
+    prompts = _prompts((3, 8, 17, 27))
+    out = {}
+    for tp in (1, 8):
+        eng = _engine(params, f"t_ch_tp{tp}", 8, tp=tp)
+        out[tp] = eng.generate_batch([(p, 9) for p in prompts])
+    assert out[8] == out[1]
+    assert out[1] == [_dense_greedy(params, p, 9) for p in prompts]
+
+
+# -- recompile guard ----------------------------------------------------------
+
+
+def test_chained_second_pass_zero_recompiles(params):
+    """The chained program's (B, chain_steps) shape is static: running
+    the same quiet workload twice must not compile anything on the
+    second pass (an accidentally K- or length-polymorphic input would
+    show up here as a per-chain compile)."""
+    eng = _engine(params, "t_ch_compile", 8)
+    prompts = _prompts((3, 9, 15, 21), seed=23)
+    reqs = [(p, 11) for p in prompts]
+
+    class _Capture(logging.Handler):
+        def __init__(self):
+            super().__init__()
+            self.compiles = []
+
+        def emit(self, record):
+            msg = record.getMessage()
+            if msg.startswith("Compiling "):
+                self.compiles.append(msg)
+
+    jax_logger = logging.getLogger("jax")
+    old_level = jax_logger.level
+
+    def _run_captured():
+        handler = _Capture()
+        jax_logger.addHandler(handler)
+        jax_logger.setLevel(logging.WARNING)
+        try:
+            with jax.log_compiles(True):
+                eng.generate_batch(list(reqs))
+        finally:
+            jax_logger.removeHandler(handler)
+            jax_logger.setLevel(old_level)
+        return handler.compiles
+
+    first = _run_captured()
+    assert first, "capture mechanism saw no compiles on the cold pass"
+    snap = eng.pool.stats.snapshot()
+    assert snap["chain_steps_sum"] > snap["chain_count"]  # really chained
+    second = _run_captured()
+    assert second == [], (
+        f"second pass recompiled {len(second)} programs: {second[:4]}"
+    )
+
+
+# -- observability ------------------------------------------------------------
+
+
+def test_chain_metrics_export(params):
+    from pathway_tpu.serve import metrics as M
+
+    eng = _engine(params, "t_ch_metrics", 8)
+    prompts = _prompts((5, 9, 14), seed=29)
+    eng.generate_batch([(p, 11) for p in prompts])
+    snap = eng.pool.stats.snapshot()
+    assert snap["chain_count"] > 0
+    assert snap["chain_steps_sum"] > snap["chain_count"]  # K=8 chains ran
+    # K=1 (mixed/per-step) rounds land in the le=1 bucket: the adaptive-K
+    # policy is visible in the histogram, not just the chained spike
+    from pathway_tpu.serve.metrics import CHAIN_BUCKETS
+    assert snap["chain_buckets"][CHAIN_BUCKETS.index(1)] > 0
+    assert 0.0 < snap["chain_occupancy"] <= 1.0
+    assert snap["chain_emitted"] <= snap["chain_slots"]
+    assert snap["host_gap_s"] > 0.0  # per-chain host windows accumulated
+    lines = "\n".join(M.render_prometheus_lines())
+    lbl = f'pool="{eng.pool.name}"'
+    assert f'pathway_kv_chain_steps_bucket{{{lbl},le="8"}}' in lines
+    assert f'pathway_kv_chain_steps_bucket{{{lbl},le="+Inf"}} ' \
+           f"{snap['chain_count']}" in lines
+    assert f"pathway_kv_chain_steps_count{{{lbl}}} " \
+           f"{snap['chain_count']}" in lines
+    assert f"pathway_kv_chain_slots_total{{{lbl}}}" in lines
+    assert f"pathway_kv_chain_emitted_total{{{lbl}}}" in lines
+    assert f"pathway_kv_chain_occupancy{{{lbl}}}" in lines
+    assert f"pathway_kv_host_gap_seconds_total{{{lbl}}}" in lines
+    # cumulative histogram buckets are monotone and end at the count
+    bucket_vals = [
+        int(line.rsplit(" ", 1)[1])
+        for line in lines.splitlines()
+        if line.startswith(f"pathway_kv_chain_steps_bucket{{{lbl}")
+    ]
+    assert bucket_vals == sorted(bucket_vals)
+    assert bucket_vals[-1] == snap["chain_count"]
+    points = M.otlp_points("0")
+    counters = {
+        a["value"]["stringValue"]
+        for p in points for a in p["attributes"]
+        if a["key"] == "counter"
+    }
+    assert {"chain_count", "chain_slots", "chain_emitted",
+            "host_gap_s"} <= counters
+    # dashboard renders the chain columns without an engine scheduler
+    from pathway_tpu.engine import telemetry as T
+
+    class _FakeOp:
+        name, id, rows_in, rows_out = "op", 0, 1, 1
+
+    class _FakeSched:
+        operators = [_FakeOp()]
+        frontier = 0
+
+    ms = T.MetricsServer.__new__(T.MetricsServer)
+    ms.scheduler = _FakeSched()
+    ms.started_at = 0.0
+    html = ms.render_dashboard()
+    assert "chain occ" in html and "host gap ms" in html

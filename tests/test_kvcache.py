@@ -413,6 +413,7 @@ def test_engine_failure_releases_inflight_waiters(params):
         raise RuntimeError("device exploded")
 
     eng._step = boom
+    eng._chained = boom  # round-10: a quiet queue decodes via the chain
     got = {}
     polled = [(
         ([1, 2, 3], 4), 1,
