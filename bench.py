@@ -921,6 +921,9 @@ def bench_generation() -> dict:
         eng_c.generate_batch([(p, bn_new + 1) for p in bprompts])  # + chain
         t_c_prefill = t_c_full = float("inf")
         gap_chained = occ = None
+        best_window = None
+        from pathway_tpu import obs as _obs
+
         for _ in range(2):
             t0 = _t.perf_counter()
             eng_c.generate_batch([(p, 1) for p in bprompts])
@@ -931,11 +934,61 @@ def bench_generation() -> dict:
             el = _t.perf_counter() - t0
             if el < t_c_full:
                 t_c_full = el
+                best_window = (t0, t0 + el)
                 s1 = eng_c.pool.stats.snapshot()
                 gap_chained = s1["host_gap_s"] - s0["host_gap_s"]
                 slots = s1["chain_slots"] - s0["chain_slots"]
                 occ = (s1["chain_emitted"] - s0["chain_emitted"]) / slots \
                     if slots else None
+        # ---- tracer-derived per-phase breakdown of the best chained
+        # window (Round-11): the flight recorder is ALWAYS ON, so the
+        # spans for the timed window above are already in the ring —
+        # overlap each phase's spans with the window and normalize
+        if best_window is not None:
+            w0, w1 = best_window
+            spans = _obs.recorder().snapshot()
+
+            def _phase_s(*prefixes):
+                tot = 0.0
+                for s in spans:
+                    if s.t1 is None or s.t1 <= w0 or s.t0 >= w1:
+                        continue
+                    if any(s.name.startswith(p) for p in prefixes):
+                        tot += min(s.t1, w1) - max(s.t0, w0)
+                return tot
+
+            wall = max(w1 - w0, 1e-9)
+            chained_fields["decode_phase_fracs"] = {
+                # scheduler queue wait (0 for this direct-call workload)
+                "queue": round(_phase_s("serve.queue") / wall, 4),
+                # re-admission prefill dispatches inside the timed window
+                "prefill": round(_phase_s(
+                    "engine.device.mixed", "engine.device.prefill"
+                ) / wall, 4),
+                # decode device-busy (dispatch -> sync return)
+                "device": round(_phase_s(
+                    "engine.device.chain", "engine.device.step"
+                ) / wall, 4),
+                # host blocked collecting the [B, K] ids (subset of
+                # device-busy — reported separately, not additive)
+                "sync": round(_phase_s("engine.sync") / wall, 4),
+                # host bookkeeping on the critical path (device idle)
+                "host": round(_phase_s("engine.host_gap") / wall, 4),
+            }
+        # ---- recorder overhead A/B on the SAME workload: chained decode
+        # with the flight recorder disabled vs the always-on number above
+        # (the <=2% budget; the hard guard is tests/test_obs.py's
+        # noise-immune per-event-cost bound)
+        t_off = float("inf")
+        with _obs.disabled():
+            for _ in range(2):
+                eng_c.generate_batch([(p, 1) for p in bprompts])
+                t0 = _t.perf_counter()
+                eng_c.generate_batch([(p, bn_new + 1) for p in bprompts])
+                t_off = min(t_off, _t.perf_counter() - t0)
+        chained_fields["trace_overhead_frac"] = round(
+            (t_c_full - t_off) / max(t_off, 1e-9), 4
+        )
         chained_tok_s = (8 * bn_new) / max(t_c_full - t_c_prefill, 1e-9)
         chained_fields["decode_tokens_per_s_chained"] = round(
             chained_tok_s, 1
@@ -1544,6 +1597,55 @@ _GATED_METRICS = {
 _GATE_TOLERANCE = 0.10
 
 
+def _host_noise_canary(backend: str) -> dict:
+    """Re-run the FIXED matmul roofline calibration at gate time and
+    compare it with (a) the same probe at the start of this run and
+    (b) the best committed same-backend history — so an environmental
+    slowdown (the r06 `data_plane.cold` false positive needed a manual
+    HEAD-worktree A/B to diagnose) self-reports as `host_degraded` > 1
+    right next to the gate verdict.  The probe is the identical fixed
+    workload every round; the code under test never touches it, so a
+    degraded factor here is HOST noise by construction."""
+    try:
+        gflops_now = _measured_matmul_peak() / 1e9
+    except Exception as exc:  # noqa: BLE001 - canary must not fail the bench
+        return {"error": f"matmul probe failed: {exc}"}
+    gflops_start = None
+    start = _PEAK_CACHE.get("peak")
+    if start and start[1] == "measured-matmul-roofline" and start[0]:
+        gflops_start = start[0] / 1e9
+    # best committed same-backend history of this same probe
+    import glob
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    best_hist = None
+    for path in sorted(glob.glob(os.path.join(repo, "BENCH_r*.json"))) + \
+            sorted(glob.glob(os.path.join(repo, "BENCH_SELF_r*.json"))):
+        if os.path.abspath(path) == _SELF_REPORT:
+            continue
+        try:
+            raw = json.load(open(path))
+        except (OSError, ValueError):
+            continue
+        parsed = raw.get("parsed", raw) if isinstance(raw, dict) else None
+        if not isinstance(parsed, dict) or parsed.get("backend") != backend:
+            continue
+        v = parsed.get("host_matmul_gflops")
+        if v:
+            best_hist = max(best_hist or 0.0, float(v))
+    refs = [v for v in (gflops_start, best_hist) if v]
+    return {
+        "gflops_at_gate": round(gflops_now, 1),
+        "gflops_at_start": round(gflops_start, 1) if gflops_start else None,
+        "best_history_gflops": round(best_hist, 1) if best_hist else None,
+        # >1.0 means the host is THAT many times slower than the
+        # reference window; ~1.0 means gate failures are probably real
+        "host_degraded": (
+            round(max(refs) / max(gflops_now, 1e-9), 2) if refs else None
+        ),
+    }
+
+
 def _gate_failures(regressions: list[dict]) -> list[dict]:
     fails = []
     for r in regressions:
@@ -2019,6 +2121,11 @@ def main() -> None:
     # hard self-history gate (VERDICT item 3): >10% regression on a gated
     # metric exits nonzero — but only AFTER the JSON line and self-report
     # land, so the evidence of the regression is never lost to the exit
+    _stage("host-noise canary")
+    canary = _host_noise_canary(backend)
+    # the gate-time probe becomes next round's history reference
+    if canary.get("gflops_at_gate"):
+        out["host_matmul_gflops"] = canary["gflops_at_gate"]
     gate_off = bool(os.environ.get("PATHWAY_BENCH_NO_GATE"))
     gate_fails = _gate_failures(out["regressions"])
     out["gate"] = {
@@ -2026,7 +2133,18 @@ def main() -> None:
         "tolerance": _GATE_TOLERANCE,
         "failures": gate_fails,
         "enforced": not gate_off,
+        # environmental-noise self-diagnosis: a failure with
+        # host_degraded >> 1 is the r06 pattern (degraded host window),
+        # not a code regression — see _host_noise_canary
+        "host_noise_canary": canary,
     }
+    if gate_fails and (canary.get("host_degraded") or 0) > 1.5:
+        out["gate"]["note"] = (
+            f"host is {canary['host_degraded']}x slower than the "
+            "reference window at gate time; failures above are likely "
+            "environmental (r06 precedent) — re-run in a quieter window "
+            "before treating them as regressions"
+        )
     # the full record — including the verbose probe log — lives in the
     # committed self-report; the printed line stays small enough that a
     # bounded tail capture keeps every headline field

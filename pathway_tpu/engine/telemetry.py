@@ -127,6 +127,26 @@ class MetricsServer:
                 "<th>chain occ</th><th>host gap ms</th></tr>"
                 f"{kv_rows}</table>"
             )
+        trace_html = ""
+        try:
+            from .. import obs as _obs
+
+            spans = _obs.recorder().recent(16)  # newest first, O(16)
+        except Exception:
+            spans = []
+        if spans:
+            now = time.perf_counter()
+            span_rows = "".join(
+                f"<tr><td>{s.name}</td><td>{s.trace_id}</td>"
+                f"<td>{s.duration_s * 1e3:.2f}</td>"
+                f"<td>{(now - s.t0):.1f}s ago</td></tr>"
+                for s in spans
+            )
+            trace_html = (
+                "<h3>recent spans (flight recorder)</h3>"
+                "<table><tr><th>span</th><th>trace</th><th>dur ms</th>"
+                f"<th>started</th></tr>{span_rows}</table>"
+            )
         return (
             "<html><head><title>pathway-tpu</title>"
             '<meta http-equiv="refresh" content="2">'
@@ -137,8 +157,9 @@ class MetricsServer:
             f"&middot; uptime={time.time() - self.started_at:.0f}s</h2>"
             "<table><tr><th>operator</th><th>id</th><th>rows in</th>"
             f"<th>rows out</th></tr>{rows}</table>"
-            f"{serve_html}{kv_html}"
-            '<p><a href="/metrics">/metrics</a></p></body></html>'
+            f"{serve_html}{kv_html}{trace_html}"
+            '<p><a href="/metrics">/metrics</a> &middot; '
+            '<a href="/debug/trace">/debug/trace</a></p></body></html>'
         )
 
     def start(self) -> None:
@@ -159,6 +180,17 @@ class MetricsServer:
                 elif self.path in ("/", "/dashboard"):
                     body = render_html().encode()
                     ctype = "text/html"
+                elif self.path.split("?", 1)[0] == "/debug/trace":
+                    # flight-recorder dump: Perfetto-loadable Chrome trace
+                    # JSON (?trace=<id> filters to one request's spans)
+                    from urllib.parse import parse_qsl as _pq
+
+                    from .. import obs as _obs
+
+                    body = _obs.chrome_trace_dump(
+                        dict(_pq(self.path.partition("?")[2]))
+                    ).encode()
+                    ctype = "application/json"
                 else:
                     self.send_response(404)
                     self.end_headers()

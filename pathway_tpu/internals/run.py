@@ -78,6 +78,13 @@ def run(
 
     from ..engine.telemetry import global_tracer
 
+    # round-11: when an OTLP endpoint is configured, the flight
+    # recorder's background flusher ships request/data-plane spans to it
+    # for the run's lifetime (no-op otherwise; atexit stops it cleanly)
+    from .. import obs as _obs
+
+    _obs.maybe_start_flusher_from_env()
+
     _build_span = global_tracer.span("pathway.graph_build", sinks=len(sinks))
     _build_span.__enter__()
     try:

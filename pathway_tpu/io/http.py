@@ -29,6 +29,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Sequence
 from urllib.parse import parse_qsl, urlsplit
 
+from .. import obs
 from ..internals import dtype as dt
 from ..internals import parse_graph as pg
 from ..internals.datasource import SubjectDataSource
@@ -269,6 +270,14 @@ class PathwayWebserver:
         }
         if with_schema_endpoint:
             self._routes[("GET", "/_schema")] = (self._schema_handler, True)
+        # flight-recorder dump: Perfetto-loadable Chrome trace JSON of
+        # recent spans (``?trace=<id>`` filters to one request's tree)
+        self._routes[("GET", "/debug/trace")] = (self._trace_handler, True)
+
+    def _trace_handler(self, _payload: dict, meta: dict) -> Any:
+        return _RawText(
+            obs.chrome_trace_dump(meta.get("params")), "application/json"
+        )
 
     # -- OpenAPI -----------------------------------------------------------
     def openapi_description_json(self, origin: str | None = None) -> dict:
@@ -353,6 +362,17 @@ class PathwayWebserver:
                 started = time.time()
                 split = urlsplit(self.path)
                 path = split.path.rstrip("/") or "/"
+                # request-scoped tracing (Round-11): an X-Pathway-Trace
+                # header joins the caller's trace, otherwise one is
+                # minted here; the id is echoed back in the response so
+                # clients can fetch the request's spans from /debug/trace
+                req_span = obs.start_span(
+                    "http.request",
+                    ctx=obs.context_from_trace_header(
+                        self.headers.get("X-Pathway-Trace")
+                    ),
+                    method=method, route=path,
+                )
                 access = {
                     "_type": "http_access",
                     "method": method,
@@ -362,6 +382,7 @@ class PathwayWebserver:
                     "unix_timestamp": int(started),
                     "remote": self.client_address[0],
                     "session_id": session_id,
+                    "trace_id": req_span.trace_id,
                 }
 
                 def finish(code: int, payload: bytes, ctype="application/json",
@@ -371,7 +392,10 @@ class PathwayWebserver:
                     (logging.info if code < 400 else logging.error)(
                         json.dumps(access)
                     )
-                    self._respond(code, payload, ctype, extra_headers)
+                    req_span.finish(status=code)
+                    hdrs = dict(extra_headers or {})
+                    hdrs.setdefault("X-Pathway-Trace", req_span.trace_id)
+                    self._respond(code, payload, ctype, hdrs)
 
                 entry = ws._routes.get((method, path))
                 if entry is None:
@@ -387,10 +411,14 @@ class PathwayWebserver:
                     "host": self.headers.get("Host"),
                     "body": body,
                     "session_id": session_id,
+                    "trace_id": req_span.trace_id,
                 }
                 if not ws._sema.acquire(timeout=ws._queue_timeout_s):
                     finish(503, b'{"error": "server at capacity"}')
                     return
+                # spans opened by the handler (rest subject, scheduler
+                # submit, engine) parent under this request's span
+                _trace_token = obs.set_current(req_span.ctx)
                 try:
                     # undecodable bodies become {} rather than a hard 400 —
                     # raw-format handlers consume meta['body'] verbatim and a
@@ -418,6 +446,7 @@ class PathwayWebserver:
                     logging.exception("Error in HTTP handler")
                     finish(500, json.dumps({"error": str(exc)}).encode())
                 finally:
+                    obs.reset_current(_trace_token)
                     ws._sema.release()
 
             def do_POST(self):
@@ -528,6 +557,10 @@ class _RestSubject:
 
     def handle(self, payload: dict, meta: dict | None = None) -> Any:
         meta = meta or {"params": {}, "headers": {}, "body": b""}
+        with obs.span("rest.handle", format=self.format):
+            return self._handle_traced(payload, meta)
+
+    def _handle_traced(self, payload: dict, meta: dict) -> Any:
         payload = self._build_payload(payload, meta)
         self._verify_payload(payload)
         if self.request_validator is not None:
@@ -565,7 +598,9 @@ class _RestSubject:
             slot: list = []
             self.pending[qid] = (ev, slot)
             self._source.push(row, 1, qid)
-            ok = ev.wait(timeout=self.timeout_s)
+            # the engine round-trip: push -> dataflow -> response writer
+            with obs.span("rest.engine_wait"):
+                ok = ev.wait(timeout=self.timeout_s)
             if self.delete_completed:
                 self._source.push(row, -1, qid)
             self.pending.pop(qid, None)

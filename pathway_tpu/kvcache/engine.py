@@ -60,24 +60,36 @@ from __future__ import annotations
 import threading
 import time
 from collections import deque
-from typing import Any, Callable
+from typing import Any, Callable, Sequence
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .. import obs
 from .block_pool import BlockPool, PoolExhausted
 from .prefix_cache import PrefixCache
+
+# jax.profiler.TraceAnnotation wraps every engine dispatch so XLA/TPU
+# profiles (jax.profiler.trace) line up with our flight-recorder spans;
+# a nullcontext fallback keeps old jax versions working
+_TraceAnnotation = getattr(jax.profiler, "TraceAnnotation", None)
+if _TraceAnnotation is None:  # pragma: no cover - modern jax has it
+    import contextlib
+
+    def _TraceAnnotation(_name):  # noqa: N802 - drop-in stand-in
+        return contextlib.nullcontext()
 
 
 class _Request:
     __slots__ = ("prompt", "max_new", "priority", "stop_token", "emitted",
-                 "index", "on_done", "on_error", "t_arrival")
+                 "index", "on_done", "on_error", "t_arrival", "span", "ctx")
 
     def __init__(self, prompt, max_new: int, *, priority: int = 1,
                  stop_token: int | None = None, index: int | None = None,
                  on_done: Callable | None = None,
-                 on_error: Callable | None = None):
+                 on_error: Callable | None = None,
+                 trace: tuple | None = None):
         self.prompt = list(prompt)
         self.max_new = int(max_new)
         self.priority = int(priority)
@@ -87,6 +99,16 @@ class _Request:
         self.on_done = on_done
         self.on_error = on_error
         self.t_arrival = time.perf_counter()
+        # request-scoped tracing: the root span is opened the moment the
+        # engine learns about the request (its trace id is minted here
+        # unless the serving path already carries one — e.g. an
+        # X-Pathway-Trace header through scheduler submit()) and finished
+        # at delivery; admission/prefill/chain spans parent under it
+        self.span = obs.start_span(
+            "engine.request", ctx=trace,
+            prompt_tokens=len(self.prompt), max_new=self.max_new,
+        )
+        self.ctx = self.span.ctx
 
 
 class _Active:
@@ -227,8 +249,14 @@ class PagedDecodeEngine:
         self.chain_steps = max(1, int(chain_steps))
         # host-gap accounting: perf_counter of the last device->host sync
         # (the device has nothing queued past it) — the next dispatch
-        # closes the window and records it (see _note_sync/_note_dispatch)
+        # closes the window and records it (see _note_sync/_note_dispatch).
+        # Round-11 generalizes the pair into device-busy vs host-gap SPANS
+        # on the engine-run trace: _note_dispatch opens the device window
+        # (closing any host gap), _note_sync closes it
         self._t_device_idle: float | None = None
+        self._t_dispatch: float | None = None
+        self._dispatch_kind = "step"
+        self._run_ctx: tuple = (obs.new_trace_id(), 0)
         self._seq_counter = 0
         self._lock = threading.RLock()
         # chain key -> (writer _Active, physical block) for blocks an
@@ -352,6 +380,9 @@ class PagedDecodeEngine:
                         int(w.priority),
                         functools.partial(scheduler.complete_inflight, w),
                         functools.partial(scheduler.fail_inflight, w),
+                        # request-scoped trace context rides along so the
+                        # engine's spans parent under the submit() root
+                        getattr(w, "trace", None),
                     ))
                 return items
         def _prio(v) -> int:
@@ -362,6 +393,17 @@ class PagedDecodeEngine:
 
                 return int(Priority.parse(v))
 
+        # request-scoped tracing: the scheduler exposes the batch's
+        # waiters while batch_fn runs, so each payload's engine spans
+        # join the trace its submit() minted (size-bucket padding repeats
+        # the last payload past the waiter list — those get fresh traces)
+        traces = []
+        if scheduler is not None:
+            traces = [
+                getattr(w, "trace", None)
+                for w in getattr(scheduler, "_inflight_waiters", ()) or ()
+            ]
+
         return self.generate_batch(
             [
                 (list(r[0]), int(r[1])) if len(r) < 3
@@ -370,11 +412,13 @@ class PagedDecodeEngine:
             ],
             poll=poll,
             return_exceptions=True,
+            traces=traces,
         )
 
     def generate_batch(self, requests, *, poll: Callable | None = None,
                        stop_token: int | None = None,
-                       return_exceptions: bool = False) -> list[list[int]]:
+                       return_exceptions: bool = False,
+                       traces: Sequence | None = None) -> list[list[int]]:
         """Greedy-decode a batch of ``(prompt_ids, max_new)`` requests (an
         optional third element is a serve.admission.Priority value).
 
@@ -396,12 +440,19 @@ class PagedDecodeEngine:
             priority = r[2] if len(r) > 2 else 1
             pending.append(_Request(
                 prompt, max_new, priority=priority, stop_token=stop, index=i,
+                trace=traces[i] if traces and i < len(traces) else None,
             ))
         results: list[Any] = [None] * len(requests)
         errors: list[tuple[int, BaseException]] = []
         outstanding = {"n": len(requests)}  # batch-origin work still open
 
         def deliver(req: _Request, err: BaseException | None = None) -> None:
+            # delivery closes the request's root span (finish() is
+            # idempotent, so a double-delivered edge case records once)
+            req.span.finish(
+                outcome="error" if err is not None else "done",
+                emitted=len(req.emitted),
+            )
             if req.on_done is None and req.on_error is None:
                 outstanding["n"] -= 1
             if err is not None:
@@ -440,6 +491,13 @@ class PagedDecodeEngine:
         # a dangling idle mark from the PREVIOUS batch's last sync would
         # bill the whole inter-batch wait to this batch's first dispatch
         self._t_device_idle = None
+        self._t_dispatch = None
+        # engine-run trace: device-busy / host-gap / sync spans for this
+        # run group under one root (requests keep their own traces)
+        run_span = obs.start_span(
+            "engine.run", ctx=(obs.new_trace_id(), 0), pool=self.pool.name,
+        )
+        self._run_ctx = run_span.ctx
         try:
             self._loop_body(running, pending, deliver, poll, stop)
         except BaseException as exc:
@@ -456,7 +514,17 @@ class PagedDecodeEngine:
                 deliver(act.req, exc)
             while pending:
                 deliver(pending.popleft(), exc)
+            run_span.finish(error=type(exc).__name__)
+            # always-on flight recorder: an engine failure dumps the span
+            # timeline (Perfetto-loadable) AFTER the failure spans above
+            # landed, so the dump shows what led up to it and which
+            # requests it took down — even when the process is about to die
+            try:
+                obs.recorder().dump_on_failure("engine_failure", exc)
+            except Exception:  # noqa: BLE001 - never mask the real error
+                pass
             raise
+        run_span.finish()
         return running
 
     def _admit_arrivals(self, running, pending, poll, stop) -> None:
@@ -468,12 +536,17 @@ class PagedDecodeEngine:
             return
         budget = self.max_batch_size - len(running) - len(pending)
         for item in (poll(budget) if budget > 0 else ()):
-            payload, priority, on_done, on_error = item
+            payload, priority, on_done, on_error = item[:4]
+            # an optional 5th element is the request's trace context
+            # (serve_batch's poll wrapper supplies it; bare 4-tuples from
+            # direct poll= callers mint a fresh trace at admission)
+            trace = item[4] if len(item) > 4 else None
             # priority-ordered like _requeue: an urgent arrival
             # must not queue behind a lower-priority victim
             self._requeue(pending, _Request(
                 payload[0], payload[1], priority=priority,
                 stop_token=stop, on_done=on_done, on_error=on_error,
+                trace=trace,
             ))
 
     def _loop_body(self, running, pending, deliver, poll, stop):
@@ -481,7 +554,16 @@ class PagedDecodeEngine:
             self._admit_arrivals(running, pending, poll, stop)
             while pending and len(running) < self.max_batch_size:
                 req = pending[0]
+                t0a = time.perf_counter()
                 status = self._try_admit(req, running, pending, deliver)
+                if status != "wait":
+                    # "wait" recurs every round while the pool is full —
+                    # recording each retry would flood the ring (and the
+                    # request's trace) with duplicates; the blocked time
+                    # is visible as the request-start -> admission gap
+                    obs.record_span("engine.admission", t0a,
+                                    time.perf_counter(), ctx=req.ctx,
+                                    outcome=status)
                 if status == "wait":
                     break
                 pending.popleft()
@@ -520,15 +602,28 @@ class PagedDecodeEngine:
         window, so ``pathway_kv_host_gap_seconds_total`` measures exactly
         the host-on-critical-path time the device spends waiting — on the
         double-buffered chained path the bookkeeping that runs AFTER the
-        next dispatch is correctly excluded."""
-        self._t_device_idle = time.perf_counter()
-
-    def _note_dispatch(self) -> None:
-        if self._t_device_idle is not None:
-            self.pool.stats.record_host_gap(
-                time.perf_counter() - self._t_device_idle
+        next dispatch is correctly excluded.  Round-11: the dispatch->sync
+        window additionally lands as an ``engine.device.<kind>`` span on
+        the engine-run trace (device-busy), the sync->dispatch window as
+        ``engine.host_gap`` — the two halves of every engine round."""
+        now = time.perf_counter()
+        if self._t_dispatch is not None:
+            obs.record_span(
+                "engine.device." + self._dispatch_kind,
+                self._t_dispatch, now, ctx=self._run_ctx,
             )
+            self._t_dispatch = None
+        self._t_device_idle = now
+
+    def _note_dispatch(self, kind: str = "step") -> None:
+        now = time.perf_counter()
+        if self._t_device_idle is not None:
+            self.pool.stats.record_host_gap(now - self._t_device_idle)
+            obs.record_span("engine.host_gap", self._t_device_idle, now,
+                            ctx=self._run_ctx)
             self._t_device_idle = None
+        self._t_dispatch = now
+        self._dispatch_kind = kind
 
     def _emit(self, req: _Request, token_id: int) -> None:
         """Record one emitted token; the FIRST token of a request closes
@@ -673,11 +768,14 @@ class PagedDecodeEngine:
             # perturb its remaining decode
             scatter_bt = self.pool.block_table(seq_id, nb)
             scatter_bt[: len(shared)] = 0
-            self._note_dispatch()
-            ids, self.pool.k, self.pool.v = self._prefill(
-                self.params, jnp.asarray(buf), jnp.asarray([n], jnp.int32),
-                self.pool.k, self.pool.v, jnp.asarray(scatter_bt[None, :]),
-            )
+            self._note_dispatch("prefill")
+            with _TraceAnnotation("pw.prefill"):
+                ids, self.pool.k, self.pool.v = self._prefill(
+                    self.params, jnp.asarray(buf),
+                    jnp.asarray([n], jnp.int32),
+                    self.pool.k, self.pool.v,
+                    jnp.asarray(scatter_bt[None, :]),
+                )
             if self.prefix is not None:
                 # zip inside insert() truncates to the full-block keys, so
                 # a partial tail block (the live decode-write target) is
@@ -807,12 +905,14 @@ class PagedDecodeEngine:
             bt[i, : len(seq.block_ids)] = seq.block_ids
             acts.append(act)
             kreal.append(len(slots))
-        self._note_dispatch()
-        ids, pool.k, pool.v = self._chained(
-            self.params, pool.k, pool.v, jnp.asarray(token),
-            jnp.asarray(positions), jnp.asarray(bt), jnp.asarray(sb),
-            jnp.asarray(so),
-        )
+        self._note_dispatch("chain")
+        t_disp = self._t_dispatch
+        with _TraceAnnotation("pw.chain_dispatch"):
+            ids, pool.k, pool.v = self._chained(
+                self.params, pool.k, pool.v, jnp.asarray(token),
+                jnp.asarray(positions), jnp.asarray(bt), jnp.asarray(sb),
+                jnp.asarray(so),
+            )
         try:
             # start the device->host copy NOW so it overlaps the chain's
             # tail and the host's bookkeeping; np.asarray later just
@@ -820,7 +920,7 @@ class PagedDecodeEngine:
             ids.copy_to_host_async()
         except Exception:  # noqa: BLE001 - optional fast path (CPU arrays)
             pass
-        return acts, kreal, ids
+        return acts, kreal, ids, t_disp
 
     def _scan_chain(self, acts, kreal, ids_np, running
                     ) -> tuple[list[_Active], int]:
@@ -871,9 +971,20 @@ class PagedDecodeEngine:
             # arrival discovered here lands in pending and adapts the
             # NEXT round to K=1 (this chain is the bounded latency cost)
             self._admit_arrivals(running, pending, poll, stop)
-            acts, kreal, ids_dev = inflight
+            acts, kreal, ids_dev, t_disp = inflight
+            t_sync0 = time.perf_counter()
             ids_np = np.asarray(ids_dev)  # ONE sync per K-token chain
+            t_sync1 = time.perf_counter()
+            # the host-blocked-on-device window (a subset of the
+            # device-busy span _note_sync closes below)
+            obs.record_span("engine.sync", t_sync0, t_sync1,
+                            ctx=self._run_ctx)
             self._note_sync()
+            # per-request chain spans: the dispatch->sync window each row
+            # rode, under the REQUEST's trace (k = the row's chain depth)
+            for i, act in enumerate(acts):
+                obs.record_span("engine.chain", t_disp, t_sync1,
+                                ctx=act.req.ctx, k=kreal[i])
             done, n_emitted = self._scan_chain(acts, kreal, ids_np, running)
             for act in done:
                 running.remove(act)
@@ -912,14 +1023,22 @@ class PagedDecodeEngine:
             sb[i] = blk
             so[i] = off
             bt[i, : len(seq.block_ids)] = seq.block_ids
-        self._note_dispatch()
-        ids, self.pool.k, self.pool.v = self._step(
-            self.params, self.pool.k, self.pool.v, jnp.asarray(token),
-            jnp.asarray(positions), jnp.asarray(bt), jnp.asarray(sb),
-            jnp.asarray(so),
-        )
+        self._note_dispatch("step")
+        t_disp = self._t_dispatch
+        with _TraceAnnotation("pw.decode_step"):
+            ids, self.pool.k, self.pool.v = self._step(
+                self.params, self.pool.k, self.pool.v, jnp.asarray(token),
+                jnp.asarray(positions), jnp.asarray(bt), jnp.asarray(sb),
+                jnp.asarray(so),
+            )
+        t_sync0 = time.perf_counter()
         ids = np.asarray(ids)
+        t_sync1 = time.perf_counter()
+        obs.record_span("engine.sync", t_sync0, t_sync1, ctx=self._run_ctx)
         self._note_sync()
+        for act, _slot in reserved:
+            obs.record_span("engine.decode_step", t_disp, t_sync1,
+                            ctx=act.req.ctx)
         # a per-step round IS a K=1 chain: recording it keeps the
         # pathway_kv_chain_steps histogram's le=1 bucket meaningful —
         # admission pressure forcing K back to 1 is visible there
@@ -1032,16 +1151,21 @@ class PagedDecodeEngine:
             raise RuntimeError(
                 "ragged step produced no rows (gated chunk cycle?)"
             )
-        self._note_dispatch()
-        ids, self.pool.k, self.pool.v = self._mixed(
-            self.params, self.pool.k, self.pool.v, jnp.asarray(tokens),
-            jnp.asarray(positions), jnp.asarray(row_tables),
-            jnp.asarray(row_start), jnp.asarray(row_nvalid),
-            jnp.asarray(row_token_idx), jnp.asarray(tok_row),
-            jnp.asarray(tok_col), jnp.asarray(sb), jnp.asarray(so),
-            jnp.asarray(logit_idx),
-        )
+        self._note_dispatch("mixed")
+        t_disp = self._t_dispatch
+        with _TraceAnnotation("pw.mixed_step"):
+            ids, self.pool.k, self.pool.v = self._mixed(
+                self.params, self.pool.k, self.pool.v, jnp.asarray(tokens),
+                jnp.asarray(positions), jnp.asarray(row_tables),
+                jnp.asarray(row_start), jnp.asarray(row_nvalid),
+                jnp.asarray(row_token_idx), jnp.asarray(tok_row),
+                jnp.asarray(tok_col), jnp.asarray(sb), jnp.asarray(so),
+                jnp.asarray(logit_idx),
+            )
+        t_sync0 = time.perf_counter()
         ids = np.asarray(ids)
+        t_sync1 = time.perf_counter()
+        obs.record_span("engine.sync", t_sync0, t_sync1, ctx=self._run_ctx)
         self._note_sync()
         self.pool.stats.record_mixed_step(len(rows))
         n_decode = sum(1 for _a, _r, f in rows if f < 0)
@@ -1056,8 +1180,15 @@ class PagedDecodeEngine:
         )
         for act, row, filled in rows:
             if filled < 0:  # decode row
+                obs.record_span("engine.decode_step", t_disp, t_sync1,
+                                ctx=act.req.ctx)
                 self._emit(act.req, int(ids[row]))
             else:
+                # the chunk's ride through this ragged dispatch, on the
+                # request's trace: [start, end) prompt positions streamed
+                obs.record_span("engine.prefill_chunk", t_disp, t_sync1,
+                                ctx=act.req.ctx, start=act.n_filled,
+                                end=filled)
                 act.n_filled = filled
                 if filled < len(act.tokens):
                     continue  # mid-prefill: this row's logits are garbage

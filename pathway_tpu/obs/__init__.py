@@ -1,0 +1,34 @@
+"""pathway_tpu.obs — request-scoped tracing + the always-on flight
+recorder (Round-11).  See obs/tracer.py for the span model."""
+
+from .tracer import (  # noqa: F401
+    FlightRecorder,
+    Span,
+    chrome_trace_dump,
+    context_from_trace_header,
+    current_context,
+    disabled,
+    event,
+    export_otlp,
+    maybe_start_flusher_from_env,
+    new_trace_id,
+    record_span,
+    recorder,
+    reset_current,
+    sanitize_trace_id,
+    set_current,
+    shutdown,
+    span,
+    start_flusher,
+    start_span,
+    use_context,
+)
+
+__all__ = [
+    "FlightRecorder", "Span", "chrome_trace_dump",
+    "context_from_trace_header", "current_context", "disabled", "event",
+    "export_otlp", "maybe_start_flusher_from_env", "new_trace_id",
+    "record_span", "recorder", "reset_current", "sanitize_trace_id",
+    "set_current", "shutdown", "span", "start_flusher", "start_span",
+    "use_context",
+]

@@ -33,6 +33,7 @@ import time as _time
 from collections import defaultdict
 from typing import Any, Callable
 
+from .. import obs
 from ..engine import runner as runner_mod
 from ..engine.graph import Operator
 from ..engine.types import CapturedStream, Update
@@ -121,6 +122,9 @@ class ClusterRunner:
         self.fabric: Fabric | None = None
         if nprocs > 1:
             self.fabric = Fabric(pid, nprocs, first_port)
+        # data-plane trace: per-round spans (run_time / agree_min) for
+        # this process land here (Round-11 time attribution)
+        self._obs_ctx = (obs.new_trace_id(), 0)
         # redirect each shard scheduler's route() into the cluster router —
         # bound once here, never per visit
         for s in self.owned:
@@ -238,7 +242,17 @@ class ClusterRunner:
                 self.fabric.send_data(owner, t, pos, 0, shard, self._seq, ups)
 
     # -- per-time execution ------------------------------------------------
+    def _fabric_wait_s(self) -> float:
+        """Sum of the fabric's attributed non-compute time (serialize +
+        socket writes, mark/data/ctl barrier waits) — the subtrahend of
+        the compute_s attribution below."""
+        st = self.fabric.stats
+        return (st["send_s"] + st["wait_marks_s"] + st["wait_data_s"]
+                + st["wait_ctl_s"])
+
     def _run_time(self, t: int) -> None:
+        rt0 = _time.perf_counter()
+        w0 = self._fabric_wait_s() if self.fabric is not None else 0.0
         self.cur_t = t
         bucket = self.pending[t]
         for pos in range(self.n_pos):
@@ -269,6 +283,18 @@ class ClusterRunner:
             # here.  Only the mark bookkeeping cleanup the barrier used
             # to do remains.
             self.fabric.prune_marks(t)
+            # round-11 time attribution: this time's wall minus the
+            # fabric waits/sends that accrued inside it is the process's
+            # COMPUTE share — the `pathway_fabric{stat="compute_s"}`
+            # bucket that turns "wait_marks dominates the 2-proc wall"
+            # from a guess into a measured split
+            rt1 = _time.perf_counter()
+            st = self.fabric.stats
+            st["compute_s"] += max(
+                (rt1 - rt0) - (self._fabric_wait_s() - w0), 0.0
+            )
+            obs.record_span("cluster.run_time", rt0, rt1,
+                            ctx=self._obs_ctx, time=t)
 
     def _local_min_pending(self) -> int | None:
         times = [t for t, b in self.pending.items() if b]
@@ -278,6 +304,16 @@ class ClusterRunner:
         return min(times) if times else None
 
     # -- control plane -----------------------------------------------------
+    def _timed_recv_ctl(self):
+        """recv_ctl with the wait billed to wait_ctl_s — ONLY inside the
+        min-agreement round, where the wait is coordinator-round cost (a
+        streaming worker's idle recv_ctl for the next tick command is
+        scheduling slack and must not pollute the time split)."""
+        t0 = _time.perf_counter()
+        msg = self.fabric.recv_ctl()
+        self.fabric.stats["wait_ctl_s"] += _time.perf_counter() - t0
+        return msg
+
     def _agree_min(self, local: int | None) -> int | None:
         """Allreduce-min over pending times WITH the EOT guarantee folded
         in (round-10): each report carries the process's cumulative
@@ -290,6 +326,7 @@ class ClusterRunner:
         to provide with an extra full rendezvous each."""
         if self.fabric is None:
             return local
+        am0 = _time.perf_counter()
         # cross-time sends only (time > frontier): same-time sends were
         # delivered under their time's mark barrier, and re-reporting
         # them would re-agree an already-processed time
@@ -299,7 +336,7 @@ class ClusterRunner:
         if self.pid == 0:
             reports: dict[int, tuple] = {0: (local, counts)}
             for _ in range(self.nprocs - 1):
-                tag, pid, m, cnts = self.fabric.recv_ctl()
+                tag, pid, m, cnts = self._timed_recv_ctl()
                 assert tag == "min", tag
                 reports[pid] = (m, cnts)
             vals = [m for m, _c in reports.values() if m is not None]
@@ -316,10 +353,16 @@ class ClusterRunner:
             }
         else:
             self.fabric.send_ctl(0, ("min", self.pid, local, counts))
-            tag, agreed, my_expected = self.fabric.recv_ctl()
+            tag, agreed, my_expected = self._timed_recv_ctl()
             assert tag == "adv", tag
         self.fabric.wait_data_counts(my_expected)
         self.fabric.confirm_sent(counts)
+        am1 = _time.perf_counter()
+        # the whole coordinator min round (report + reply + count-wait);
+        # its ctl/data wait shares are separately attributed inside
+        self.fabric.stats["agree_min_s"] += am1 - am0
+        obs.record_span("cluster.agree_min", am0, am1, ctx=self._obs_ctx,
+                        agreed=agreed if agreed is not None else "none")
         return agreed
 
     def _gather(self, payload: tuple) -> list | None:

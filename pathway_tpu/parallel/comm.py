@@ -41,6 +41,8 @@ import time as _time
 from collections import defaultdict
 from typing import Any
 
+from .. import obs
+
 _LEN = struct.Struct("<I")
 
 # Per-run shared secret for peer authentication (the spawner generates one
@@ -87,7 +89,16 @@ class Fabric:
             "data_msgs_out": 0, "mark_msgs_out": 0, "ctl_msgs_out": 0,
             "wait_marks_s": 0.0, "wait_eot_s": 0.0, "wait_ctl_s": 0.0,
             "wait_data_s": 0.0,
+            # round-11 time attribution: compute_s/agree_min_s filled by
+            # ClusterRunner; wait_marks_s_p<N> splits the mark-barrier
+            # wait BY PEER so the straggler (ROADMAP item 1's 1.5s
+            # wait_marks_s) is attributable to a process, not a guess
+            "compute_s": 0.0, "agree_min_s": 0.0,
         }
+        for p in self.peers:
+            self.stats[f"wait_marks_s_p{p}"] = 0.0
+        # data-plane trace: fabric wait spans for this process's rounds
+        self._obs_ctx = (obs.new_trace_id(), 0)
         # counted-delivery bookkeeping (round-10 EOT batching): data
         # frames are counted per peer in both directions, and unconfirmed
         # sends remember their target logical time — the cluster's min
@@ -361,15 +372,29 @@ class Fabric:
 
     # -- barriers ----------------------------------------------------------
     def wait_marks(self, time: int, pos: int, timeout_s: float = 120.0) -> None:
-        """Block until every peer marked (time, >= pos)."""
+        """Block until every peer marked (time, >= pos).
+
+        Round-11: the wait is attributed PER PEER — each peer's
+        ``wait_marks_s_p<pid>`` accumulates how long it kept this process
+        at the barrier (its mark's observed arrival minus the wait's
+        start), so a 2-proc `wait_marks_s` spike names its straggler —
+        and waits land as ``fabric.wait_marks`` flight-recorder spans."""
         deadline = _time.monotonic() + timeout_s
         t0 = _time.perf_counter()
+        remaining = set(self.peers)
         with self._cond:
             while True:
                 # success test before the death check: a peer that already
                 # delivered its mark may legitimately be gone by now
-                if all(self._marks[p].get(time, -1) >= pos for p in self.peers):
-                    self.stats["wait_marks_s"] += _time.perf_counter() - t0
+                now = _time.perf_counter()
+                for p in [p for p in remaining
+                          if self._marks[p].get(time, -1) >= pos]:
+                    self.stats[f"wait_marks_s_p{p}"] += now - t0
+                    remaining.discard(p)
+                if not remaining:
+                    self.stats["wait_marks_s"] += now - t0
+                    obs.record_span("fabric.wait_marks", t0, now,
+                                    ctx=self._obs_ctx, time=time, pos=pos)
                     return
                 self._check()
                 if not self._cond.wait(timeout=min(1.0, deadline - _time.monotonic())):
@@ -453,7 +478,10 @@ class Fabric:
             while True:
                 if all(self._recv_counts[p] >= n
                        for p, n in expected.items()):
-                    self.stats["wait_data_s"] += _time.perf_counter() - t0
+                    now = _time.perf_counter()
+                    self.stats["wait_data_s"] += now - t0
+                    obs.record_span("fabric.wait_data", t0, now,
+                                    ctx=self._obs_ctx)
                     return
                 self._check()
                 if not self._cond.wait(
@@ -490,6 +518,11 @@ class Fabric:
         return batches
 
     def recv_ctl(self, timeout_s: float = 120.0) -> Any:
+        # NOTE: no blanket wait_ctl_s accounting here — a streaming
+        # worker blocks in recv_ctl waiting for the coordinator's next
+        # TICK (idle scheduling, not round cost), which would swamp the
+        # time split.  ClusterRunner._agree_min times its own ctl waits
+        # into wait_ctl_s, where they ARE coordinator-round cost.
         try:
             msg = self._ctl.get(timeout=timeout_s)
         except queue.Empty:

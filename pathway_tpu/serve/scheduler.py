@@ -29,6 +29,7 @@ import threading
 import time
 from typing import Any, Callable, Sequence
 
+from .. import obs
 from .admission import (
     AdmissionPolicy,
     DeadlineExceededError,
@@ -43,7 +44,8 @@ from .metrics import serve_stats
 
 class _Waiter:
     __slots__ = ("payload", "priority", "enqueued", "deadline", "event",
-                 "result", "error", "seq", "cancelled")
+                 "result", "error", "seq", "cancelled", "trace",
+                 "queue_span")
 
     def __init__(self, payload, priority: Priority, deadline: float | None, seq: int):
         self.payload = payload
@@ -55,6 +57,12 @@ class _Waiter:
         self.error: BaseException | None = None
         self.seq = seq
         self.cancelled = False
+        # request-scoped tracing (Round-11): `trace` is the request's
+        # (trace_id, span_id) root context — captured at submit() so
+        # engine-side spans parent to it across threads; `queue_span`
+        # covers enqueue -> pop/shed (the queue-wait attribution)
+        self.trace: tuple | None = None
+        self.queue_span = None
 
     def __lt__(self, other: "_Waiter") -> bool:
         return (self.priority, self.seq) < (other.priority, other.seq)
@@ -119,8 +127,13 @@ class RequestScheduler:
         self._seq = itertools.count()
         self._closed = False
         self._inflight = 0
+        self._inflight_waiters: Sequence = ()
         self._thread: threading.Thread | None = None
         self.stats = serve_stats(name, depth_fn=lambda: len(self._heap))
+        # scheduler-scoped trace: batch-formation spans (which cut across
+        # requests) land here; per-request spans live on each request's
+        # own trace
+        self._obs_ctx = (obs.new_trace_id(), 0)
         if start:
             self.start()
 
@@ -144,6 +157,8 @@ class RequestScheduler:
                     w.error = SchedulerClosedError()
                     w.event.set()
                     self.stats.record_shed("closed")
+                    if w.queue_span is not None:
+                        w.queue_span.finish(outcome="closed")
                 self._heap.clear()
             self._cond.notify_all()
         th = self._thread
@@ -173,9 +188,24 @@ class RequestScheduler:
             deadline_s = self.default_deadline_s
         if timeout_s is None:
             timeout_s = self.default_timeout_s
-        waiter = self._admit(payload, priority, deadline_s)
+        # the request's root span: minted here (or continuing the ambient
+        # trace — e.g. the HTTP handler's X-Pathway-Trace context); every
+        # queue/engine span of this request parents under it
+        root = obs.start_span(
+            "serve.request", scheduler=self.name, priority=priority.name,
+        )
+        try:
+            waiter = self._admit(payload, priority, deadline_s,
+                                 trace=root.ctx)
+        except BaseException as exc:
+            root.finish(outcome="shed", error=type(exc).__name__)
+            raise
         if waiter is None:  # degraded
-            return self.degrade_fn(payload)
+            obs.event("serve.degrade", ctx=root.ctx, scheduler=self.name)
+            try:
+                return self.degrade_fn(payload)
+            finally:
+                root.finish(outcome="degraded")
         wait_s = timeout_s
         if deadline_s is not None:
             wait_s = min(wait_s, deadline_s + 0.05)
@@ -195,16 +225,23 @@ class RequestScheduler:
                 expired = (waiter.deadline is not None
                            and time.monotonic() >= waiter.deadline)
                 self.stats.record_shed("deadline" if expired else "timeout")
+                waiter.queue_span.finish(
+                    outcome="shed_deadline" if expired else "shed_timeout"
+                )
+            root.finish(outcome="timeout")
             raise DeadlineExceededError(
                 f"request timed out after {wait_s:.2f}s in scheduler "
                 f"{self.name!r}"
             )
         if waiter.error is not None:
+            root.finish(outcome="error", error=type(waiter.error).__name__)
             raise waiter.error
+        root.finish(outcome="done")
         return waiter.result
 
     def _admit(self, payload, priority: Priority,
-               deadline_s: float | None) -> _Waiter | None:
+               deadline_s: float | None,
+               trace: tuple | None = None) -> _Waiter | None:
         if self._closed:
             self.stats.record_shed("closed")
             raise SchedulerClosedError()
@@ -249,6 +286,10 @@ class RequestScheduler:
                 self.stats.record_shed("closed")
                 raise SchedulerClosedError()
             waiter = _Waiter(payload, priority, deadline, next(self._seq))
+            waiter.trace = trace
+            waiter.queue_span = obs.start_span(
+                "serve.queue", ctx=trace, scheduler=self.name,
+            )
             heapq.heappush(self._heap, waiter)
             self.stats.record_admitted()
             self._cond.notify_all()
@@ -297,12 +338,19 @@ class RequestScheduler:
                 # found itself already out of the heap so the shed is
                 # recorded here
                 self.stats.record_shed("timeout")
+                if w.queue_span is not None:
+                    w.queue_span.finish(outcome="abandoned")
                 continue
             if w.deadline is not None and now > w.deadline:
                 w.error = DeadlineExceededError()
                 w.event.set()
                 self.stats.record_shed("deadline")
+                if w.queue_span is not None:
+                    w.queue_span.finish(outcome="shed_deadline")
             else:
+                # queue wait ends here: the request is in a formed batch
+                if w.queue_span is not None:
+                    w.queue_span.finish(outcome="dispatched")
                 live.append(w)
         return live
 
@@ -353,7 +401,12 @@ class RequestScheduler:
         n = len(batch)
         payloads = self._pad([w.payload for w in batch])
         t0 = time.monotonic()
+        tp0 = time.perf_counter()
         self._inflight = n
+        # batch_fn implementations that know about the scheduler (the
+        # paged engine's serve_batch) read the executing waiters here to
+        # carry each request's trace context into their own spans
+        self._inflight_waiters = batch
         try:
             results = list(self.batch_fn(payloads))[:n]
             if len(results) < n:
@@ -362,13 +415,27 @@ class RequestScheduler:
                 )
         except Exception as exc:  # noqa: BLE001 — propagate to every caller
             self.stats.record_batch(n, sum(t0 - w.enqueued for w in batch))
+            tp1 = time.perf_counter()
+            obs.record_span("serve.batch", tp0, tp1, ctx=self._obs_ctx,
+                            scheduler=self.name, n=n,
+                            padded=len(payloads), error=type(exc).__name__)
             for w in batch:
                 w.error = exc
                 w.event.set()
+                if w.trace is not None:
+                    obs.record_span("serve.execute", tp0, tp1, ctx=w.trace,
+                                    error=type(exc).__name__)
             return
         finally:
             self._inflight = 0
+            self._inflight_waiters = ()
         self.stats.record_batch(n, sum(t0 - w.enqueued for w in batch))
+        tp1 = time.perf_counter()
+        obs.record_span("serve.batch", tp0, tp1, ctx=self._obs_ctx,
+                        scheduler=self.name, n=n, padded=len(payloads))
+        for w in batch:
+            if w.trace is not None:
+                obs.record_span("serve.execute", tp0, tp1, ctx=w.trace)
         completed = 0
         for w, r in zip(batch, results):
             if isinstance(r, BaseException):

@@ -9,8 +9,10 @@ Lowered to a single engine operator keeping an InnerIndex plus the data rows
 
 from __future__ import annotations
 
+import time as _time
 from typing import Any, Callable
 
+from ... import obs
 from ...engine.graph import DiffOutputOperator
 from ...engine.runner import register_lowering, _env_for, _compile
 from ...engine.types import consolidate
@@ -111,10 +113,16 @@ class ExternalIndexOperator(DiffOutputOperator):
         def flush_inserts():
             if not pending_inserts:
                 return
+            # per-pass index-probe span (Round-11): attributes the RAG
+            # serving path's time to the index stage — the sub-index
+            # probes/fusion and embedder nest under the same timeline
+            t0 = _time.perf_counter()
             if len(pending_inserts) >= 4:
                 answers = self._answer_batch(pending_inserts)
             else:
                 answers = [self._answer(k, r) for k, r in pending_inserts]
+            obs.record_span("index.query", t0, _time.perf_counter(),
+                            index=self.name, n=len(pending_inserts))
             # backpressure observability: how many concurrent queries each
             # index pass actually served (serve/metrics.py; the engine-side
             # counterpart of the REST scheduler's batch occupancy)

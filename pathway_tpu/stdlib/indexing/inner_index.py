@@ -10,10 +10,13 @@ from __future__ import annotations
 
 import math
 import re
+import time as _time
 from collections import Counter, defaultdict
 from typing import Any, Callable
 
 import numpy as np
+
+from ... import obs
 
 
 class InnerIndex:
@@ -827,11 +830,22 @@ class HybridIndex(InnerIndex):
             idx.remove(key)
 
     def search(self, query, k, metadata_filter=None):
+        """Round-11: each sub-index probe and the RRF fusion (rerank)
+        land as spans, so a hybrid `query_p50_ms` regression names its
+        stage (dense probe vs BM25 probe vs fuse) instead of hiding in
+        one aggregate number."""
         fused: dict[int, float] = defaultdict(float)
         for idx, q, w in zip(self.inner, query, self.weights):
             if w == 0.0:
                 continue
-            for rank, (key, _score) in enumerate(idx.search(q, k * 2, metadata_filter)):
+            t0 = _time.perf_counter()
+            matches = idx.search(q, k * 2, metadata_filter)
+            obs.record_span("index.probe", t0, _time.perf_counter(),
+                            kind=type(idx).__name__, k=k * 2)
+            for rank, (key, _score) in enumerate(matches):
                 fused[key] += w / (self.k + rank + 1)
-        out = sorted(fused.items(), key=lambda t: -t[1])
-        return out[:k]
+        t0 = _time.perf_counter()
+        out = sorted(fused.items(), key=lambda t: -t[1])[:k]
+        obs.record_span("index.fuse", t0, _time.perf_counter(),
+                        candidates=len(fused), k=k)
+        return out

@@ -55,6 +55,18 @@ class DashboardServer:
     # -- endpoint bodies ---------------------------------------------------
     def handle(self, path: str):
         """Returns (status, body_bytes, content_type) for GET `path`."""
+        if path.split("?", 1)[0] == "/debug/trace":
+            # flight-recorder dump (round-11): spans recorded in THIS
+            # process (a dashboard embedded in a serving process shows
+            # its timeline; the standalone app shows its own requests)
+            from urllib.parse import parse_qsl
+
+            from .. import obs
+
+            body = obs.chrome_trace_dump(
+                dict(parse_qsl(path.partition("?")[2]))
+            ).encode()
+            return 200, body, "application/json"
         if path.startswith("/metrics/") or path == "/graph":
             conn = self._ensure_conn()
             if path == "/metrics/latest":

@@ -8,10 +8,12 @@ wrappers behind the same UDF interface for drop-in parity.
 
 from __future__ import annotations
 
+import time as _time
 from typing import Any
 
 import numpy as np
 
+from ... import obs
 from ...internals import dtype as dt
 from ...internals.expression import ApplyExpression, ColumnExpression, wrap
 from ...internals.udfs import CacheStrategy, with_cache_strategy
@@ -30,14 +32,31 @@ class BaseEmbedder:
     def get_embedding_dimension(self, **kwargs) -> int:
         return int(np.asarray(self._embed("dimension probe")).shape[0])
 
+    def _embed_traced(self, text):
+        t0 = _time.perf_counter()
+        out = self._embed(text)
+        obs.record_span("rag.embed", t0, _time.perf_counter(), n=1,
+                        embedder=type(self).__name__)
+        return out
+
+    def _embed_many_traced(self, texts):
+        t0 = _time.perf_counter()
+        out = self._embed_many(texts)
+        obs.record_span("rag.embed", t0, _time.perf_counter(),
+                        n=len(texts), embedder=type(self).__name__)
+        return out
+
     def __call__(self, text, **kwargs):
         if isinstance(text, ColumnExpression):
             return ApplyExpression(
-                self._embed, dt.ANY_ARRAY, (text,), {},
+                self._embed_traced, dt.ANY_ARRAY, (text,), {},
                 propagate_none=True,
-                batch_fn=self._embed_many,  # one device dispatch per micro-batch
+                # one device dispatch per micro-batch; the traced wrapper
+                # dispatches through self._embed_many, so subclass (and
+                # cache-strategy) overrides stay in effect
+                batch_fn=self._embed_many_traced,
             )
-        return self._embed(text)
+        return self._embed_traced(text)
 
 
 class SentenceTransformerEmbedder(BaseEmbedder):

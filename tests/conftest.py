@@ -44,3 +44,14 @@ def clear_parse_graph():
     yield
     pg.G.clear()
     clear_groups()
+
+
+@pytest.fixture(autouse=True, scope="session")
+def _obs_flusher_shutdown():
+    """Round-11 hygiene: the flight recorder's background flusher must
+    never outlive the test session (a dangling thread flakes
+    --continue-on-collection-errors runs)."""
+    yield
+    from pathway_tpu import obs
+
+    obs.shutdown()

@@ -1,0 +1,674 @@
+"""Round-11 observability — ISSUE 6 acceptance.
+
+Pins the tentpole guarantees of the request-scoped tracer + always-on
+flight recorder (pathway_tpu/obs):
+
+- span-tree parent/child correctness within and ACROSS threads;
+- the ring-buffer bound holds under 100k events;
+- Chrome-trace dumps are valid JSON with monotonic `ts`, loadable in
+  Perfetto, served from ``/debug/trace``;
+- an ``X-Pathway-Trace`` header propagates END TO END through
+  ``rest_connector`` (echoed in the response, spans recorded under it);
+- a chained-decode request produces a span tree covering admission ->
+  queue -> prefill chunks -> chain dispatch/sync -> delivery;
+- dump-on-engine-failure fires;
+- the recorder is cheap enough to leave ON: per-event record cost times
+  the events a chained run records stays <= 2% of that run's wall
+  (noise-immune form of the bench's trace_overhead_frac);
+- the zero-recompile guard still passes with tracing enabled;
+- the fabric's mark-barrier wait is attributed PER PEER;
+- the background flusher shuts down cleanly (no dangling threads).
+"""
+
+import json
+import threading
+import time
+import urllib.request
+from collections import defaultdict
+
+import jax
+import numpy as np
+import pytest
+
+from pathway_tpu import obs
+from pathway_tpu.kvcache import PagedDecodeEngine
+from pathway_tpu.models.decoder import DecoderConfig, init_decoder_params
+
+_CFG = DecoderConfig(
+    vocab_size=64, d_model=64, n_layers=2, n_heads=8, d_ff=128, max_len=128
+)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_decoder_params(_CFG, jax.random.PRNGKey(0))
+
+
+@pytest.fixture(autouse=True)
+def _clean_recorder():
+    rec = obs.recorder()
+    rec.clear()
+    rec.enabled = True
+    rec.failure_dumps = 0
+    yield
+    # tier-1 hygiene: no dangling flusher thread may outlive a test
+    obs.shutdown()
+    rec.clear()
+    rec.enabled = True
+
+
+def _engine(params, name, **kw):
+    kw.setdefault("num_blocks", 96)
+    kw.setdefault("block_size", 4)
+    kw.setdefault("max_batch_size", 4)
+    kw.setdefault("seq_buckets", (16, 32, 64))
+    kw.setdefault("prefill_chunk", 8)
+    kw.setdefault("chain_steps", 8)
+    return PagedDecodeEngine(_CFG, params, name=name, **kw)
+
+
+# -- span model -----------------------------------------------------------
+
+
+def test_span_tree_same_thread_nesting():
+    with obs.span("root", kind="t") as root:
+        with obs.span("child") as child:
+            with obs.span("grandchild") as gc:
+                pass
+    assert child.parent_id == root.span_id
+    assert gc.parent_id == child.span_id
+    assert child.trace_id == root.trace_id == gc.trace_id
+    # all three landed in the recorder, finished
+    names = [s.name for s in obs.recorder().snapshot()]
+    assert names == ["grandchild", "child", "root"]  # finish order
+
+
+def test_span_tree_parent_child_across_threads():
+    with obs.span("root") as root:
+        ctx = root.ctx
+    results = {}
+
+    def worker(n):
+        # a worker thread adopts the captured context explicitly
+        with obs.use_context(ctx):
+            with obs.span(f"w{n}") as s:
+                time.sleep(0.01)
+            results[n] = s
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(3)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len(results) == 3
+    for s in results.values():
+        assert s.trace_id == root.trace_id
+        assert s.parent_id == root.span_id
+        assert s.tid != root.tid  # really recorded from another thread
+    # the submitting thread's ambient context is untouched
+    assert obs.current_context() is None
+
+
+def test_explicit_ctx_and_retroactive_record():
+    ctx = (obs.new_trace_id(), 0)
+    s = obs.record_span("retro", 1.0, 2.5, ctx=ctx, n=7)
+    assert s.trace_id == ctx[0] and s.parent_id == 0
+    assert s.t0 == 1.0 and s.t1 == 2.5
+    assert s.attrs == {"n": 7}
+    assert obs.recorder().spans_for_trace(ctx[0]) == [s]
+
+
+def test_disabled_context_suppresses_recording():
+    rec = obs.recorder()
+    with obs.disabled():
+        obs.event("invisible")
+    assert len(rec) == 0
+    obs.event("visible")
+    assert [s.name for s in rec.snapshot()] == ["visible"]
+
+
+def test_trace_header_sanitization():
+    assert obs.sanitize_trace_id("abc-123_X") == "abc-123_X"
+    assert obs.sanitize_trace_id("x" * 65) is None
+    assert obs.sanitize_trace_id("bad\r\nheader") is None
+    assert obs.sanitize_trace_id("") is None
+    assert obs.sanitize_trace_id(None) is None
+    assert obs.context_from_trace_header("t1") == ("t1", 0)
+    assert obs.context_from_trace_header("no spaces!") is None
+
+
+# -- ring buffer + dumps --------------------------------------------------
+
+
+def test_ring_buffer_bound_holds_under_100k_events():
+    rec = obs.recorder()
+    ctx = (obs.new_trace_id(), 0)
+    for _ in range(100_000):
+        obs.record_span("e", 0.0, 0.0, ctx=ctx)
+    assert len(rec) == rec.capacity  # bounded — oldest evicted
+    assert rec.n_recorded >= 100_000
+    # the ring is still fully functional after saturation
+    obs.record_span("after", 0.0, 0.0, ctx=ctx)
+    assert rec.snapshot()[-1].name == "after"
+    assert len(rec) == rec.capacity
+
+
+def test_chrome_trace_dump_valid_json_monotonic_ts():
+    with obs.span("outer"):
+        with obs.span("inner"):
+            pass
+    obs.event("instant")
+    dump = json.loads(obs.recorder().chrome_trace_json())
+    events = dump["traceEvents"]
+    assert events[0]["name"] == "clock_sync"
+    xs = [e for e in events if e["ph"] == "X"]
+    assert {e["name"] for e in xs} == {"outer", "inner", "instant"}
+    ts = [e["ts"] for e in xs]
+    assert ts == sorted(ts)  # monotonic on the perf_counter timeline
+    for e in xs:
+        assert e["dur"] >= 0
+        assert isinstance(e["pid"], int) and isinstance(e["tid"], int)
+        assert "trace" in e["args"] and "span" in e["args"]
+    # parent links survive into args (Perfetto flow reconstruction)
+    inner = next(e for e in xs if e["name"] == "inner")
+    outer = next(e for e in xs if e["name"] == "outer")
+    assert inner["args"]["parent"] == outer["args"]["span"]
+
+
+def test_debug_trace_endpoint_webserver_and_metrics_server():
+    from pathway_tpu.io.http import PathwayWebserver
+
+    with obs.span("visible_span"):
+        pass
+    ws = PathwayWebserver("127.0.0.1", 0)
+    raw = ws._trace_handler({}, {"params": {}})
+    dump = json.loads(raw.text)
+    assert raw.ctype == "application/json"
+    assert any(e["name"] == "visible_span" for e in dump["traceEvents"])
+    # ?trace= filters to one request's tree
+    tid = next(
+        e["args"]["trace"] for e in dump["traceEvents"]
+        if e["name"] == "visible_span"
+    )
+    filtered = json.loads(
+        ws._trace_handler({}, {"params": {"trace": tid}}).text
+    )
+    assert all(
+        e["args"].get("trace") == tid
+        for e in filtered["traceEvents"] if e["ph"] == "X"
+    )
+
+    # the MetricsServer serves the same dump at /debug/trace
+    import socket
+
+    from pathway_tpu.engine.telemetry import MetricsServer
+
+    class _Sched:
+        frontier = 0
+        operators = ()
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    srv = MetricsServer(_Sched(), port=port)
+    srv.start()
+    try:
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/debug/trace", timeout=10
+        ).read()
+        dump2 = json.loads(body)
+        assert any(
+            e["name"] == "visible_span" for e in dump2["traceEvents"]
+        )
+    finally:
+        srv.stop()
+
+
+# -- serving-path integration --------------------------------------------
+
+
+def test_scheduler_queue_and_batch_spans():
+    from pathway_tpu.serve.scheduler import RequestScheduler
+
+    sched = RequestScheduler(
+        lambda xs: [x * 2 for x in xs], name="t_obs_sched",
+        batch_linger_ms=1.0,
+    )
+    try:
+        assert sched.submit(21) == 42
+    finally:
+        sched.shutdown()
+    spans = obs.recorder().snapshot()
+    root = next(s for s in spans if s.name == "serve.request")
+    assert root.attrs["outcome"] == "done"
+    by_name = {s.name: s for s in spans if s.trace_id == root.trace_id}
+    q = by_name["serve.queue"]
+    assert q.parent_id == root.span_id
+    assert q.attrs["outcome"] == "dispatched"
+    ex = by_name["serve.execute"]
+    assert ex.parent_id == root.span_id
+    # batch-formation span on the scheduler's own trace
+    batch = next(s for s in spans if s.name == "serve.batch")
+    assert batch.attrs["scheduler"] == "t_obs_sched"
+    assert batch.attrs["n"] == 1
+
+
+def test_chained_request_span_tree_admission_to_delivery(params):
+    """ISSUE 6 acceptance: a chained-decode request produces a span tree
+    covering admission -> queue -> prefill chunks -> chain dispatch/sync
+    -> delivery, dumpable as Perfetto-loadable Chrome trace JSON."""
+    eng = _engine(params, "t_obs_tree")
+    obs.recorder().clear()
+    out = eng.generate_batch([([1, 2, 3, 4, 5], 12), ([7, 8, 9], 12)])
+    assert all(len(o) == 12 for o in out)
+    spans = obs.recorder().snapshot()
+    reqs = [s for s in spans if s.name == "engine.request"]
+    assert len(reqs) == 2
+    for root in reqs:
+        assert root.attrs["outcome"] == "done"  # delivery closed the root
+        assert root.attrs["emitted"] == 12
+        kids = {
+            s.name for s in spans
+            if s.trace_id == root.trace_id and s.parent_id == root.span_id
+        }
+        # admission, chunked prefill, and the chain windows it rode
+        assert {"engine.admission", "engine.prefill_chunk",
+                "engine.chain"} <= kids
+    # the engine-run trace carries the device-busy/host-gap/sync split
+    run = next(s for s in spans if s.name == "engine.run")
+    run_names = {
+        s.name for s in spans if s.trace_id == run.trace_id
+    }
+    assert "engine.device.chain" in run_names  # chain dispatch->sync
+    assert "engine.sync" in run_names          # the [B, K] ids collect
+    assert "engine.host_gap" in run_names      # host-on-critical-path
+    # two requests, distinct traces
+    assert len({r.trace_id for r in reqs}) == 2
+    # and the whole thing dumps as valid Chrome trace JSON
+    dump = json.loads(obs.recorder().chrome_trace_json(reqs[0].trace_id))
+    names = {e["name"] for e in dump["traceEvents"] if e["ph"] == "X"}
+    assert {"engine.request", "engine.admission", "engine.chain"} <= names
+
+
+def test_poll_arrival_inherits_scheduler_trace(params):
+    """A request admitted mid-run via poll_inflight keeps the trace its
+    scheduler submit() minted (the 5th poll-item element)."""
+    from pathway_tpu.serve.scheduler import RequestScheduler
+
+    eng = _engine(params, "t_obs_poll")
+    sched = RequestScheduler(
+        lambda reqs: eng.serve_batch(reqs, scheduler=sched),
+        name="t_obs_poll_sched", max_batch_size=2, batch_linger_ms=1.0,
+    )
+    try:
+        r1 = sched.submit(([1, 2, 3], 4))
+        assert len(r1) == 4
+    finally:
+        sched.shutdown()
+    spans = obs.recorder().snapshot()
+    root = next(s for s in spans if s.name == "serve.request")
+    same_trace = {s.name for s in spans if s.trace_id == root.trace_id}
+    # the engine's request span joined the scheduler request's trace
+    assert "engine.request" in same_trace
+
+
+def test_dump_on_engine_failure_fires(params, tmp_path, monkeypatch):
+    monkeypatch.setenv("PATHWAY_TRACE_DUMP_DIR", str(tmp_path))
+    eng = _engine(params, "t_obs_fail")
+
+    def boom(*_a, **_k):
+        raise RuntimeError("device exploded")
+
+    eng._step = boom
+    eng._chained = boom
+    eng._mixed = boom
+    with pytest.raises(RuntimeError, match="device exploded"):
+        eng.generate_batch([([1, 2, 3], 4)])
+    rec = obs.recorder()
+    assert rec.failure_dumps == 1
+    assert rec.last_dump_path is not None
+    assert rec.last_dump_path.startswith(str(tmp_path))
+    dump = json.loads(open(rec.last_dump_path).read())
+    assert any(
+        e["name"] == "engine.run" and e["args"].get("error")
+        for e in dump["traceEvents"] if e["ph"] == "X"
+    )
+
+
+# -- overhead + recompile guards ------------------------------------------
+
+
+def test_recorder_overhead_guard_on_chained_microbench(params):
+    """The <=2% budget, measured in a host-noise-immune form: (events a
+    chained run records) x (measured per-event record cost) must stay
+    under 2% of that run's wall.  An A/B of two full runs would swing
+    with the container's 2-3x throughput noise; the per-event cost and
+    the event COUNT are both stable."""
+    eng = _engine(params, "t_obs_overhead")
+    reqs = [([1 + i, 2, 3, 4], 12) for i in range(4)]
+    eng.generate_batch(list(reqs))  # compile + warm every shape
+    rec = obs.recorder()
+    rec.clear()
+    n0 = rec.n_recorded
+    t0 = time.perf_counter()
+    eng.generate_batch(list(reqs))
+    wall = time.perf_counter() - t0
+    n_events = rec.n_recorded - n0
+    assert n_events > 0
+    ctx = (obs.new_trace_id(), 0)
+    reps = 20_000
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        obs.record_span("overhead_probe", 0.0, 1.0, ctx=ctx)
+    per_event = (time.perf_counter() - t0) / reps
+    overhead_frac = per_event * n_events / wall
+    assert overhead_frac <= 0.02, (
+        f"recorder overhead {overhead_frac:.4f} > 2% "
+        f"({n_events} events x {per_event * 1e6:.2f}us / {wall:.3f}s wall)"
+    )
+
+
+def test_zero_recompile_with_tracing_enabled(params):
+    """Round-8/10 contract unchanged by Round-11: the traced engine still
+    compiles each program once — a second pass over the same chained
+    workload triggers zero new XLA compilations."""
+    import logging
+
+    assert obs.recorder().enabled  # tracing really on
+    eng = _engine(params, "t_obs_compile")
+    reqs = [(p, 9) for p in ([3, 1, 4, 1, 5], [9, 2, 6])]
+
+    class _Capture(logging.Handler):
+        def __init__(self):
+            super().__init__()
+            self.compiles = []
+
+        def emit(self, record):
+            msg = record.getMessage()
+            if msg.startswith("Compiling "):
+                self.compiles.append(msg)
+
+    jax_logger = logging.getLogger("jax")
+    old_level = jax_logger.level
+
+    def _run_captured():
+        handler = _Capture()
+        jax_logger.addHandler(handler)
+        jax_logger.setLevel(logging.WARNING)
+        try:
+            with jax.log_compiles(True):
+                eng.generate_batch(list(reqs))
+        finally:
+            jax_logger.removeHandler(handler)
+            jax_logger.setLevel(old_level)
+        return handler.compiles
+
+    first = _run_captured()
+    assert first, "capture mechanism saw no compiles on the cold pass"
+    second = _run_captured()
+    assert second == [], (
+        f"second pass recompiled {len(second)} programs: {second[:4]}"
+    )
+
+
+# -- data plane -----------------------------------------------------------
+
+
+def test_fabric_wait_marks_attributed_per_peer():
+    """wait_marks records per-peer elapsed: the peer that arrives late is
+    the one whose wait_marks_s_p<pid> grows (ROADMAP item 1's straggler
+    diagnosis).  Unit-level — no sockets, the container's loopback is
+    unreliable (see tests/test_cluster.py's seed failures)."""
+    from pathway_tpu.parallel.comm import Fabric
+
+    f = Fabric.__new__(Fabric)
+    f.pid = 0
+    f.peers = [1, 2]
+    f._cond = threading.Condition()
+    f._marks = defaultdict(dict)
+    f._dead = None
+    f.stats = {"wait_marks_s": 0.0, "wait_marks_s_p1": 0.0,
+               "wait_marks_s_p2": 0.0}
+    f._obs_ctx = (obs.new_trace_id(), 0)
+    f._marks[1][5] = 3  # peer 1 already marked before the wait starts
+
+    def late_mark():
+        time.sleep(0.06)
+        with f._cond:
+            f._marks[2][5] = 3
+            f._cond.notify_all()
+
+    th = threading.Thread(target=late_mark)
+    th.start()
+    f.wait_marks(5, 3, timeout_s=5.0)
+    th.join()
+    assert f.stats["wait_marks_s_p1"] < 0.05   # was never waited on
+    assert f.stats["wait_marks_s_p2"] >= 0.05  # the straggler
+    assert f.stats["wait_marks_s"] >= f.stats["wait_marks_s_p2"]
+    # the barrier landed as a flight-recorder span too
+    names = [s.name for s in obs.recorder().snapshot()]
+    assert "fabric.wait_marks" in names
+
+
+def test_fabric_stats_render_as_pathway_fabric_buckets():
+    """The new per-peer/compute keys flow into the /metrics
+    pathway_fabric{stat=...} family without special-casing."""
+    from pathway_tpu.engine.telemetry import MetricsServer
+
+    class _Sched:
+        frontier = 3
+        operators = ()
+
+    class _Fab:
+        stats = {"wait_marks_s": 1.5, "wait_marks_s_p1": 1.2,
+                 "compute_s": 0.3, "agree_min_s": 0.8}
+
+    srv = MetricsServer(_Sched(), port=0)
+    srv.fabric = _Fab()
+    text = srv.render()
+    assert 'pathway_fabric{stat="wait_marks_s_p1"} 1.200000' in text
+    assert 'pathway_fabric{stat="compute_s"} 0.300000' in text
+    assert 'pathway_fabric{stat="agree_min_s"} 0.800000' in text
+
+
+# -- RAG query path -------------------------------------------------------
+
+
+def test_hybrid_index_probe_and_fuse_spans():
+    from pathway_tpu.stdlib.indexing.inner_index import (
+        BruteForceKnn, HybridIndex,
+    )
+
+    rng = np.random.default_rng(0)
+    a = BruteForceKnn(4, reserved_space=8)
+    b = BruteForceKnn(4, reserved_space=8)
+    hyb = HybridIndex([a, b])
+    for i in range(6):
+        v = rng.normal(size=4).astype(np.float32)
+        hyb.add(i, (v, v))
+    q = rng.normal(size=4).astype(np.float32)
+    out = hyb.search((q, q), 3)
+    assert len(out) == 3
+    spans = obs.recorder().snapshot()
+    probes = [s for s in spans if s.name == "index.probe"]
+    assert len(probes) == 2
+    assert {p.attrs["kind"] for p in probes} == {"BruteForceKnn"}
+    fuse = [s for s in spans if s.name == "index.fuse"]
+    assert len(fuse) == 1 and fuse[0].attrs["k"] == 3
+
+
+def test_embedder_records_rag_embed_spans():
+    from pathway_tpu.xpacks.llm.embedders import BaseEmbedder
+
+    class _E(BaseEmbedder):
+        def _embed(self, text):
+            return np.ones(3, np.float32)
+
+    e = _E()
+    e("hello")
+    e._embed_many_traced(["a", "b"])
+    spans = [s for s in obs.recorder().snapshot() if s.name == "rag.embed"]
+    assert [s.attrs["n"] for s in spans] == [1, 2]
+    assert spans[0].attrs["embedder"] == "_E"
+
+
+# -- flusher hygiene ------------------------------------------------------
+
+
+def test_flusher_starts_flushes_and_shuts_down_cleanly():
+    fl = obs.start_flusher(interval_s=0.05)
+    assert fl.is_alive()
+    obs.event("to_flush")
+    time.sleep(0.12)  # at least one flush tick
+    obs.shutdown()
+    assert not fl.is_alive()
+    assert not [
+        t for t in threading.enumerate() if t.name == "pw-obs-flusher"
+    ]
+    # idempotent; a second shutdown is a no-op
+    obs.shutdown()
+    # restartable after shutdown
+    fl2 = obs.start_flusher(interval_s=0.05)
+    assert fl2.is_alive() and fl2 is not fl
+    obs.shutdown()
+    assert not fl2.is_alive()
+
+
+def test_flusher_exports_late_finishing_roots():
+    """A long-lived root span (opened before thousands of children
+    finished and a flush ran) must still be exported when IT finishes —
+    the cursor counts recorded spans, not span ids."""
+    fl = obs.start_flusher(interval_s=3600)  # manual flush_once only
+    try:
+        root = obs.start_span("long_root")  # low span id, finishes last
+        ctx = root.ctx
+        for _ in range(50):
+            obs.record_span("child", 0.0, 0.0, ctx=ctx)
+        assert fl.flush_once() == 50  # children flushed first
+        root.finish()
+        exported = []
+        orig = obs.recorder().snapshot
+
+        # capture what the second flush selects
+        n_before = obs.recorder().n_recorded
+        ring = orig()
+        fresh = n_before - fl._cursor
+        exported = ring[-fresh:] if fresh < len(ring) else ring
+        assert [s.name for s in exported] == ["long_root"]
+        assert fl.flush_once() == 1
+    finally:
+        obs.shutdown()
+
+
+def test_otlp_span_export_payload():
+    """export_otlp posts OTLP/HTTP JSON with real trace/span ids."""
+    import http.server
+    import socketserver
+
+    got = {}
+
+    class H(http.server.BaseHTTPRequestHandler):
+        def do_POST(self):
+            n = int(self.headers.get("Content-Length") or 0)
+            got["path"] = self.path
+            got["body"] = json.loads(self.rfile.read(n))
+            self.send_response(200)
+            self.send_header("Content-Length", "2")
+            self.end_headers()
+            self.wfile.write(b"{}")
+
+        def log_message(self, *a):
+            pass
+
+    with socketserver.TCPServer(("127.0.0.1", 0), H) as srv:
+        port = srv.server_address[1]
+        th = threading.Thread(target=srv.handle_request, daemon=True)
+        th.start()
+        with obs.span("exported", x=1):
+            pass
+        obs.export_otlp(
+            f"http://127.0.0.1:{port}", obs.recorder().snapshot()
+        )
+        th.join(timeout=5)
+    assert got["path"] == "/v1/traces"
+    spans = got["body"]["resourceSpans"][0]["scopeSpans"][0]["spans"]
+    exported = next(s for s in spans if s["name"] == "exported")
+    assert len(exported["traceId"]) == 32
+    assert len(exported["spanId"]) == 16
+    assert int(exported["endTimeUnixNano"]) >= int(
+        exported["startTimeUnixNano"]
+    )
+
+
+# -- X-Pathway-Trace end-to-end through rest_connector --------------------
+
+
+def _free_port() -> int:
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_trace_header_propagates_e2e_through_rest_connector():
+    import pathway_tpu as pw
+
+    port = _free_port()
+    queries, writer = pw.io.http.rest_connector(
+        host="127.0.0.1", port=port, route="/ask",
+        schema=pw.schema_from_types(query=str), methods=["POST"],
+    )
+    writer(queries.select(result=queries.query.str.upper()))
+    out = {}
+
+    def client():
+        time.sleep(0.8)
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/ask",
+            json.dumps({"query": "abc"}).encode(),
+            headers={"Content-Type": "application/json",
+                     "X-Pathway-Trace": "e2e-trace-41"},
+        )
+        resp = urllib.request.urlopen(req, timeout=10)
+        out["answer"] = json.loads(resp.read())
+        out["echo"] = resp.headers.get("X-Pathway-Trace")
+        # a request WITHOUT the header gets a freshly minted id echoed
+        req2 = urllib.request.Request(
+            f"http://127.0.0.1:{port}/ask",
+            json.dumps({"query": "xy"}).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        out["minted"] = urllib.request.urlopen(req2, timeout=10) \
+            .headers.get("X-Pathway-Trace")
+        # the flight recorder is queryable over HTTP while serving
+        out["dump"] = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/debug/trace?trace=e2e-trace-41",
+            timeout=10,
+        ).read())
+
+    th = threading.Thread(target=client, daemon=True)
+    th.start()
+    pw.run(timeout_s=8.0, autocommit_duration_ms=20)
+    th.join(timeout=1)
+    assert out["answer"] == "ABC"
+    assert out["echo"] == "e2e-trace-41"  # the header IS the trace id
+    assert out["minted"] and out["minted"] != "e2e-trace-41"
+    # the caller's trace id groups the whole server-side span tree
+    spans = obs.recorder().spans_for_trace("e2e-trace-41")
+    names = {s.name for s in spans}
+    assert {"http.request", "rest.handle", "rest.engine_wait"} <= names
+    http_span = next(s for s in spans if s.name == "http.request")
+    handle = next(s for s in spans if s.name == "rest.handle")
+    wait = next(s for s in spans if s.name == "rest.engine_wait")
+    assert handle.parent_id == http_span.span_id
+    assert wait.parent_id == handle.span_id
+    assert http_span.attrs["status"] == 200
+    # and the HTTP dump endpoint returned exactly that tree
+    dump_names = {
+        e["name"] for e in out["dump"]["traceEvents"] if e["ph"] == "X"
+    }
+    assert {"http.request", "rest.handle", "rest.engine_wait"} <= dump_names
