@@ -699,17 +699,23 @@ class GroupbyOperator(Operator):
                 if amax * max(total_abs_diff, 1) >= 2**62:
                     return False
             val_arrs.append(v)
+        # per-shard reduce_sum building block (round-12): the scatter-add
+        # segment sums route through parallel/mapreduce.py, which picks
+        # the exact numpy kernel or a jitted device segment_sum program
+        # for device-native dtypes at size (DrJAX-style map/reduce —
+        # exactness-sensitive int64/float64 columns always stay on numpy)
+        from ..parallel import mapreduce
+
         G = len(uniq)
-        total = np.zeros(G, np.int64)
-        np.add.at(total, codes, diffs)
+        total = mapreduce.segment_sum(diffs, codes, G)
         red_results: list = []
         for spec, v in zip(red_plan, val_arrs):
             if spec[0] == "count":
                 red_results.append(None)
             elif spec[0] in ("sum", "avg"):
-                acc = np.zeros(G, v.dtype)
-                np.add.at(acc, codes, v * diffs)
-                red_results.append(acc)
+                red_results.append(
+                    mapreduce.segment_sum(v, codes, G, weights=diffs)
+                )
             else:  # min/max: net (code, value) multiset deltas
                 order = np.lexsort((v, codes))
                 c_s, v_s, d_s = codes[order], v[order], diffs[order]

@@ -127,6 +127,31 @@ class MetricsServer:
                 "<th>chain occ</th><th>host gap ms</th></tr>"
                 f"{kv_rows}</table>"
             )
+        fabric_html = ""
+        fab = getattr(self, "fabric", None)
+        if fab is not None:
+            st = dict(fab.stats)
+            wait_rows = "".join(
+                f"<tr><td>{k}</td><td>{st[k]:.3f}s</td></tr>"
+                for k in ("compute_s", "wait_marks_s", "agree_min_s",
+                          "wait_ctl_s", "wait_sync_s", "send_s", "sender_s")
+                if k in st
+            )
+            fabric_html = (
+                "<h3>exchange fabric</h3><table><tr>"
+                "<th>sender queue</th><th>peak</th><th>flushes</th>"
+                "<th>coalesced</th><th>data out</th><th>bytes out</th>"
+                "</tr><tr>"
+                f"<td>{st.get('sender_queue_depth', 0)}</td>"
+                f"<td>{st.get('sender_queue_peak', 0)}</td>"
+                f"<td>{st.get('sender_flushes', 0)}</td>"
+                f"<td>{st.get('sender_coalesced', 0)}</td>"
+                f"<td>{st.get('data_msgs_out', 0)}</td>"
+                f"<td>{st.get('send_bytes', 0)}</td>"
+                "</tr></table>"
+                f"<table><tr><th>time split</th><th>s</th></tr>{wait_rows}"
+                "</table>"
+            )
         trace_html = ""
         try:
             from .. import obs as _obs
@@ -157,7 +182,7 @@ class MetricsServer:
             f"&middot; uptime={time.time() - self.started_at:.0f}s</h2>"
             "<table><tr><th>operator</th><th>id</th><th>rows in</th>"
             f"<th>rows out</th></tr>{rows}</table>"
-            f"{serve_html}{kv_html}{trace_html}"
+            f"{serve_html}{kv_html}{fabric_html}{trace_html}"
             '<p><a href="/metrics">/metrics</a> &middot; '
             '<a href="/debug/trace">/debug/trace</a></p></body></html>'
         )
@@ -487,9 +512,11 @@ def otlp_export_spans(endpoint: str, spans: list["Span"]) -> None:
     )
 
 
-def otlp_export_metrics(endpoint: str, scheduler) -> None:
+def otlp_export_metrics(endpoint: str, scheduler, fabric=None) -> None:
     """Push per-operator row counters as OTLP sums (the /metrics content in
-    push form)."""
+    push form).  With a fabric attached, the exchange counters — including
+    the round-12 sender-queue depth/flush/coalesce stats — ride along as
+    `pathway.fabric` points labeled by stat name."""
     now = str(int(time.time() * 1e9))
     points = []
     for op in scheduler.operators:
@@ -524,6 +551,28 @@ def otlp_export_metrics(endpoint: str, scheduler) -> None:
                 "aggregationTemporality": 2,  # CUMULATIVE
                 "isMonotonic": True,
                 "dataPoints": serve_points,
+            },
+        })
+    if fabric is not None:
+        fabric_points = []
+        for k, v in dict(fabric.stats).items():
+            point = {
+                "timeUnixNano": now,
+                "attributes": [
+                    {"key": "stat", "value": {"stringValue": k}},
+                ],
+            }
+            if isinstance(v, float):
+                point["asDouble"] = v
+            else:
+                point["asInt"] = str(v)
+            fabric_points.append(point)
+        metrics.append({
+            "name": "pathway.fabric",
+            "sum": {
+                "aggregationTemporality": 2,  # CUMULATIVE
+                "isMonotonic": False,  # queue depth is a gauge-like stat
+                "dataPoints": fabric_points,
             },
         })
     _post_json(

@@ -189,7 +189,9 @@ def run(
             from ..engine.telemetry import otlp_export_metrics
 
             try:
-                otlp_export_metrics(_mon, scheduler)
+                otlp_export_metrics(
+                    _mon, scheduler, fabric=getattr(runner, "fabric", None)
+                )
             except Exception:
                 import logging
 

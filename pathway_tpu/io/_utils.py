@@ -24,6 +24,19 @@ from ..internals.value import Json, ref_scalar
 from ..engine.types import unwrap_row
 
 
+def partition_owner(name: str, nprocs: int) -> int:
+    """Stable owner of a scanned object in an N-process cluster.  Must be
+    agreed without communication (each process filters its own listing)
+    and survive file additions, so it hashes the NAME — with a real
+    mixing hash: crc32 is linear, and names differing in one digit
+    (part0.txt..part3.txt) all landed on one process, serializing the
+    whole ingest on a single worker (round-12)."""
+    import hashlib
+
+    digest = hashlib.blake2b(name.encode(), digest_size=8).digest()
+    return int.from_bytes(digest, "little") % nprocs
+
+
 def coerce_value(v: Any, d: dt.DType):
     if v is None:
         return None
@@ -222,13 +235,9 @@ class FilePollingSource(DataSource):
         else:
             out = sorted(glob.glob(self.path))
         if self._partition is not None:
-            import zlib
-
             pid, n = self._partition
-            out = [
-                f for f in out
-                if zlib.crc32(os.path.basename(f).encode()) % n == pid
-            ]
+            out = [f for f in out
+                   if partition_owner(os.path.basename(f), n) == pid]
         return out
 
     def _cache_put(self, f: str, mtime: float, payload: bytes) -> None:

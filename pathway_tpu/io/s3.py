@@ -13,7 +13,6 @@ import csv as _csv
 import io as _io
 import json
 import time
-import zlib
 from typing import Any
 
 from ..internals.schema import SchemaMetaclass
@@ -147,10 +146,12 @@ class S3ScannerSource(DataSource):
         client = self._ensure_client()
         entries = list_objects_paginated(client, self.bucket, self.prefix)
         if self._partition is not None:
+            from ._utils import partition_owner
+
             pid, n = self._partition
             entries = [
                 (k, e) for k, e in entries
-                if zlib.crc32(k.encode()) % n == pid
+                if partition_owner(k, n) == pid
             ]
         return entries
 

@@ -10,15 +10,32 @@ frontier gossip.
 One `ClusterRunner` per OS process owns `threads` contiguous shards and
 walks (time, topo-position, shard) in the same deterministic order on every
 process.  Exchange edges (groupby/join re-key, centralized ops) are "wait
-positions": before processing one, a process sends a mark ("I finished every
-earlier position at this time; my data for you is on the wire") and waits
-for all peers' marks — per-connection FIFO turns the mark into a data
-barrier.  The coordinator (process 0) agrees the next time via an
-allreduce-min over pending times; the min round carries each process's
-in-flight send counts/target-times, closing the cross-time race that a
-separate per-time eot barrier used to close with one extra rendezvous
-per time (round-10).  Output/capture operators are centralized
-on shard 0 (process 0), so sink effects happen exactly once.
+positions": before processing one, a process posts a COUNTED mark ("I
+finished every earlier position at this time; here is how many data
+frames I stamped for you at every earlier exchange point") and
+count-proves all peers' exchange points instead of treating the mark as
+a FIFO barrier (round-12).  Marks ride the fabric's control lane while
+bulk data frames are pickled+written on per-peer sender threads, so a
+peer's serialization never extends this process's mark wait, and a quiet
+exchange point costs one tiny control frame.  The coordinator (process
+0) agrees the next time via an allreduce-min over pending times; each
+process folds the target times of its not-yet-walked sends into its
+report (the sender "vouches" a frame until it has itself processed the
+target time, whose counted mark points then prove delivery everywhere)
+— the round is split into an async `begin` posted at the tail of each
+processed time and a `finish` that blocks only when the next time is
+actually needed, so the round for time t+1 rides under the slowest
+peer's compute for time t.  Output/capture operators are centralized on
+shard 0 (process 0), so sink effects happen exactly once.
+
+Cross-process traffic is aggregates-first (round-12): exchange edges
+into key-insensitive groupbys (plain-column groupings with
+count/sum/avg/min/max reducers) consolidate the outgoing batch by row
+value — the multiset of (row, diff) is preserved exactly, so results are
+bit-identical — and partitioned live sources keep their polled rows on
+the polling process's own shards (keys are content-derived, and the next
+key/group-routed exchange re-partitions anyway), eliminating the raw-row
+input shuffle entirely.
 
 With n_processes == 1 there is no fabric and the same walk degrades to the
 sequential sharded execution (bit-identical to round 1's ShardedGraphRunner,
@@ -40,6 +57,7 @@ from ..engine.types import CapturedStream, Update
 from ..internals import parse_graph as pg
 from .sharded import ShardRouter, edge_router, _BROADCAST, _CENTRAL, _SHARD_BY_KEY
 from .comm import Fabric
+from . import mapreduce
 
 # node kinds whose output keys equal their input keys, so key-routed
 # downstream edges never move rows between shards
@@ -106,7 +124,29 @@ class ClusterRunner:
         for pos, op in enumerate(base_topo):
             if op.id in base_inputs:
                 self.input_pos[pos] = base_inputs[op.id]
+        # inputs whose live source is partitioned across processes keep
+        # their polled rows on the polling process's own shards (round-12:
+        # keys are content-derived, and the next key/group-routed exchange
+        # re-partitions anyway) — which also means their output is NOT
+        # key-partitioned, so downstream key-routed edges must exchange
+        self._local_keep_inputs: set[int] = set()
+        if nprocs > 1:
+            for idx, (_op, source) in enumerate(base.input_ops):
+                if source.is_live() and hasattr(source, "set_partition"):
+                    self._local_keep_inputs.add(idx)
         self.wait_positions = self._compute_wait_positions()
+        # exchange combiner specs (round-12): edges into key-insensitive
+        # groupbys consolidate outgoing batches by row value, so only
+        # aggregates cross the fabric (parallel/mapreduce.py)
+        self._combine_specs: dict[tuple[int, int], tuple] = {}
+        if nprocs > 1:
+            base_ops = self.topo[self.owned[0]]
+            for pos, node in self.nodes.items():
+                if node.kind != "groupby":
+                    continue
+                spec = mapreduce.exchange_combine_spec(base_ops[pos])
+                if spec is not None:
+                    self._combine_specs[(pos, 0)] = spec
         # execution state
         # pending[time][(pos, shard)] = [(producer, seq, port, updates)]
         self.pending: dict[int, dict[tuple[int, int], list]] = defaultdict(
@@ -122,6 +162,8 @@ class ClusterRunner:
         self.fabric: Fabric | None = None
         if nprocs > 1:
             self.fabric = Fabric(pid, nprocs, first_port)
+        # outstanding pipelined min-agreement round (posted report), if any
+        self._agree_pending: tuple | None = None
         # data-plane trace: per-round spans (run_time / agree_min) for
         # this process land here (Round-11 time attribution)
         self._obs_ctx = (obs.new_trace_id(), 0)
@@ -144,8 +186,12 @@ class ClusterRunner:
             if node is None:
                 continue
             if node.kind == "input":
-                keypart[node.id] = True
-                # partitioned live sources route their reads across processes
+                # a local-keep input (partitioned live source, round-12)
+                # places rows on the POLLING process's shards, so its
+                # output is not key-partitioned and downstream key-routed
+                # edges must exchange; every other input injects by key
+                idx = self.input_pos.get(pos)
+                keypart[node.id] = idx not in self._local_keep_inputs
                 wait.add(pos)
                 continue
             ups = [t._node for t in node.input_tables]
@@ -193,8 +239,21 @@ class ClusterRunner:
                     for s2 in range(self.n_total):
                         self._deliver(time, down_pos, port, s2, updates)
                     continue
+                edge_updates = updates
+                spec = self._combine_specs.get((down_pos, port))
+                if spec is not None:
+                    # consolidate BEFORE routing (round-12): the edge
+                    # feeds a key-insensitive groupby, so merging equal
+                    # rows first means the per-row group-key hash below
+                    # runs once per DISTINCT row — profiling showed that
+                    # hash, not the wire, was the 2-proc exchange tax
+                    combined = mapreduce.combine_for_exchange(
+                        edge_updates, spec
+                    )
+                    if combined is not None:
+                        edge_updates = combined
                 per_shard: dict[int, list[Update]] = defaultdict(list)
-                for u in updates:
+                for u in edge_updates:
                     per_shard[router.shard_of(u)].append(u)
                 for s2, us in per_shard.items():
                     self._deliver(time, down_pos, port, s2, us)
@@ -211,21 +270,39 @@ class ClusterRunner:
             )
         else:
             assert self.fabric is not None
-            self.fabric.send_data(owner, time, pos, port, shard, self._seq, updates)
+            # NOTE: no consolidation here — combine-eligible edges are
+            # groupby inputs, whose router is always the keyed kind, so
+            # route() consolidated the batch BEFORE the per-shard split
+            # (re-combining the already-distinct slice would be a wasted
+            # O(n) pass on the hot path).
+            # A send stamped at the currently-walked time is covered by
+            # the counted mark this process posts when crossing (time,
+            # pos); anything else (cross-time emission, on_end flush) is
+            # vouched in the min-agreement until this process has walked
+            # the target time (round-12 progress accounting).
+            self.fabric.send_data(owner, time, pos, port, shard, self._seq,
+                                  updates, vouch=(time != self.cur_t))
 
     def _inject(self, input_idx: int, events: list, exclusive: bool,
                 time_override: int | None = None) -> None:
         """Feed source events.  Replicated sources (every process read the
         whole thing, e.g. static files) keep only owned shards.  Exclusive
-        sources (one reader per event: partitioned scans, or live sources
-        pinned to one process) route their slice, shipping non-owned rows to
-        their owners over the fabric."""
+        sources (one reader per event) route their slice: a PARTITIONED
+        source keeps its rows on this process's own shards (round-12 —
+        keys are content-derived, so ownership of a row is independent of
+        which process parsed it, and the next key/group-routed exchange
+        re-partitions anyway: the raw-row input shuffle is pure waste),
+        while a pinned unpartitioned source still ships rows to their
+        key's owner so downstream work spreads across processes."""
         pos = next(p for p, i in self.input_pos.items() if i == input_idx)
+        local_keep = input_idx in self._local_keep_inputs
         per: dict[tuple[int, int], list[Update]] = defaultdict(list)
         for t, key, row, diff in events:
             if time_override is not None:
                 t = time_override
             shard = self.input_router.shard_of((key, row, diff))
+            if local_keep:
+                shard = self.owned[shard % self.threads]
             owner = self.owner_of(shard)
             if owner != self.pid and not exclusive:
                 continue
@@ -257,7 +334,12 @@ class ClusterRunner:
         bucket = self.pending[t]
         for pos in range(self.n_pos):
             if self.fabric is not None and pos in self.wait_positions:
-                self.fabric.send_mark(t, pos)
+                # counted mark (round-12): posted on the control lane with
+                # this process's cumulative per-(peer, t, pos') frame
+                # counts; the wait count-proves every peer's exchange
+                # point instead of blocking on a FIFO mark frame queued
+                # behind bulk data
+                self.fabric.post_mark(t, pos)
                 self.fabric.wait_marks(t, pos)
                 for producer, seq, port, shard, updates in self.fabric.take_data(t, pos):
                     bucket[(pos, shard)].append((producer, seq, port, updates))
@@ -276,12 +358,15 @@ class ClusterRunner:
         self.frontier = max(self.frontier, t)
         self.cur_t = None
         if self.fabric is not None:
-            # the per-time EOT barrier is gone (round-10): sends stamped
-            # during `t` stay visible through the sender's unconfirmed-
-            # send report until a min-agreement round count-confirms
-            # their delivery (_agree_min), so no rendezvous is needed
-            # here.  Only the mark bookkeeping cleanup the barrier used
-            # to do remains.
+            # this process has walked `t` under the agreement, so every
+            # send targeting times <= t is delivery-proven by the counted
+            # mark points of that walk — stop vouching for them
+            self.fabric.confirm_below(t)
+            # pipelined coordinator round (round-12): post the NEXT min
+            # report right here, before bookkeeping and before whatever
+            # host work the caller does next, so the round for time t+1
+            # rides under the slowest peer's remaining compute for t
+            self._begin_agree_min()
             self.fabric.prune_marks(t)
             # round-11 time attribution: this time's wall minus the
             # fabric waits/sends that accrued inside it is the process's
@@ -304,65 +389,68 @@ class ClusterRunner:
         return min(times) if times else None
 
     # -- control plane -----------------------------------------------------
-    def _timed_recv_ctl(self):
-        """recv_ctl with the wait billed to wait_ctl_s — ONLY inside the
-        min-agreement round, where the wait is coordinator-round cost (a
-        streaming worker's idle recv_ctl for the next tick command is
-        scheduling slack and must not pollute the time split)."""
+    def _timed_recv_ctl(self, stat: str = "wait_ctl_s"):
+        """recv_ctl with the wait billed to an explicit stat: wait_ctl_s
+        inside the min-agreement round (coordinator-round cost),
+        wait_sync_s for gather/broadcast rendezvous (tick/shutdown
+        synchronization — kept distinct so the round-12 overlap work
+        cannot hide stalls there; a streaming worker's idle recv_ctl for
+        the next tick command lands in wait_sync_s, visible but separate
+        from the compute/marks/round split)."""
         t0 = _time.perf_counter()
         msg = self.fabric.recv_ctl()
-        self.fabric.stats["wait_ctl_s"] += _time.perf_counter() - t0
+        self.fabric.stats[stat] += _time.perf_counter() - t0
         return msg
 
-    def _agree_min(self, local: int | None) -> int | None:
-        """Allreduce-min over pending times WITH the EOT guarantee folded
-        in (round-10): each report carries the process's cumulative
-        data-frame send counts per destination and includes its
-        unconfirmed sends' minimum target time in the local min, and the
-        coordinator's reply tells every process how many frames to
-        expect from each peer.  Count-waiting on those totals proves (by
-        per-connection FIFO) that every in-flight frame has landed —
-        the guarantee the separate per-time/per-tick EOT BARRIERS used
-        to provide with an extra full rendezvous each."""
-        if self.fabric is None:
-            return local
+    def _begin_agree_min(self) -> None:
+        """Async half of the allreduce-min round (round-12): snapshot this
+        process's minimum pending logical time — local pending buckets,
+        force-ticks, stashed remote data, and the target times of sends
+        it still vouches for (out-of-walk sends whose delivery is proven
+        only once their target time is walked) — and post the report.
+        Non-blocking: the report rides the fabric's sender thread, and
+        the coordinator's gather happens in :meth:`_finish_agree_min`,
+        so the round overlaps whatever compute happens in between."""
+        if self.fabric is None or self._agree_pending is not None:
+            return
+        b0 = _time.perf_counter()
+        local = self._local_min_pending()
+        vmin = self.fabric.vouched_min()
+        if vmin is not None:
+            local = vmin if local is None else min(local, vmin)
+        if self.pid != 0:
+            self.fabric.send_ctl(0, ("min", self.pid, local))
+        self._agree_pending = (local, b0)
+
+    def _finish_agree_min(self) -> int | None:
+        """Blocking half: gather (coordinator) or await (worker) the
+        round posted by :meth:`_begin_agree_min` and return the agreed
+        next time.  Only this half can stall, and only when the next
+        time is actually needed — the report/reply transport already
+        happened under overlapped compute."""
+        assert self._agree_pending is not None, "begin_agree_min not posted"
+        local, b0 = self._agree_pending
+        self._agree_pending = None
         am0 = _time.perf_counter()
-        # cross-time sends only (time > frontier): same-time sends were
-        # delivered under their time's mark barrier, and re-reporting
-        # them would re-agree an already-processed time
-        counts, sent_min = self.fabric.sent_report(above=self.frontier)
-        if sent_min is not None:
-            local = sent_min if local is None else min(local, sent_min)
         if self.pid == 0:
-            reports: dict[int, tuple] = {0: (local, counts)}
+            vals = [] if local is None else [local]
             for _ in range(self.nprocs - 1):
-                tag, pid, m, cnts = self._timed_recv_ctl()
+                tag, _pid, m = self._timed_recv_ctl()
                 assert tag == "min", tag
-                reports[pid] = (m, cnts)
-            vals = [m for m, _c in reports.values() if m is not None]
+                if m is not None:
+                    vals.append(m)
             agreed = min(vals) if vals else None
-            for peer in self.fabric.peers:
-                expected = {
-                    src: cnts.get(peer, 0)
-                    for src, (_m, cnts) in reports.items() if src != peer
-                }
-                self.fabric.send_ctl(peer, ("adv", agreed, expected))
-            my_expected = {
-                src: cnts.get(0, 0)
-                for src, (_m, cnts) in reports.items() if src != 0
-            }
+            self.fabric.broadcast_ctl(("adv", agreed))
         else:
-            self.fabric.send_ctl(0, ("min", self.pid, local, counts))
-            tag, agreed, my_expected = self._timed_recv_ctl()
+            tag, agreed = self._timed_recv_ctl()
             assert tag == "adv", tag
-        self.fabric.wait_data_counts(my_expected)
-        self.fabric.confirm_sent(counts)
         am1 = _time.perf_counter()
-        # the whole coordinator min round (report + reply + count-wait);
-        # its ctl/data wait shares are separately attributed inside
+        # agree_min_s counts only the blocking finish; the span covers
+        # begin->finish so traces show how much of the round was hidden
         self.fabric.stats["agree_min_s"] += am1 - am0
-        obs.record_span("cluster.agree_min", am0, am1, ctx=self._obs_ctx,
-                        agreed=agreed if agreed is not None else "none")
+        obs.record_span("cluster.agree_min", b0, am1, ctx=self._obs_ctx,
+                        agreed=agreed if agreed is not None else "none",
+                        finish_wait_s=round(am1 - am0, 6))
         return agreed
 
     def _gather(self, payload: tuple) -> list | None:
@@ -372,7 +460,7 @@ class ClusterRunner:
         if self.pid == 0:
             out = [payload]
             for _ in range(self.nprocs - 1):
-                tag, p = self.fabric.recv_ctl()
+                tag, p = self._timed_recv_ctl("wait_sync_s")
                 assert tag == "rep", tag
                 out.append(p)
             return out
@@ -385,18 +473,30 @@ class ClusterRunner:
         if self.pid == 0:
             self.fabric.broadcast_ctl(("cmd", payload))
             return payload
-        tag, p = self.fabric.recv_ctl()
+        tag, p = self._timed_recv_ctl("wait_sync_s")
         assert tag == "cmd", tag
         return p
 
     # -- drains ------------------------------------------------------------
     def _agreed_drain(self) -> None:
-        """Process every globally-pending logical time in ascending order."""
+        """Process every globally-pending logical time in ascending order.
+        With a fabric, each round is pipelined: `_run_time` posts the next
+        round's report at its own tail, so the blocking `finish` here
+        usually finds the reports already gathered."""
+        if self.fabric is None:
+            while True:
+                m = self._local_min_pending()
+                if m is None:
+                    return
+                self._run_time(m)
+        self._begin_agree_min()
         while True:
-            m = self._agree_min(self._local_min_pending())
+            m = self._finish_agree_min()
             if m is None:
                 return
             self._run_time(m)
+            # normally a no-op: _run_time already began the next round
+            self._begin_agree_min()
 
     def _input_barrier(self) -> None:
         """Formerly an EOT rendezvous ensuring injected/on_end emissions

@@ -8,19 +8,39 @@ three message families over one full TCP mesh:
 
   - data(time, pos, port, shard, seq, updates) — update batches crossing a
     process boundary at an exchange edge (the reference's exchange channels)
-  - mark(time, pos) — "this process finished every topo position < pos at
-    `time` and all its data for them is on the wire" (per-connection FIFO
-    makes the mark a barrier: receiving it guarantees the data arrived) —
-    the deterministic replacement for timely's frontier gossip
-  - eot(time) — "all sends stamped during `time`, including to later logical
-    times, are on the wire".  Round-10: the per-time/per-tick eot BARRIER is
-    gone — the cluster's min-agreement round piggybacks per-peer data-frame
-    counts and unconfirmed sends' target times (sent_report/wait_data_counts/
-    confirm_sent), which closes the same cross-time race without an extra
-    rendezvous; explicit eot frames remain only for the shutdown barrier
+  - mark(time, pos, counts) — "this process finished every topo position
+    < pos at `time`; here is, per destination, the cumulative number of
+    data frames I have stamped for every (time, p<=pos)".  Round-12: the
+    mark is no longer an ordering barrier — it carries COUNTS, so a
+    receiver count-proves completeness of a (time, pos) exchange point
+    (received-per-(peer,time,pos) == announced) instead of relying on
+    per-connection FIFO between the mark and the data.  That freedom is
+    what lets bulk data frames ride an asynchronous sender thread while
+    marks overtake them on a control lane: a quiet exchange point costs
+    one tiny control frame, and the wait only blocks when frames are
+    genuinely in flight.
   - ctl(payload) — worker->coordinator reports and coordinator broadcasts
     (advance/tick/endphase/rescale), the jax.distributed-style host control
-    plane promised in SURVEY.md §2c
+    plane promised in SURVEY.md §2c.  eot frames remain only for the
+    shutdown barrier.
+
+Send path (round-12): `send_data` only enqueues — pickling and socket
+writes happen on one sender thread per peer, so serialization never sits
+on the compute thread.  Each sender drains two lanes: a control lane
+(ctl/marks/eot; marks for the same logical time coalesce to the newest)
+that is flushed before the data lane each cycle, and a FIFO data lane
+whose small frames coalesce per (time, pos) into one grouped frame (one
+pickle, one write, N logical frames — the receiver unpacks and counts
+each).  Queues are bounded; a full queue blocks the producer (billed to
+``send_s``, so backpressure stays visible in the wall split).
+
+Progress/EOT: a cross-time or out-of-walk send is "vouched" by its sender
+— its target logical time joins the sender's min-agreement report — until
+the sender has itself processed that time (the agreed walk guarantees
+every process ran it, and the receiver's counted mark-wait there proved
+delivery).  Same-time sends during the walk are covered by the counted
+marks alone.  This replaces both the per-time EOT barrier (round-10) and
+the per-round count-wait with zero extra rendezvous.
 
 Addresses: process i listens on first_port + i on localhost (multi-host
 would swap the address table, as the reference's PATHWAY_ADDRESSES does).
@@ -38,7 +58,7 @@ import socket
 import struct
 import threading
 import time as _time
-from collections import defaultdict
+from collections import defaultdict, deque
 from typing import Any
 
 from .. import obs
@@ -51,6 +71,17 @@ _LEN = struct.Struct("<I")
 # pickle execution to any local process that can dial the port.
 _SECRET_ENV = "PATHWAY_FABRIC_SECRET"
 
+# Fault injection for tests (see tests/test_overlap_fabric.py): delay every
+# sender-thread drain cycle by N ms, optionally only on one pid.  Forces
+# queue buildup (=> coalescing) and models a delayed straggler without
+# touching protocol code paths.
+_DELAY_ENV = "PW_FABRIC_SEND_DELAY_MS"
+_DELAY_PID_ENV = "PW_FABRIC_DELAY_PID"
+
+# sender-queue bound: frames (not bytes) per peer; a full queue blocks the
+# producer so memory stays bounded under a slow peer
+_MAX_QUEUED_FRAMES = 8192
+
 
 def _fabric_secret() -> bytes | None:
     s = os.environ.get(_SECRET_ENV)
@@ -61,34 +92,235 @@ class FabricError(RuntimeError):
     pass
 
 
+class _PeerSender(threading.Thread):
+    """Asynchronous send path for one peer: the compute thread enqueues,
+    this thread pickles + writes.  Two lanes:
+
+      - ctl lane: ctl payloads / counted marks / eot.  Flushed before the
+        data lane each drain cycle so progress control overtakes bulk data
+        (safe: marks carry counts, so ordering vs data is irrelevant).
+        Marks for the same logical time coalesce to the newest (cursor and
+        counts are both monotone).
+      - data lane: strict FIFO.  Consecutive frames for the same
+        (time, pos) coalesce into one grouped "D" frame carrying N logical
+        frames (one pickle / one write); the receiver unpacks and counts
+        every logical frame, so the counted-delivery math is unchanged.
+    """
+
+    def __init__(self, fabric: "Fabric", peer: int, sock: socket.socket):
+        super().__init__(daemon=True, name=f"pw-fabric-send-{peer}")
+        self.fabric = fabric
+        self.peer = peer
+        self.sock = sock
+        self.ctl: deque = deque()
+        self.data: deque = deque()
+        self.cond = threading.Condition()
+        self.idle = True  # False while a popped batch is being written
+        self.stopped = False
+        delay = float(os.environ.get(_DELAY_ENV, "0") or 0)
+        dpid = os.environ.get(_DELAY_PID_ENV)
+        if dpid is not None and dpid != "" and int(dpid) != fabric.pid:
+            delay = 0.0
+        self.delay_s = delay / 1000.0
+
+    # -- producer side (compute thread) -----------------------------------
+    def put_data(self, item: tuple) -> None:
+        with self.cond:
+            while (
+                len(self.data) >= _MAX_QUEUED_FRAMES
+                and not self.stopped
+                and self.fabric._dead is None
+            ):
+                self.cond.wait(timeout=0.5)
+            self.fabric._check()
+            self.data.append(item)
+            self._note_depth()
+            self.cond.notify_all()
+
+    def put_ctl(self, item: tuple) -> None:
+        with self.cond:
+            self.fabric._check()
+            if item[0] == "M":
+                # coalesce: one pending mark per logical time — the newest
+                # cursor/counts supersede (both monotone per time)
+                t = item[1]
+                for i, old in enumerate(self.ctl):
+                    if old[0] == "M" and old[1] == t:
+                        self.ctl[i] = item
+                        self.fabric._bump("sender_mark_coalesced", 1)
+                        self.cond.notify_all()
+                        return
+            self.ctl.append(item)
+            self._note_depth()
+            self.cond.notify_all()
+
+    def _note_depth(self) -> None:
+        # one scope for both gauges: the cross-peer TOTAL of queued
+        # frames (a per-peer peak under a global depth reads nonsense)
+        total = self._total_depth()
+        st = self.fabric.stats
+        st["sender_queue_depth"] = total
+        if total > st["sender_queue_peak"]:
+            st["sender_queue_peak"] = total
+
+    def _total_depth(self) -> int:
+        return sum(
+            len(s.data) + len(s.ctl) for s in self.fabric._senders.values()
+        )
+
+    def flush(self, timeout_s: float = 120.0) -> None:
+        deadline = _time.monotonic() + timeout_s
+        with self.cond:
+            while self.ctl or self.data or not self.idle:
+                if self.stopped or self.fabric._dead is not None:
+                    return
+                if not self.cond.wait(timeout=0.2):
+                    if _time.monotonic() > deadline:
+                        raise FabricError(
+                            f"pid {self.fabric.pid}: sender flush timeout "
+                            f"to peer {self.peer}"
+                        )
+
+    def stop(self) -> None:
+        with self.cond:
+            self.stopped = True
+            self.cond.notify_all()
+
+    # -- consumer side (sender thread) ------------------------------------
+    def run(self) -> None:
+        try:
+            while True:
+                with self.cond:
+                    while not self.ctl and not self.data and not self.stopped:
+                        self.cond.wait(timeout=0.5)
+                    if self.stopped and not self.ctl and not self.data:
+                        return
+                    ctl_batch = list(self.ctl)
+                    self.ctl.clear()
+                    data_batch = list(self.data)
+                    self.data.clear()
+                    self.idle = False
+                    self.fabric.stats["sender_queue_depth"] = (
+                        self._total_depth()
+                    )
+                    self.cond.notify_all()
+                if self.delay_s:
+                    _time.sleep(self.delay_s)
+                t0 = _time.perf_counter()
+                frames = [self._encode_ctl(it) for it in ctl_batch]
+                frames.extend(self._coalesce(data_batch))
+                payload = b"".join(
+                    _LEN.pack(len(b)) + b for b in frames
+                )
+                if payload:
+                    self.sock.sendall(payload)
+                st = self.fabric.stats
+                with self.fabric._cond:
+                    st["sender_s"] += _time.perf_counter() - t0
+                    st["sender_flushes"] += 1
+                    st["send_count"] += len(frames)
+                    st["send_bytes"] += len(payload)
+                with self.cond:
+                    self.idle = True
+                    self.cond.notify_all()
+        except Exception as exc:  # noqa: BLE001 — pickling moved off the
+            # compute thread, so a serialization failure (unpicklable
+            # update value) surfaces HERE now; it must kill the fabric
+            # loudly like a socket error, not strand peers at mark waits
+            self.fabric._sender_died(self.peer, exc)
+        finally:
+            with self.cond:
+                self.idle = True
+                self.stopped = True
+                self.cond.notify_all()
+
+    @staticmethod
+    def _encode_ctl(item: tuple) -> bytes:
+        return pickle.dumps(item, protocol=pickle.HIGHEST_PROTOCOL)
+
+    def _coalesce(self, batch: list) -> list[bytes]:
+        """Group consecutive data-lane items by (time, pos) into "D"
+        frames.  FIFO is preserved (runs are consecutive); each logical
+        frame stays individually counted on the receiver."""
+        out: list[bytes] = []
+        i, n = 0, len(batch)
+        coalesced = 0
+        while i < n:
+            _tag, t, pos, port, shard, seq, updates = batch[i]
+            j = i + 1
+            while j < n and batch[j][1] == t and batch[j][2] == pos:
+                j += 1
+            if j - i == 1:
+                msg = ("d", t, pos, port, shard, self.fabric.pid, seq,
+                       updates)
+            else:
+                entries = [
+                    (b[5], b[3], b[4], b[6]) for b in batch[i:j]
+                ]  # (seq, port, shard, updates)
+                msg = ("D", t, pos, self.fabric.pid, entries)
+                coalesced += (j - i) - 1
+            out.append(pickle.dumps(msg, protocol=pickle.HIGHEST_PROTOCOL))
+            i = j
+        if coalesced:
+            with self.fabric._cond:
+                self.fabric.stats["sender_coalesced"] += coalesced
+        return out
+
+
 class Fabric:
     def __init__(self, pid: int, nprocs: int, first_port: int,
                  host: str = "127.0.0.1", connect_timeout_s: float = 30.0):
+        # tests override the mesh-formation deadline (this container's
+        # loopback aborts connects intermittently; a cheap deadline makes
+        # the retry-with-fresh-ports idiom fast instead of 30s per try)
+        env_to = os.environ.get("PW_FABRIC_CONNECT_TIMEOUT_S")
+        if env_to:
+            connect_timeout_s = float(env_to)
         self.pid = pid
         self.n = nprocs
         self.peers = [p for p in range(nprocs) if p != pid]
         self._socks: dict[int, socket.socket] = {}
-        self._send_locks: dict[int, threading.Lock] = {}
         self._cond = threading.Condition()
         # data[(time, pos)] -> list[(producer_pid, seq, port, shard, updates)]
         self._data: dict[tuple[int, int], list] = defaultdict(list)
-        # marks[peer][time] -> highest pos marked
+        # marks[peer][time] -> highest pos the peer announced (its cursor)
         self._marks: dict[int, dict[int, int]] = defaultdict(dict)
+        # announced[(peer, time)] -> {pos: cumulative frames the peer
+        # stamped for us at (time, pos)} — merged max (counts are monotone)
+        self._announced: dict[tuple[int, int], dict[int, int]] = {}
+        # received[(peer, time, pos)] -> data frames landed (logical count)
+        self._recv_pos_counts: dict[tuple[int, int, int], int] = defaultdict(int)
+        # sent-by-time[time][dst][pos] -> cumulative logical frames stamped
+        # (the mark snapshot source; pruned with the mark bookkeeping)
+        self._sent_by_time: dict[int, dict[int, dict[int, int]]] = (
+            defaultdict(lambda: defaultdict(lambda: defaultdict(int)))
+        )
+        # vouched sends: target times of out-of-walk sends this process
+        # still answers for in the min-agreement (dropped once the target
+        # time has itself been processed — see confirm_below)
+        self._vouched: dict[int, int] = defaultdict(int)  # time -> n frames
         self._eot: set[tuple[int, int]] = set()  # (peer, time)
         self._done_peers: set[int] = set()  # peers past their shutdown barrier
         self._ctl: "queue.Queue[Any]" = queue.Queue()
         self._dead: str | None = None
         self._closed = False
-        # observability (VERDICT r3): where exchange wall-time goes —
-        # serialization+socket writes, barrier waits, volumes by direction.
-        # Swept into /metrics and the bench `parallel` block; the model is
-        # timely's progress/channel instrumentation.
+        # observability (VERDICT r3): where exchange wall-time goes.
+        # Round-12 split: send_s is the COMPUTE thread's enqueue cost
+        # (including backpressure blocking); sender_s is the sender
+        # thread's pickle+write time, overlapped with compute.
         self.stats = {
             "send_count": 0, "send_bytes": 0, "send_s": 0.0,
+            "sender_s": 0.0, "sender_flushes": 0, "sender_coalesced": 0,
+            "sender_mark_coalesced": 0,
+            "sender_queue_depth": 0, "sender_queue_peak": 0,
             "recv_count": 0, "recv_bytes": 0,
             "data_msgs_out": 0, "mark_msgs_out": 0, "ctl_msgs_out": 0,
             "wait_marks_s": 0.0, "wait_eot_s": 0.0, "wait_ctl_s": 0.0,
             "wait_data_s": 0.0,
+            # wait_sync_s: shutdown/tick gather+broadcast rendezvous —
+            # routed through the timed ctl path under its own stat so the
+            # round-12 overlap work cannot hide stalls there (round-12)
+            "wait_sync_s": 0.0,
             # round-11 time attribution: compute_s/agree_min_s filled by
             # ClusterRunner; wait_marks_s_p<N> splits the mark-barrier
             # wait BY PEER so the straggler (ROADMAP item 1's 1.5s
@@ -99,14 +331,6 @@ class Fabric:
             self.stats[f"wait_marks_s_p{p}"] = 0.0
         # data-plane trace: fabric wait spans for this process's rounds
         self._obs_ctx = (obs.new_trace_id(), 0)
-        # counted-delivery bookkeeping (round-10 EOT batching): data
-        # frames are counted per peer in both directions, and unconfirmed
-        # sends remember their target logical time — the cluster's min
-        # agreement piggybacks these so the per-time/per-tick EOT BARRIER
-        # round trips are gone (see cluster._agree_min)
-        self._sent_counts: dict[int, int] = defaultdict(int)
-        self._recv_counts: dict[int, int] = defaultdict(int)
-        self._sent_unconfirmed: list[tuple[int, int, int]] = []  # (dst, idx, t)
         self._secret = _fabric_secret()
         if self._secret is None:
             logging.getLogger(__name__).warning(
@@ -116,6 +340,10 @@ class Fabric:
                 _SECRET_ENV,
             )
         self._connect(host, first_port, connect_timeout_s)
+        self._senders: dict[int, _PeerSender] = {}
+        for peer, sock in self._socks.items():
+            snd = _PeerSender(self, peer, sock)
+            self._senders[peer] = snd
         self._threads = []
         for peer, sock in self._socks.items():
             th = threading.Thread(
@@ -124,6 +352,12 @@ class Fabric:
             )
             th.start()
             self._threads.append(th)
+        for snd in self._senders.values():
+            snd.start()
+
+    def _bump(self, key: str, n: int) -> None:
+        with self._cond:
+            self.stats[key] += n
 
     # -- mesh formation ----------------------------------------------------
     def _connect(self, host: str, first_port: int, timeout_s: float) -> None:
@@ -207,16 +441,9 @@ class Fabric:
             listener.settimeout(timeout_s)
             acceptor = threading.Thread(target=do_accept, daemon=True)
             acceptor.start()
-        for peer in dial_to:
+        def dial_once(peer: int) -> socket.socket:
             sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
-            while True:
-                try:
-                    sock.connect((host, first_port + peer))
-                    break
-                except OSError:
-                    if _time.monotonic() > deadline:
-                        raise FabricError(f"cannot reach peer {peer}")
-                    _time.sleep(0.1)
+            sock.connect((host, first_port + peer))
             sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             pid_bytes = self.pid.to_bytes(4, "little")
             if self._secret is not None:
@@ -239,7 +466,32 @@ class Fabric:
                     )
             else:
                 sock.sendall(pid_bytes)
-            self._socks[peer] = sock
+            return sock
+
+        for peer in dial_to:
+            # the WHOLE dial+handshake retries until the deadline: this
+            # container's loopback aborts established connections
+            # mid-handshake (ECONNABORTED) often enough that retrying
+            # only the connect() left mesh formation flaky.  A rejected
+            # credential is a real failure and never retried.
+            while True:
+                try:
+                    self._socks[peer] = dial_once(peer)
+                    break
+                except FabricError as exc:
+                    if "rejected" in str(exc):
+                        raise
+                    if _time.monotonic() > deadline:
+                        raise FabricError(
+                            f"cannot reach peer {peer}: {exc}"
+                        )
+                    _time.sleep(0.1)
+                except OSError as exc:
+                    if _time.monotonic() > deadline:
+                        raise FabricError(
+                            f"cannot reach peer {peer}: {exc}"
+                        )
+                    _time.sleep(0.1)
         if acceptor is not None:
             acceptor.join(timeout_s)
             if len(accepted) != len(accept_from):
@@ -249,63 +501,80 @@ class Fabric:
                 )
         self._socks.update(accepted)
         listener.close()
-        self._send_locks = {p: threading.Lock() for p in self._socks}
 
     # -- send --------------------------------------------------------------
-    def _send(self, peer: int, msg: tuple) -> None:
-        t0 = _time.perf_counter()
-        blob = pickle.dumps(msg, protocol=pickle.HIGHEST_PROTOCOL)
-        with self._send_locks[peer]:
-            try:
-                self._socks[peer].sendall(_LEN.pack(len(blob)) + blob)
-            except OSError as exc:
-                raise FabricError(f"peer {peer} unreachable: {exc}")
-        st = self.stats
-        st["send_count"] += 1
-        st["send_bytes"] += len(blob) + _LEN.size
-        st["send_s"] += _time.perf_counter() - t0
-
-    def _send_all(self, msg: tuple) -> None:
-        """One pickle, every peer: protocol fan-outs (marks, eot, ctl
-        broadcasts) share the serialized blob instead of re-pickling per
-        peer."""
-        t0 = _time.perf_counter()
-        blob = pickle.dumps(msg, protocol=pickle.HIGHEST_PROTOCOL)
-        framed = _LEN.pack(len(blob)) + blob
-        for peer in self.peers:
-            with self._send_locks[peer]:
-                try:
-                    self._socks[peer].sendall(framed)
-                except OSError as exc:
-                    raise FabricError(f"peer {peer} unreachable: {exc}")
-        st = self.stats
-        st["send_count"] += len(self.peers)
-        st["send_bytes"] += len(framed) * len(self.peers)
-        st["send_s"] += _time.perf_counter() - t0
+    def _sender_died(self, peer: int, exc: Exception) -> None:
+        with self._cond:
+            if not self._closed and peer not in self._done_peers:
+                self._dead = f"send path to peer {peer} failed: {exc!r}"
+                self._ctl.put(("__peer_lost__", peer))
+            self._cond.notify_all()
+        for snd in self._senders.values():
+            with snd.cond:
+                snd.cond.notify_all()
 
     def send_data(self, peer: int, time: int, pos: int, port: int, shard: int,
-                  seq: int, updates: list) -> None:
-        self.stats["data_msgs_out"] += 1
-        with self._cond:
-            self._sent_counts[peer] += 1
-            self._sent_unconfirmed.append(
-                (peer, self._sent_counts[peer], time)
-            )
-        self._send(peer, ("d", time, pos, port, shard, self.pid, seq, updates))
+                  seq: int, updates: list, vouch: bool = True) -> None:
+        """Enqueue one data frame for the peer's sender thread.
 
-    def send_mark(self, time: int, pos: int) -> None:
-        self.stats["mark_msgs_out"] += 1
-        self._send_all(("m", time, pos))
+        ``vouch=False`` marks a same-time send made inside the agreed walk
+        of ``time``: its delivery is proven by the counted mark the sender
+        posts when crossing (time, pos), so it never joins the
+        min-agreement report.  Everything else (cross-time emissions,
+        injections, on_end flushes) is vouched — its target time stays in
+        this process's reported minimum until the time has been processed
+        (``confirm_below``), which by the agreed walk implies every
+        receiver count-proved the delivery."""
+        t0 = _time.perf_counter()
+        with self._cond:
+            self._check_locked()
+            self.stats["data_msgs_out"] += 1
+            self._sent_by_time[time][peer][pos] += 1
+            if vouch:
+                self._vouched[time] += 1
+        self._senders[peer].put_data(
+            ("data", time, pos, port, shard, seq, updates)
+        )
+        with self._cond:
+            self.stats["send_s"] += _time.perf_counter() - t0
+
+    def post_mark(self, time: int, pos: int) -> None:
+        """Counted mark: announce to every peer that this process finished
+        all positions < pos at ``time``, together with the cumulative
+        per-(destination, pos') frame counts it has stamped for ``time``.
+        Receivers count-prove the exchange point instead of treating the
+        frame as a FIFO barrier, so the mark rides the control lane and
+        may legally overtake bulk data."""
+        with self._cond:
+            self._check_locked()
+            self.stats["mark_msgs_out"] += 1
+            by_dst = self._sent_by_time.get(time, {})
+            counts = {dst: dict(per_pos) for dst, per_pos in by_dst.items()}
+        msg = ("M", time, pos, counts)
+        for peer in self.peers:
+            self._senders[peer].put_ctl(msg)
 
     def send_eot(self, time: int) -> None:
-        self._send_all(("e", time))
+        for peer in self.peers:
+            self._senders[peer].put_ctl(("e", time))
 
     def send_ctl(self, peer: int, payload: Any) -> None:
-        self.stats["ctl_msgs_out"] += 1
-        self._send(peer, ("c", payload))
+        with self._cond:
+            self._check_locked()
+            self.stats["ctl_msgs_out"] += 1
+        self._senders[peer].put_ctl(("c", payload))
 
     def broadcast_ctl(self, payload: Any) -> None:
-        self._send_all(("c", payload))
+        with self._cond:
+            self._check_locked()
+            self.stats["ctl_msgs_out"] += len(self.peers)
+        for peer in self.peers:
+            self._senders[peer].put_ctl(("c", payload))
+
+    def flush(self, timeout_s: float = 120.0) -> None:
+        """Block until every sender queue is drained and written."""
+        for snd in self._senders.values():
+            snd.flush(timeout_s)
 
     # -- receive -----------------------------------------------------------
     def _recv_loop(self, peer: int, sock: socket.socket) -> None:
@@ -341,14 +610,28 @@ class Fabric:
                     self._data[(t, pos)].append(
                         (producer, seq, port, shard, updates)
                     )
-                    self._recv_counts[peer] += 1
+                    self._recv_pos_counts[(peer, t, pos)] += 1
                     self._cond.notify_all()
-            elif kind == "m":
-                _, t, pos = msg
+            elif kind == "D":
+                _, t, pos, producer, entries = msg
+                with self._cond:
+                    bucket = self._data[(t, pos)]
+                    for seq, port, shard, updates in entries:
+                        bucket.append((producer, seq, port, shard, updates))
+                    self._recv_pos_counts[(peer, t, pos)] += len(entries)
+                    self._cond.notify_all()
+            elif kind == "M":
+                _, t, pos, counts = msg
+                mine = counts.get(self.pid, {})
                 with self._cond:
                     cur = self._marks[peer].get(t, -1)
                     if pos > cur:
                         self._marks[peer][t] = pos
+                    if mine:
+                        ann = self._announced.setdefault((peer, t), {})
+                        for p, n in mine.items():
+                            if n > ann.get(p, 0):
+                                ann[p] = n
                     self._cond.notify_all()
             elif kind == "e":
                 with self._cond:
@@ -365,20 +648,41 @@ class Fabric:
                 self._dead = f"peer {peer} disconnected"
                 self._ctl.put(("__peer_lost__", peer))
             self._cond.notify_all()
+        for snd in self._senders.values():
+            with snd.cond:
+                snd.cond.notify_all()
+
+    def _check_locked(self) -> None:
+        if self._dead is not None:
+            raise FabricError(self._dead)
 
     def _check(self) -> None:
         if self._dead is not None:
             raise FabricError(self._dead)
 
-    # -- barriers ----------------------------------------------------------
+    # -- counted mark-point wait -------------------------------------------
+    def _mark_ready(self, peer: int, time: int, pos: int) -> bool:
+        """(caller holds _cond)  Peer's exchange point (time, pos) is
+        complete: its cursor passed pos AND every frame it announced for
+        (time, pos) has landed."""
+        if self._marks[peer].get(time, -1) < pos:
+            return False
+        ann = self._announced.get((peer, time))
+        if not ann:
+            return True
+        need = ann.get(pos, 0)
+        return self._recv_pos_counts[(peer, time, pos)] >= need
+
     def wait_marks(self, time: int, pos: int, timeout_s: float = 120.0) -> None:
-        """Block until every peer marked (time, >= pos).
+        """Block until every peer's (time, pos) exchange point is
+        count-proven complete (cursor >= pos and announced-frame counts
+        matched).  Quiet points complete on the control-lane mark alone;
+        the wait only blocks on bytes when frames are genuinely in flight.
 
         Round-11: the wait is attributed PER PEER — each peer's
         ``wait_marks_s_p<pid>`` accumulates how long it kept this process
-        at the barrier (its mark's observed arrival minus the wait's
-        start), so a 2-proc `wait_marks_s` spike names its straggler —
-        and waits land as ``fabric.wait_marks`` flight-recorder spans."""
+        at the barrier, so a 2-proc `wait_marks_s` spike names its
+        straggler — and waits land as ``fabric.wait_marks`` spans."""
         deadline = _time.monotonic() + timeout_s
         t0 = _time.perf_counter()
         remaining = set(self.peers)
@@ -388,7 +692,7 @@ class Fabric:
                 # delivered its mark may legitimately be gone by now
                 now = _time.perf_counter()
                 for p in [p for p in remaining
-                          if self._marks[p].get(time, -1) >= pos]:
+                          if self._mark_ready(p, time, pos)]:
                     self.stats[f"wait_marks_s_p{p}"] += now - t0
                     remaining.discard(p)
                 if not remaining:
@@ -396,7 +700,7 @@ class Fabric:
                     obs.record_span("fabric.wait_marks", t0, now,
                                     ctx=self._obs_ctx, time=time, pos=pos)
                     return
-                self._check()
+                self._check_locked()
                 if not self._cond.wait(timeout=min(1.0, deadline - _time.monotonic())):
                     if _time.monotonic() > deadline:
                         raise FabricError(
@@ -416,94 +720,49 @@ class Fabric:
                         self._marks[p].pop(time, None)
                     self.stats["wait_eot_s"] += _time.perf_counter() - t0
                     return
-                self._check()
+                self._check_locked()
                 if not self._cond.wait(timeout=min(1.0, deadline - _time.monotonic())):
                     if _time.monotonic() > deadline:
                         raise FabricError(
                             f"pid {self.pid}: eot barrier timeout at t={time}"
                         )
 
-    # -- counted delivery (round-10: EOT piggybacked on the min round) -----
-    def sent_report(self, above: int | None = None
-                    ) -> tuple[dict[int, int], int | None]:
-        """Snapshot for the cluster's min-agreement round: cumulative data
-        frames sent per peer, plus the minimum target logical time among
-        sends not yet globally confirmed.  Reporting unconfirmed sends'
-        times is what lets the agreement see in-flight work WITHOUT a
-        separate EOT barrier: the sender vouches for a frame until the
-        round that confirms every receiver has caught up to the counts
-        (:meth:`confirm_sent`), after which the receiver's own pending
-        report carries it.
-
-        ``above`` (the caller's processed frontier) filters the reported
-        minimum to CROSS-TIME sends only: a frame stamped at an
-        already-processed time was delivered under that time's mark
-        barrier (per-position rendezvous inside ``_run_time``), and
-        reporting it would drag the agreed minimum back to a finished
-        time — every exchanging time would be agreed and run twice.
-        Only sends targeting times past the frontier are in the
-        cross-time race the old EOT barrier closed.  The COUNTS stay
-        unfiltered, so delivery of every frame is still confirmed."""
+    # -- vouched sends (round-12 progress/EOT accounting) ------------------
+    def vouched_min(self) -> int | None:
+        """Minimum target logical time among out-of-walk sends this
+        process still answers for in the min-agreement round.  The sender
+        vouches until it has itself processed the target time: the agreed
+        walk then guarantees every receiver count-proved the delivery at
+        its mark points (``confirm_below``)."""
         with self._cond:
-            counts = dict(self._sent_counts)
-            tmin = min(
-                (t for _dst, _idx, t in self._sent_unconfirmed
-                 if above is None or t > above),
-                default=None,
-            )
-            return counts, tmin
+            return min(self._vouched) if self._vouched else None
 
-    def confirm_sent(self, snapshot: dict[int, int]) -> None:
-        """Drop unconfirmed-send records covered by ``snapshot`` (the
-        counts reported in a completed agreement round): every receiver
-        has count-waited past them, so from the next round on the data
-        appears in the receivers' own pending reports."""
+    def confirm_below(self, time: int) -> None:
+        """Drop vouches for sends targeting times <= ``time`` — this
+        process has run those times under the agreement, so their counted
+        mark points (which include every cross-time frame in their
+        announced counts) proved delivery everywhere."""
         with self._cond:
-            self._sent_unconfirmed = [
-                e for e in self._sent_unconfirmed
-                if e[1] > snapshot.get(e[0], 0)
-            ]
-
-    def wait_data_counts(self, expected: dict[int, int],
-                         timeout_s: float = 120.0) -> None:
-        """Block until at least ``expected[src]`` data frames have arrived
-        from each ``src`` — the counted-delivery replacement for the EOT
-        barrier: per-connection FIFO means matching the sender-reported
-        count proves every frame it vouched for is in ``self._data``."""
-        if not expected:
-            return
-        deadline = _time.monotonic() + timeout_s
-        t0 = _time.perf_counter()
-        with self._cond:
-            while True:
-                if all(self._recv_counts[p] >= n
-                       for p, n in expected.items()):
-                    now = _time.perf_counter()
-                    self.stats["wait_data_s"] += now - t0
-                    obs.record_span("fabric.wait_data", t0, now,
-                                    ctx=self._obs_ctx)
-                    return
-                self._check()
-                if not self._cond.wait(
-                    timeout=min(1.0, deadline - _time.monotonic())
-                ):
-                    if _time.monotonic() > deadline:
-                        raise FabricError(
-                            f"pid {self.pid}: data-count barrier timeout "
-                            f"(expected {expected}, have "
-                            f"{dict(self._recv_counts)})"
-                        )
+            for t in [t for t in self._vouched if t <= time]:
+                del self._vouched[t]
 
     def prune_marks(self, below_time: int) -> None:
-        """Drop mark bookkeeping for logical times < ``below_time`` (they
-        were previously cleaned by the per-time EOT barrier; times are
-        processed in ascending order, so older marks can never gate a
-        future wait — a late straggler recreates at most one small entry,
-        pruned by the next call)."""
+        """Drop mark/count bookkeeping for logical times < ``below_time``
+        (times are processed in ascending order, so older marks can never
+        gate a future wait; a late straggler send recreates symmetric
+        fresh entries on both sides — both pruned their history at the
+        same processed times — cleaned by the next call)."""
         with self._cond:
             for marks in self._marks.values():
                 for t in [t for t in marks if t < below_time]:
                     del marks[t]
+            for key in [k for k in self._announced if k[1] < below_time]:
+                del self._announced[key]
+            for key in [k for k in self._recv_pos_counts
+                        if k[1] < below_time]:
+                del self._recv_pos_counts[key]
+            for t in [t for t in self._sent_by_time if t < below_time]:
+                del self._sent_by_time[t]
 
     def pending_times(self) -> set[int]:
         """Times with stashed remote data not yet taken."""
@@ -521,8 +780,9 @@ class Fabric:
         # NOTE: no blanket wait_ctl_s accounting here — a streaming
         # worker blocks in recv_ctl waiting for the coordinator's next
         # TICK (idle scheduling, not round cost), which would swamp the
-        # time split.  ClusterRunner._agree_min times its own ctl waits
-        # into wait_ctl_s, where they ARE coordinator-round cost.
+        # time split.  ClusterRunner._timed_recv_ctl bills its waits to
+        # an explicit stat (wait_ctl_s inside the min round, wait_sync_s
+        # for gather/broadcast rendezvous).
         try:
             msg = self._ctl.get(timeout=timeout_s)
         except queue.Empty:
@@ -539,12 +799,16 @@ class Fabric:
         """Rendezvous before teardown: once every peer reaches this point no
         protocol message is outstanding, so the subsequent socket closes
         cannot be mistaken for failures."""
+        self.flush(timeout_s)
         self.send_eot(self._SHUTDOWN_T)
         self.wait_eot(self._SHUTDOWN_T, timeout_s=timeout_s)
+        self.flush(timeout_s)
         self._closed = True
 
     def close(self) -> None:
         self._closed = True
+        for snd in getattr(self, "_senders", {}).values():
+            snd.stop()
         for sock in self._socks.values():
             try:
                 sock.shutdown(socket.SHUT_RDWR)

@@ -810,7 +810,10 @@ class HybridIndex(InnerIndex):
     `weights` scales each sub-index's RRF contribution (w_i / (k + rank)),
     letting a caller down-weight a weaker retriever so fusion dominates
     both components instead of averaging toward the worse one; the default
-    (all 1.0) is the reference's plain RRF."""
+    (all 1.0) is the reference's plain RRF.  A ZERO weight disables the
+    sub-index completely — no adds, no removals, no probes — so callers
+    (HybridIndexFactory) can also skip computing its items: a tuned-out
+    retriever costs nothing at either index or query time (round-12)."""
 
     def __init__(self, inner_indexes: list[InnerIndex], *, k: float = 60.0,
                  weights: list[float] | None = None):
@@ -822,11 +825,15 @@ class HybridIndex(InnerIndex):
 
     def add(self, key, item, metadata=None):
         # item is a tuple: one entry per sub-index
-        for idx, it in zip(self.inner, item):
+        for idx, it, w in zip(self.inner, item, self.weights):
+            if w == 0.0:
+                continue  # disabled tier: its item may be raw/unembedded
             idx.add(key, it, metadata)
 
     def remove(self, key):
-        for idx in self.inner:
+        for idx, w in zip(self.inner, self.weights):
+            if w == 0.0:
+                continue
             idx.remove(key)
 
     def search(self, query, k, metadata_filter=None):

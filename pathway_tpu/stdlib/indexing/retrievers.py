@@ -129,14 +129,36 @@ class TantivyBM25Factory(AbstractRetrieverFactory):
 
 @dataclasses.dataclass
 class HybridIndexFactory(AbstractRetrieverFactory):
+    """RRF fusion over sub-retrievers.  ``weights`` (one per sub-factory)
+    scales each sub-index's RRF contribution; a ZERO weight disables that
+    retriever end to end — no query-side embedding is computed and no
+    probe runs for it (round-12: the tuned hybrid dense weight is 0.0 on
+    the bench corpus, and paying the dense encoder per query anyway was
+    the bulk of the `query_p50_ms` regression the `rag.embed` /
+    `index.probe` spans attributed)."""
+
     retriever_factories: list[AbstractRetrieverFactory] = dataclasses.field(default_factory=list)
     k: float = 60.0
+    weights: list[float] | None = None
 
     def build_index(self, data_column, data_table, metadata_column=None) -> DataIndex:
         subs = self.retriever_factories
         k = self.k
+        weights = self.weights
+        if weights is not None and len(weights) != len(subs):
+            raise ValueError(
+                f"weights must match retriever_factories length "
+                f"({len(weights)} != {len(subs)})"
+            )
 
         sub_embedders = [getattr(f, "embedder", None) for f in subs]
+        if weights is not None:
+            # a 0-weight retriever's query embedding is dead work: fuse
+            # skips its probe, so never pay its encoder either
+            sub_embedders = [
+                None if w == 0.0 else emb
+                for emb, w in zip(sub_embedders, weights)
+            ]
 
         def make_inner(f):
             if isinstance(f, (BruteForceKnnFactory, UsearchKnnFactory)):
@@ -152,7 +174,9 @@ class HybridIndexFactory(AbstractRetrieverFactory):
         inner_factories = [make_inner(f) for f in subs]
 
         def factory():
-            return HybridIndex([mk() for mk in inner_factories], k=k)
+            return HybridIndex(
+                [mk() for mk in inner_factories], k=k, weights=weights,
+            )
 
         def hybrid_embedder(col):
             if isinstance(col, MakeTupleExpression):

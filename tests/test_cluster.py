@@ -19,32 +19,35 @@ import pytest
 REPO = Path(__file__).resolve().parent.parent
 
 
-def _free_port_block() -> int:
-    # grab an anchor port; fabric uses anchor..anchor+nprocs-1
-    s = socket.socket()
-    s.bind(("127.0.0.1", 0))
-    port = s.getsockname()[1]
-    s.close()
-    return port
+from .utils import fabric_mesh_flake, fabric_port_block
 
 
 def _spawn(script: Path, processes: int, threads: int = 1,
-           timeout: int = 120, extra_env: dict | None = None) -> None:
+           timeout: int = 120, extra_env: dict | None = None,
+           attempts: int = 4) -> None:
     env = dict(os.environ)
     env["PYTHONPATH"] = str(REPO)
+    env["PW_FABRIC_CONNECT_TIMEOUT_S"] = "8"  # cheap mesh retries
     env.pop("PATHWAY_THREADS", None)
     env.pop("PATHWAY_PROCESSES", None)
     if extra_env:
         env.update(extra_env)
-    cmd = [
-        sys.executable, "-m", "pathway_tpu", "spawn",
-        "--threads", str(threads), "--processes", str(processes),
-        "--first-port", str(_free_port_block()),
-        "--", sys.executable, str(script),
-    ]
-    res = subprocess.run(cmd, env=env, capture_output=True, text=True,
-                         timeout=timeout)
-    assert res.returncode == 0, f"stdout={res.stdout}\nstderr={res.stderr}"
+    last = ""
+    for _attempt in range(attempts):
+        cmd = [
+            sys.executable, "-m", "pathway_tpu", "spawn",
+            "--threads", str(threads), "--processes", str(processes),
+            "--first-port", str(fabric_port_block(processes)),
+            "--", sys.executable, str(script),
+        ]
+        res = subprocess.run(cmd, env=env, capture_output=True, text=True,
+                             timeout=timeout)
+        if res.returncode == 0:
+            return
+        last = f"stdout={res.stdout}\nstderr={res.stderr}"
+        if not fabric_mesh_flake(res.stderr):
+            break  # real failure: surface it, never retry it away
+    raise AssertionError(last)
 
 
 def _wordcount_script(tmp: Path, inp: Path, out: Path) -> Path:

@@ -419,7 +419,10 @@ def test_fabric_wait_marks_attributed_per_peer():
     """wait_marks records per-peer elapsed: the peer that arrives late is
     the one whose wait_marks_s_p<pid> grows (ROADMAP item 1's straggler
     diagnosis).  Unit-level — no sockets, the container's loopback is
-    unreliable (see tests/test_cluster.py's seed failures)."""
+    unreliable (see tests/test_cluster.py's seed failures).  Round-12:
+    marks are COUNTED — a peer's exchange point completes when its
+    cursor passed the position and its announced frame counts matched
+    the received ones (`_mark_ready`)."""
     from pathway_tpu.parallel.comm import Fabric
 
     f = Fabric.__new__(Fabric)
@@ -427,6 +430,8 @@ def test_fabric_wait_marks_attributed_per_peer():
     f.peers = [1, 2]
     f._cond = threading.Condition()
     f._marks = defaultdict(dict)
+    f._announced = {}
+    f._recv_pos_counts = defaultdict(int)
     f._dead = None
     f.stats = {"wait_marks_s": 0.0, "wait_marks_s_p1": 0.0,
                "wait_marks_s_p2": 0.0}

@@ -157,3 +157,38 @@ def assert_key_entries_in_stream_consistent(table: pw.Table) -> None:
             f"key {e.key} multiplicity {cur} at time {e.time}"
         )
         state[e.key] = cur
+
+
+# -- multi-process fabric test plumbing (round-12) -------------------------
+# One shared implementation of the fixed-range port anchor and the
+# mesh-formation retry predicate: this container's loopback aborts
+# connects intermittently, and ephemeral-range (bind-to-0) anchors race
+# its own outbound connections.  Used by test_cluster, test_snapshots,
+# and test_overlap_fabric — keep the retryable-error set HERE only.
+
+def fabric_port_block(n: int = 4) -> int:
+    """Bindable anchor from the fixed 21000-28000 range; the fabric uses
+    anchor..anchor+nprocs-1."""
+    import random
+    import socket
+
+    rng = random.Random()
+    for _ in range(64):
+        base = 21000 + rng.randrange(0, 6800)
+        try:
+            for i in range(n):
+                s = socket.socket()
+                s.bind(("127.0.0.1", base + i))
+                s.close()
+            return base
+        except OSError:
+            continue
+    raise RuntimeError("no bindable port block in 21000-28000")
+
+
+def fabric_mesh_flake(stderr: str) -> bool:
+    """True when a failed spawn's stderr shows a mesh-formation flake
+    (retry with a fresh port block) rather than a real failure."""
+    return ("cannot reach peer" in stderr
+            or "peers connected" in stderr
+            or "cannot bind fabric port" in stderr)
