@@ -117,6 +117,7 @@ def assert_table_has_schema(table: Table, schema, *, allow_superset: bool = True
 # -- namespaces --------------------------------------------------------------
 from . import debug  # noqa: E402
 from . import demo  # noqa: E402
+from . import faults  # noqa: E402
 from . import io  # noqa: E402
 from . import obs  # noqa: E402
 from . import persistence  # noqa: E402

@@ -20,11 +20,16 @@ EXIT_CODE_UPSCALE = 12
 MAX_PROCESSES = 64
 
 
-def _spawn_once(program: list[str], threads: int, processes: int, first_port: int) -> int:
+def _spawn_once(program: list[str], threads: int, processes: int,
+                first_port: int, fail_fast: bool = False) -> int:
     """Run the program as `processes` cooperating OS processes.
 
     A rescale exit code (10/12) from ANY worker terminates the others so the
-    supervisor can respawn the whole cluster at the new size.
+    supervisor can respawn the whole cluster at the new size.  With
+    ``fail_fast`` (the restart supervisor), the first nonzero exit also
+    terminates the survivors immediately — peer-death detection makes
+    them abort on their own anyway (parallel/comm.py PeerLostError +
+    poison broadcast), this just skips waiting out the heartbeat deadline.
     """
     import time
 
@@ -65,13 +70,30 @@ def _spawn_once(program: list[str], threads: int, processes: int, first_port: in
                 return rc
             if rc != 0:
                 code = rc
+                if fail_fast:
+                    for q in running:
+                        q.terminate()
+                    for q in running:
+                        q.wait()
+                    return code
         time.sleep(0.1)
     return code
 
 
 def spawn(program: list[str], *, threads: int = 1, processes: int = 1,
-          first_port: int = 10000, record: bool = False) -> int:
+          first_port: int = 10000, record: bool = False,
+          restart: int = 0) -> int:
     """Supervise the program; honor elastic-rescale exit codes.
+
+    ``restart`` (Round-13): how many times a crashed cluster is
+    relaunched.  A worker dying (chaos kill, OOM, segfault) aborts the
+    whole mesh at a consistent protocol point (peer-death detection +
+    poison broadcast); the supervisor then respawns every worker slot
+    and the run resumes from the persistence journal — with a
+    persistence backend configured, output is exactly-once across the
+    kill (tests/test_chaos_cluster.py pins the squash-check).  Faults
+    armed via ``PW_FAULT`` use ``PW_FAULT_STAMP_DIR`` to fire only once
+    across incarnations.
 
     Worker cap (reference: MAX_WORKERS=8, dataflow/config.rs:11-15): total
     threads x processes above 8 needs the 'unlimited-workers' entitlement;
@@ -90,8 +112,10 @@ def spawn(program: list[str], *, threads: int = 1, processes: int = 1,
                 file=sys.stderr,
             )
             processes = new_procs
+    attempts_left = max(0, int(restart))
     while True:
-        code = _spawn_once(program, threads, processes, first_port)
+        code = _spawn_once(program, threads, processes, first_port,
+                           fail_fast=attempts_left > 0)
         if code == EXIT_CODE_DOWNSCALE and processes > 1:
             processes = max(1, processes // 2)
             print(f"[pathway-tpu] downscaling to {processes} processes", file=sys.stderr)
@@ -100,7 +124,26 @@ def spawn(program: list[str], *, threads: int = 1, processes: int = 1,
             processes = min(MAX_PROCESSES, processes * 2)
             print(f"[pathway-tpu] upscaling to {processes} processes", file=sys.stderr)
             continue
+        if code != 0 and attempts_left > 0:
+            attempts_left -= 1
+            print(
+                f"[pathway-tpu] cluster died (exit {code}); relaunching all "
+                f"{processes} worker slot(s) "
+                f"({restart - attempts_left}/{restart}) — the persistence "
+                "journal resumes the mesh",
+                file=sys.stderr,
+            )
+            continue
         return code
+
+
+def run_cluster(program: list[str], *, threads: int = 1, processes: int = 1,
+                first_port: int = 10000, restart: int = 0) -> int:
+    """Python entry for a supervised cluster run with kill-and-recover:
+    ``run_cluster([...program...], processes=2, restart=2)`` is
+    ``pathway-tpu spawn --processes 2 --restart 2 -- program``."""
+    return spawn(program, threads=threads, processes=processes,
+                 first_port=first_port, restart=restart)
 
 
 def spawn_from_env() -> int:
@@ -114,6 +157,7 @@ def spawn_from_env() -> int:
         threads=int(os.environ.get("PATHWAY_THREADS", "1")),
         processes=int(os.environ.get("PATHWAY_PROCESSES", "1")),
         first_port=int(os.environ.get("PATHWAY_FIRST_PORT", "10000")),
+        restart=int(os.environ.get("PATHWAY_RESTART_ATTEMPTS", "0")),
     )
 
 
@@ -126,6 +170,10 @@ def main(argv: list[str] | None = None) -> int:
     sp.add_argument("--processes", "-n", type=int, default=1)
     sp.add_argument("--first-port", type=int, default=10000)
     sp.add_argument("--record", action="store_true")
+    sp.add_argument("--restart", type=int, default=0,
+                    help="relaunch a crashed cluster up to N times "
+                         "(kill-and-recover; resumes from the persistence "
+                         "journal)")
     sp.add_argument("program", nargs=argparse.REMAINDER)
 
     sub.add_parser("spawn-from-env", help="spawn using PATHWAY_SPAWN_PROGRAM env")
@@ -153,7 +201,8 @@ def main(argv: list[str] | None = None) -> int:
         if not program:
             parser.error("no program given")
         return spawn(program, threads=args.threads, processes=args.processes,
-                     first_port=args.first_port, record=args.record)
+                     first_port=args.first_port, record=args.record,
+                     restart=args.restart)
     if args.command == "spawn-from-env":
         return spawn_from_env()
     if args.command == "run":

@@ -30,6 +30,7 @@ from typing import Any, Sequence
 from urllib.parse import parse_qsl, urlsplit
 
 from .. import obs
+from ..serve.admission import EngineFailedError
 from ..internals import dtype as dt
 from ..internals import parse_graph as pg
 from ..internals.datasource import SubjectDataSource
@@ -438,6 +439,33 @@ class PathwayWebserver:
                 except _HttpError as he:
                     finish(he.status, json.dumps({"error": he.reason}).encode(),
                            extra_headers=he.headers)
+                except EngineFailedError as ef:
+                    # Round-13: a request that died to an engine failure
+                    # (or exhausted supervised restarts) is a TRANSIENT
+                    # server-side outage — 503 + Retry-After with the
+                    # trace id in the body, distinct from admission's
+                    # 429 (the client did nothing wrong and should retry
+                    # unchanged once the engine restarts/degrades)
+                    logging.error(json.dumps({
+                        "_type": "engine_failed",
+                        "error": str(ef),
+                        "trace_id": req_span.trace_id,
+                        "engine_trace": ef.trace_id,
+                        "dump_path": ef.dump_path,
+                    }))
+                    finish(
+                        503,
+                        json.dumps({
+                            "error": str(ef),
+                            "trace": req_span.trace_id,
+                            "engine_trace": ef.trace_id,
+                            "retry_after_s": ef.retry_after_s,
+                        }).encode(),
+                        extra_headers={
+                            "Retry-After":
+                                f"{max(1, round(ef.retry_after_s))}"
+                        },
+                    )
                 except TimeoutError:
                     finish(504, b'{"error": "query timed out"}')
                 except json.JSONDecodeError:

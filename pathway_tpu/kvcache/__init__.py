@@ -35,12 +35,13 @@ managed-resource framing follows arxiv 2603.09555.
 """
 
 from .block_pool import BlockPool, PoolExhausted, SequenceState
-from .engine import PagedDecodeEngine, resolve_tp
+from .engine import EngineHungError, PagedDecodeEngine, resolve_tp
 from .paged_attention import paged_attention, paged_attention_reference
 from .prefix_cache import PrefixCache
 
 __all__ = [
     "BlockPool",
+    "EngineHungError",
     "PoolExhausted",
     "SequenceState",
     "PrefixCache",

@@ -150,6 +150,16 @@ class BlockPool:
             shards=self.tp, shard_hbm_bytes=self.per_shard_bytes,
         )
 
+    def retire(self) -> None:
+        """Release this pool's registry name immediately (Round-13: a
+        supervised engine restart rebuilds a same-name pool while the old
+        object may still be transiently pinned by the failure traceback —
+        without this, the replacement would get a '#1' suffix and a fresh
+        stats block instead of re-attaching to the monotonic counters)."""
+        with _LIVE_POOLS_LOCK:
+            if _LIVE_POOLS.get(self.name) is self:
+                del _LIVE_POOLS[self.name]
+
     # -- capacity ----------------------------------------------------------
     @property
     def per_shard_bytes(self) -> int:

@@ -57,6 +57,7 @@ reserved null block), per the TPU static-shape rule.
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 from collections import deque
@@ -66,9 +67,73 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .. import obs
+from .. import faults, obs
 from .block_pool import BlockPool, PoolExhausted
 from .prefix_cache import PrefixCache
+
+
+class EngineHungError(RuntimeError):
+    """A device dispatch exceeded the engine watchdog deadline: the
+    program is presumed wedged (driver hang, deadlocked collective, a
+    chaos `hang`).  The engine treats it exactly like a failed dispatch
+    — trace dump, then supervised restart when budget remains."""
+
+
+class _WatchdogSync:
+    """Deadline-bounded device->host sync.
+
+    A blocked ``np.asarray(device_array)`` cannot be interrupted from
+    Python, so the pull runs on a persistent helper thread and the
+    engine thread waits with a timeout.  On expiry the helper is
+    ORPHANED (it parks on the wedged pull; daemon, so it never blocks
+    exit) and the next sync spawns a fresh one — the restarted engine's
+    new pool makes the wedged program's eventual result irrelevant."""
+
+    def __init__(self, name: str = "pw-engine-watchdog"):
+        self._name = name
+        self._thread: threading.Thread | None = None
+        self._inbox = None
+
+    def _spawn(self) -> None:
+        import queue as _q
+
+        self._inbox = _q.Queue()
+        self._thread = threading.Thread(
+            target=self._loop, args=(self._inbox,), daemon=True,
+            name=self._name,
+        )
+        self._thread.start()
+
+    @staticmethod
+    def _loop(inbox) -> None:
+        while True:
+            job = inbox.get()
+            if job is None:
+                return  # orphaned after a timeout: wind down
+            fn, box = job
+            try:
+                box["result"] = fn()
+            except BaseException as exc:  # noqa: BLE001 - re-raised below
+                box["error"] = exc
+            box["done"].set()
+
+    def run(self, fn: Callable, timeout_s: float):
+        if self._thread is None or not self._thread.is_alive():
+            self._spawn()
+        box: dict = {"done": threading.Event(), "result": None, "error": None}
+        self._inbox.put((fn, box))
+        if not box["done"].wait(timeout_s):
+            # the helper is stuck inside fn(); abandon it (a None
+            # sentinel stops it if fn ever returns) and fail typed
+            self._inbox.put(None)
+            self._thread = None
+            raise EngineHungError(
+                f"device dispatch still blocked after {timeout_s}s "
+                "(watchdog deadline)"
+            )
+        if box["error"] is not None:
+            raise box["error"]
+        return box["result"]
 
 # jax.profiler.TraceAnnotation wraps every engine dispatch so XLA/TPU
 # profiles (jax.profiler.trace) line up with our flight-recorder spans;
@@ -184,7 +249,10 @@ class PagedDecodeEngine:
                  prefix_sharing: bool = True, stop_token: int | None = None,
                  attn: str | None = None, chunked_prefill: bool = True,
                  prefill_chunk: int | None = None, tp: int | None = None,
-                 chain_steps: int = 8, name: str = "paged_decoder"):
+                 chain_steps: int = 8, name: str = "paged_decoder",
+                 watchdog_timeout_s: float | None = None,
+                 max_restarts: int | None = None,
+                 degrade_fn: Callable | None = None):
         from ..models.encoder import _resolve_dtype
 
         self.cfg = cfg
@@ -206,12 +274,46 @@ class PagedDecodeEngine:
             params = shard_decoder_params(params, self.mesh)
         self.params = params
         head_dim = cfg.d_model // cfg.n_heads
-        self.pool = BlockPool(
+        # Round-13 failure domain: the pool's constructor args are kept so
+        # a supervised restart can rebuild it from scratch (a failed or
+        # hung dispatch may have consumed the donated K/V arrays)
+        self._pool_kwargs = dict(
             num_blocks=num_blocks, block_size=block_size,
             n_layers=cfg.n_layers, n_heads=cfg.n_heads, head_dim=head_dim,
             dtype=_resolve_dtype(cfg.dtype), name=name, mesh=self.mesh,
         )
+        self._prefix_sharing = bool(prefix_sharing)
+        self.pool = BlockPool(**self._pool_kwargs)
         self.prefix = PrefixCache(self.pool) if prefix_sharing else None
+        # watchdog + supervised restart (Round-13): a dispatch blocked
+        # past watchdog_timeout_s raises EngineHungError; any engine
+        # failure with restart budget left rebuilds the pool and
+        # re-admits every in-flight sequence by recompute over
+        # prompt + emitted — token-identical to an uninterrupted run
+        # (the same guarantee preemption-recompute already pins).  When
+        # the budget is exhausted, requests fail with a typed
+        # EngineFailedError — or complete through `degrade_fn(prompt,
+        # n_remaining, emitted)`, the degrade-to-host-tier handoff.
+        if watchdog_timeout_s is None:
+            env_wd = os.environ.get("PW_ENGINE_WATCHDOG_S")
+            watchdog_timeout_s = float(env_wd) if env_wd else None
+        self.watchdog_timeout_s = (
+            watchdog_timeout_s if watchdog_timeout_s
+            and watchdog_timeout_s > 0 else None
+        )
+        if max_restarts is None:
+            max_restarts = int(os.environ.get("PW_ENGINE_MAX_RESTARTS", "0")
+                               or 0)
+        self.max_restarts = max(0, int(max_restarts))
+        self.degrade_fn = degrade_fn
+        self._watchdog = (
+            _WatchdogSync(f"pw-watchdog-{name}")
+            if self.watchdog_timeout_s else None
+        )
+        # failure timestamp: set when a restartable failure is caught,
+        # cleared by the first token emitted after the restart — the
+        # failure -> first-recovered-token MTTR the bench reports
+        self._t_failure: float | None = None
         bs = self.pool.block_size
         cap = min((num_blocks - 1) * bs, cfg.max_len)
         if max_blocks_per_seq is None:
@@ -490,42 +592,204 @@ class PagedDecodeEngine:
         self._inflight_prefix.clear()
         # a dangling idle mark from the PREVIOUS batch's last sync would
         # bill the whole inter-batch wait to this batch's first dispatch
+        # (and a dangling failure mark would record the inter-batch wall
+        # clock as a bogus engine-recovery MTTR sample)
         self._t_device_idle = None
         self._t_dispatch = None
+        self._t_failure = None
         # engine-run trace: device-busy / host-gap / sync spans for this
         # run group under one root (requests keep their own traces)
         run_span = obs.start_span(
             "engine.run", ctx=(obs.new_trace_id(), 0), pool=self.pool.name,
         )
         self._run_ctx = run_span.ctx
-        try:
-            self._loop_body(running, pending, deliver, poll, stop)
-        except BaseException as exc:
-            self._inflight_prefix.clear()
-            # fail EVERYTHING still in flight before propagating: requests
-            # admitted via poll_inflight are owned by this engine, and
-            # leaving their waiters unset would hang submit() callers
-            # until timeout with a misleading deadline error
-            for act in running:
-                try:
-                    self.pool.free_sequence(act.seq_id)
-                except Exception:  # noqa: BLE001 - best-effort cleanup
-                    pass
-                deliver(act.req, exc)
-            while pending:
-                deliver(pending.popleft(), exc)
-            run_span.finish(error=type(exc).__name__)
-            # always-on flight recorder: an engine failure dumps the span
-            # timeline (Perfetto-loadable) AFTER the failure spans above
-            # landed, so the dump shows what led up to it and which
-            # requests it took down — even when the process is about to die
+        attempts_left = self.max_restarts
+        while True:
             try:
-                obs.recorder().dump_on_failure("engine_failure", exc)
-            except Exception:  # noqa: BLE001 - never mask the real error
-                pass
-            raise
+                self._loop_body(running, pending, deliver, poll, stop)
+                break
+            except BaseException as exc:
+                self._inflight_prefix.clear()
+                # supervised restart (Round-13): with budget left, a
+                # failed/hung dispatch rebuilds the pool and re-admits
+                # every in-flight sequence by recompute over
+                # prompt + emitted — the exact preemption-recompute path,
+                # so recovered output is token-identical to an
+                # uninterrupted run
+                if attempts_left > 0 and isinstance(exc, Exception):
+                    attempts_left -= 1
+                    try:
+                        obs.recorder().dump_on_failure("engine_failure", exc)
+                    except Exception:  # noqa: BLE001
+                        pass
+                    err_name, err_text = type(exc).__name__, str(exc)
+                    # the traceback's frames hold locals referencing the
+                    # dead pool; drop it so the rebuild can release the
+                    # old K/V arrays (and reclaim the pool's stats name)
+                    exc.__traceback__ = None
+                    try:
+                        self._restart(
+                            running, pending, err_name, err_text,
+                            attempt=self.max_restarts - attempts_left,
+                        )
+                        continue
+                    except BaseException as rexc:  # noqa: BLE001
+                        exc = rexc  # rebuild failed: budget is moot
+                # always-on flight recorder: the run span is closed with
+                # its error FIRST (so the dump shows the failed engine
+                # run), then the dump is written BEFORE the failure
+                # deliveries so _wrap_failure attaches THIS failure's
+                # dump path to every typed error (the 503 body points an
+                # operator at the right file) — only the per-request
+                # delivery-outcome spans land after the dump
+                run_span.finish(error=type(exc).__name__)
+                try:
+                    obs.recorder().dump_on_failure("engine_failure", exc)
+                except Exception:  # noqa: BLE001 - never mask the error
+                    pass
+                self._fail_all(running, pending, deliver, exc)
+                if not isinstance(exc, Exception):
+                    raise  # KeyboardInterrupt/SystemExit must propagate
+                # every request was delivered a per-request outcome above
+                # (typed EngineFailedError, or a degrade completion) —
+                # batch-origin callers see the typed error through the
+                # normal errors/results path, so re-raising the raw
+                # exception here would only destroy successfully degraded
+                # results
+                break
         run_span.finish()
         return running
+
+    # -- failure domain (Round-13) -----------------------------------------
+    def _restart(self, running, pending, err_name: str, err_text: str,
+                 attempt: int) -> None:
+        """Rebuild the failure domain: fresh BlockPool + PrefixCache
+        (the old pool's donated arrays may be consumed or backing a
+        wedged program), then every in-flight request rejoins the queue
+        carrying its emitted tokens — admission recomputes prefill over
+        prompt + emitted, token-identical by the preemption guarantee."""
+        import logging
+
+        self._t_failure = time.perf_counter()
+        t0 = self._t_failure
+        survivors = [act.req for act in running]
+        running.clear()
+        # requeue the survivors BEFORE attempting the rebuild: if the
+        # rebuild itself fails (e.g. device OOM while the wedged old
+        # program still pins HBM), the terminal _fail_all must still see
+        # every in-flight request — orphaning them would hang their
+        # waiters until timeout
+        for req in survivors:
+            self._requeue(pending, req)
+        # release the dead pool BEFORE constructing its replacement so
+        # the metrics name (and its monotonic counters) re-attach
+        self.prefix = None
+        old_pool = self.pool
+        old_pool.retire()
+        try:
+            self.pool = None
+            self.pool = BlockPool(**self._pool_kwargs)
+        except BaseException:
+            # keep a pool object attached: the terminal path still reads
+            # .stats (degrade accounting) and frees sequences through it
+            self.pool = old_pool
+            raise
+        self.prefix = (
+            PrefixCache(self.pool) if self._prefix_sharing else None
+        )
+        self._t_device_idle = None
+        self._t_dispatch = None
+        rebuild_s = time.perf_counter() - t0
+        self.pool.stats.record_engine_restart(rebuild_s)
+        obs.event(
+            "engine.restart", ctx=self._run_ctx, attempt=attempt,
+            error=err_name, rebuild_s=round(rebuild_s, 4),
+            inflight=len(survivors),
+        )
+        logging.getLogger(__name__).warning(
+            "engine restart #%d after %s: %s — pool rebuilt in %.3fs, "
+            "re-admitting %d in-flight sequence(s) by recompute",
+            attempt, err_name, err_text, rebuild_s, len(survivors),
+        )
+
+    def _fail_all(self, running, pending, deliver, exc: BaseException) -> None:
+        """Terminal failure: fail (or degrade) EVERYTHING still in
+        flight before propagating — requests admitted via poll_inflight
+        are owned by this engine, and leaving their waiters unset would
+        hang submit() callers until timeout with a misleading deadline
+        error.  With a ``degrade_fn``, each request is handed to the
+        cheaper tier instead (the serve degrade hook); waiters that
+        cannot degrade fail with a typed EngineFailedError carrying the
+        flight-recorder dump path."""
+        # terminal: no recovery is coming, so no first-token may close a
+        # recovery window against this failure timestamp
+        self._t_failure = None
+        for act in running:
+            try:
+                self.pool.free_sequence(act.seq_id)
+            except Exception:  # noqa: BLE001 - best-effort cleanup
+                pass
+        reqs = [act.req for act in running] + list(pending)
+        running.clear()
+        pending.clear()
+        wrapped = self._wrap_failure(exc)
+        # degrade only on real engine failures: a KeyboardInterrupt /
+        # SystemExit must propagate promptly, not block on minutes of
+        # serial host decode first
+        degrade = self.degrade_fn is not None and isinstance(exc, Exception)
+        for req in reqs:
+            if degrade and self._try_degrade(req, deliver):
+                continue
+            deliver(req, wrapped)
+
+    def _wrap_failure(self, exc: BaseException):
+        from ..serve.admission import EngineFailedError
+
+        dump = getattr(obs.recorder(), "last_dump_path", None)
+        budget = (
+            f" after {self.max_restarts} restart(s)" if self.max_restarts
+            else ""
+        )
+        return EngineFailedError(
+            f"decode engine failed{budget}: {type(exc).__name__}: {exc}",
+            retry_after_s=5.0, trace_id=self._run_ctx[0], dump_path=dump,
+        )
+
+    def _try_degrade(self, req: _Request, deliver) -> bool:
+        """Degrade-to-host-tier handoff: complete one stranded request
+        through ``degrade_fn(prompt, n_remaining, emitted)`` (the serial
+        tier).  Tokens already emitted by the dead engine are kept —
+        the degrade tier continues the sequence, it does not restart
+        it."""
+        import logging
+
+        try:
+            remaining = req.max_new - len(req.emitted)
+            if remaining > 0 and (
+                req.stop_token is None
+                or req.stop_token not in req.emitted
+            ):
+                extra = self.degrade_fn(
+                    list(req.prompt), remaining, list(req.emitted)
+                )
+                for t in list(extra)[:remaining]:
+                    req.emitted.append(int(t))
+                    if req.stop_token is not None \
+                            and int(t) == req.stop_token:
+                        break  # same EOS truncation as _scan_chain
+        except Exception as dexc:  # noqa: BLE001 - fall back to failing
+            logging.getLogger(__name__).warning(
+                "degrade tier failed for a stranded request (%s); "
+                "failing it typed instead", dexc,
+            )
+            return False
+        # delivery happens OUTSIDE the try: a raising on_done callback
+        # must propagate (as on the normal path), not convert an
+        # already-delivered success into a second on_error delivery
+        obs.event("engine.degraded", ctx=req.ctx, emitted=len(req.emitted))
+        self.pool.stats.record_engine_degrade()
+        deliver(req)
+        return True
 
     def _admit_arrivals(self, running, pending, poll, stop) -> None:
         """Step-boundary admission of newly arrived requests into the
@@ -634,6 +898,25 @@ class PagedDecodeEngine:
             self.pool.stats.record_ttft(
                 time.perf_counter() - req.t_arrival
             )
+        if self._t_failure is not None:
+            # first token after a supervised restart: the
+            # failure -> first-recovered-token window (engine_restart_s)
+            self.pool.stats.record_engine_recovery(
+                time.perf_counter() - self._t_failure
+            )
+            self._t_failure = None
+
+    def _sync_host(self, dev_array) -> np.ndarray:
+        """Device->host sync, watchdog-bounded when configured.  The
+        `engine.sync` fault point lives INSIDE the pull so a chaos
+        `hang` wedges exactly where a stuck device program would."""
+        def pull():
+            faults.fire("engine.sync")
+            return np.asarray(dev_array)
+
+        if self._watchdog is None:
+            return pull()
+        return self._watchdog.run(pull, self.watchdog_timeout_s)
 
     # -- admission ---------------------------------------------------------
     def _try_admit(self, req: _Request, running, pending, deliver) -> str:
@@ -768,6 +1051,7 @@ class PagedDecodeEngine:
             # perturb its remaining decode
             scatter_bt = self.pool.block_table(seq_id, nb)
             scatter_bt[: len(shared)] = 0
+            faults.fire("engine.dispatch.prefill")
             self._note_dispatch("prefill")
             with _TraceAnnotation("pw.prefill"):
                 ids, self.pool.k, self.pool.v = self._prefill(
@@ -776,6 +1060,10 @@ class PagedDecodeEngine:
                     self.pool.k, self.pool.v,
                     jnp.asarray(scatter_bt[None, :]),
                 )
+            # the sync stays INSIDE the failure cleanup: a hung/failed
+            # sync (watchdog) with no restart budget must not leak the
+            # just-prefilled blocks for the engine's lifetime
+            first_id = int(self._sync_host(ids)[0])
             if self.prefix is not None:
                 # zip inside insert() truncates to the full-block keys, so
                 # a partial tail block (the live decode-write target) is
@@ -787,7 +1075,6 @@ class PagedDecodeEngine:
             # engine's (process-long) lifetime
             self.pool.free_sequence(seq_id)
             raise
-        first_id = int(np.asarray(ids)[0])
         self._note_sync()
         self._emit(req, first_id)
         act = _Active(seq_id, req)
@@ -905,6 +1192,7 @@ class PagedDecodeEngine:
             bt[i, : len(seq.block_ids)] = seq.block_ids
             acts.append(act)
             kreal.append(len(slots))
+        faults.fire("engine.dispatch.chain")
         self._note_dispatch("chain")
         t_disp = self._t_dispatch
         with _TraceAnnotation("pw.chain_dispatch"):
@@ -973,7 +1261,7 @@ class PagedDecodeEngine:
             self._admit_arrivals(running, pending, poll, stop)
             acts, kreal, ids_dev, t_disp = inflight
             t_sync0 = time.perf_counter()
-            ids_np = np.asarray(ids_dev)  # ONE sync per K-token chain
+            ids_np = self._sync_host(ids_dev)  # ONE sync per K-token chain
             t_sync1 = time.perf_counter()
             # the host-blocked-on-device window (a subset of the
             # device-busy span _note_sync closes below)
@@ -992,7 +1280,17 @@ class PagedDecodeEngine:
             nxt = None
             if running and not pending \
                     and self._chain_headroom(running) >= 2:
-                nxt = self._dispatch_chain(running, pending)
+                try:
+                    nxt = self._dispatch_chain(running, pending)
+                except BaseException:
+                    # the overlapped dispatch failed AFTER chain N's
+                    # finished rows left `running` but BEFORE their
+                    # deliveries below ran — deliver them now or the
+                    # failure path (restart or fail-all) loses completed
+                    # requests it can no longer see
+                    for act in done:
+                        deliver(act.req)
+                    raise
             # overlap: chain N's completion bookkeeping runs while the
             # device executes chain N+1 (the _note_sync/_note_dispatch
             # pair above already closed the device-idle window, so this
@@ -1023,6 +1321,7 @@ class PagedDecodeEngine:
             sb[i] = blk
             so[i] = off
             bt[i, : len(seq.block_ids)] = seq.block_ids
+        faults.fire("engine.dispatch.step")
         self._note_dispatch("step")
         t_disp = self._t_dispatch
         with _TraceAnnotation("pw.decode_step"):
@@ -1032,7 +1331,7 @@ class PagedDecodeEngine:
                 jnp.asarray(so),
             )
         t_sync0 = time.perf_counter()
-        ids = np.asarray(ids)
+        ids = self._sync_host(ids)
         t_sync1 = time.perf_counter()
         obs.record_span("engine.sync", t_sync0, t_sync1, ctx=self._run_ctx)
         self._note_sync()
@@ -1151,6 +1450,7 @@ class PagedDecodeEngine:
             raise RuntimeError(
                 "ragged step produced no rows (gated chunk cycle?)"
             )
+        faults.fire("engine.dispatch.mixed")
         self._note_dispatch("mixed")
         t_disp = self._t_dispatch
         with _TraceAnnotation("pw.mixed_step"):
@@ -1163,7 +1463,7 @@ class PagedDecodeEngine:
                 jnp.asarray(logit_idx),
             )
         t_sync0 = time.perf_counter()
-        ids = np.asarray(ids)
+        ids = self._sync_host(ids)
         t_sync1 = time.perf_counter()
         obs.record_span("engine.sync", t_sync0, t_sync1, ctx=self._run_ctx)
         self._note_sync()

@@ -199,6 +199,17 @@ class Int8DecoderHost:
             from ..kvcache.engine import build_engine
 
             kwargs.setdefault("name", "host_decoder_kv")
+            # Round-13: when the engine's supervised restarts are
+            # exhausted, stranded requests hand off to THIS host's serial
+            # int8 tier (the degrade-to-host-tier path) — tokens the dead
+            # engine already emitted are kept, the serial tier continues
+            # the sequence over prompt + emitted
+            kwargs.setdefault(
+                "degrade_fn",
+                lambda prompt, n_remaining, emitted: self.generate(
+                    list(prompt) + list(emitted), n_remaining
+                ),
+            )
             engine = build_engine(
                 self.cfg, self._jax_params,
                 "serving falls back to serialized batch-1 decode",

@@ -50,13 +50,13 @@ import time as _time
 from collections import defaultdict
 from typing import Any, Callable
 
-from .. import obs
+from .. import faults, obs
 from ..engine import runner as runner_mod
 from ..engine.graph import Operator
 from ..engine.types import CapturedStream, Update
 from ..internals import parse_graph as pg
 from .sharded import ShardRouter, edge_router, _BROADCAST, _CENTRAL, _SHARD_BY_KEY
-from .comm import Fabric
+from .comm import ClusterAborted, Fabric, FabricError
 from . import mapreduce
 
 # node kinds whose output keys equal their input keys, so key-routed
@@ -162,6 +162,7 @@ class ClusterRunner:
         self.fabric: Fabric | None = None
         if nprocs > 1:
             self.fabric = Fabric(pid, nprocs, first_port)
+        self._aborted = False
         # outstanding pipelined min-agreement round (posted report), if any
         self._agree_pending: tuple | None = None
         # data-plane trace: per-round spans (run_time / agree_min) for
@@ -339,6 +340,9 @@ class ClusterRunner:
                 # counts; the wait count-proves every peer's exchange
                 # point instead of blocking on a FIFO mark frame queued
                 # behind bulk data
+                # chaos: fabric.mark is the "die mid-exchange" fault
+                # point (fire() early-returns cheaply when nothing armed)
+                faults.fire("fabric.mark", time=t, pos=pos)
                 self.fabric.post_mark(t, pos)
                 self.fabric.wait_marks(t, pos)
                 for producer, seq, port, shard, updates in self.fabric.take_data(t, pos):
@@ -555,8 +559,55 @@ class ClusterRunner:
                 static_srcs.append((idx, source))
         return static_srcs, live_srcs
 
+    # -- coordinated abort (Round-13) --------------------------------------
+    def _abort(self, exc: BaseException) -> None:
+        """Failure path for a cluster run: poison every peer (so the
+        whole mesh aborts at its current protocol point instead of each
+        survivor timing out alone), dump fabric stats + the flight
+        recorder, and close the fabric.  The original typed error
+        (PeerLostError / ClusterAborted / whatever the operator raised)
+        propagates to the caller unchanged."""
+        if self._aborted or self.fabric is None:
+            return
+        self._aborted = True
+        import logging
+
+        logging.getLogger(__name__).error(
+            "pid %d aborting cluster run: %s: %s",
+            self.pid, type(exc).__name__, exc,
+        )
+        try:
+            if not isinstance(exc, ClusterAborted):
+                # a ClusterAborted means a peer already poisoned the mesh
+                self.fabric.poison(
+                    f"pid {self.pid}: {type(exc).__name__}: {exc}"
+                )
+        except Exception:  # noqa: BLE001 - abort is best-effort
+            pass
+        try:
+            _dump_fabric_stats(self.fabric, self.pid)
+        except Exception:  # noqa: BLE001
+            pass
+        try:
+            obs.recorder().dump_on_failure("cluster_abort", exc)
+        except Exception:  # noqa: BLE001
+            pass
+        try:
+            self.fabric.close()
+        except Exception:  # noqa: BLE001
+            pass
+
     # -- public entry points ----------------------------------------------
     def run_batch(self) -> dict[int, CapturedStream]:
+        try:
+            return self._run_batch_inner()
+        except SystemExit:
+            raise
+        except BaseException as exc:
+            self._abort(exc)
+            raise
+
+    def _run_batch_inner(self) -> dict[int, CapturedStream]:
         static_srcs, live_srcs = self._prepare_sources()
         for idx, source in static_srcs:
             self._inject(idx, source.static_events(), exclusive=False)
@@ -570,6 +621,23 @@ class ClusterRunner:
         return self.captures
 
     def run_streaming(
+        self,
+        autocommit_ms: int = 50,
+        timeout_s: float | None = None,
+        idle_stop_s: float | None = None,
+    ) -> dict[int, CapturedStream]:
+        try:
+            return self._run_streaming_inner(
+                autocommit_ms=autocommit_ms, timeout_s=timeout_s,
+                idle_stop_s=idle_stop_s,
+            )
+        except SystemExit:
+            raise
+        except BaseException as exc:
+            self._abort(exc)
+            raise
+
+    def _run_streaming_inner(
         self,
         autocommit_ms: int = 50,
         timeout_s: float | None = None,
@@ -596,6 +664,14 @@ class ClusterRunner:
         # total live sources across the cluster (for the finish decision)
         n_live_total = self._sum_across(len(live_srcs))
         prev_active = True
+        # Round-13: the run-deadline stop decision is AGREED, not local.
+        # Every process reports its own elapsed wall clock in the
+        # per-round gather; the coordinator finishes when the cluster-wide
+        # MAX elapsed passes timeout_s and broadcasts the single finish
+        # command — so all peers stop at the same agreed tick instead of
+        # racing their own clocks (a worker whose clock started earlier
+        # can no longer observe its own deadline mid-protocol).
+        peers_elapsed = 0.0
         while True:
             loop_t0 = _time.monotonic()
             # coordinator decides the tick; everyone else follows
@@ -605,8 +681,9 @@ class ClusterRunner:
                     slept = autocommit_ms / 1000.0
                     _time.sleep(slept)
                 now = _time.monotonic()
+                elapsed = max(now - start, peers_elapsed)
                 cmd: tuple
-                if timeout_s is not None and now - start > timeout_s:
+                if timeout_s is not None and elapsed > timeout_s:
                     cmd = ("finish",)
                 elif idle_stop_s is not None and now - last_event > idle_stop_s:
                     cmd = ("finish",)
@@ -658,9 +735,11 @@ class ClusterRunner:
             mgr = getattr(self, "_snapshot_mgr", None)
             if mgr is not None and len(cmd) > 2 and cmd[2]:
                 mgr.snapshot()
-            # gather round state
+            # gather round state (incl. each process's elapsed clock —
+            # the agreed-deadline input for the next round's decision)
             reports = self._gather(
-                (len(finished), got_any, has_completions, self.frontier)
+                (len(finished), got_any, has_completions, self.frontier,
+                 _time.monotonic() - start)
             )
             if self.pid == 0:
                 assert reports is not None
@@ -668,6 +747,7 @@ class ClusterRunner:
                 any_events = any(r[1] for r in reports)
                 any_comps = any(r[2] for r in reports)
                 global_frontier = max(r[3] for r in reports)
+                peers_elapsed = max(r[4] for r in reports)
                 prev_active = any_events or any_comps
                 if any_events:
                     last_event = _time.monotonic()

@@ -61,7 +61,7 @@ import time as _time
 from collections import defaultdict, deque
 from typing import Any
 
-from .. import obs
+from .. import faults, obs
 
 _LEN = struct.Struct("<I")
 
@@ -82,6 +82,20 @@ _DELAY_PID_ENV = "PW_FABRIC_DELAY_PID"
 # producer so memory stays bounded under a slow peer
 _MAX_QUEUED_FRAMES = 8192
 
+# data frames drained per sender cycle: bounds how long one encode+write
+# window can starve the ctl lane (heartbeats) — see _PeerSender.run
+_MAX_DRAIN_FRAMES = 1024
+
+# Round-13 liveness knobs: heartbeats ride the ctl lane every
+# PW_FABRIC_HEARTBEAT_S (0 disables); a peer silent for longer than
+# PW_FABRIC_PEER_TIMEOUT_S while this process is blocked on it raises a
+# typed PeerLostError; PW_FABRIC_WAIT_TIMEOUT_S bounds EVERY blocking
+# protocol recv (mark/eot/ctl) so a lost-but-undiagnosed frame (a chaos
+# `drop`, a half-open connection) can never hang the mesh forever.
+_HB_ENV = "PW_FABRIC_HEARTBEAT_S"
+_PEER_TIMEOUT_ENV = "PW_FABRIC_PEER_TIMEOUT_S"
+_WAIT_TIMEOUT_ENV = "PW_FABRIC_WAIT_TIMEOUT_S"
+
 
 def _fabric_secret() -> bytes | None:
     s = os.environ.get(_SECRET_ENV)
@@ -90,6 +104,36 @@ def _fabric_secret() -> bytes | None:
 
 class FabricError(RuntimeError):
     pass
+
+
+class PeerLostError(FabricError):
+    """A peer process is gone (disconnected, silent past the heartbeat
+    deadline, or its exchange frames never arrived) while this process
+    was blocked on it.  Typed so supervisors and tests can tell a
+    liveness failure from a protocol bug, and carries WHAT the caller
+    was blocked on so the abort point is attributable."""
+
+    def __init__(self, peer: int, waiting_on: str, detail: str = ""):
+        self.peer = peer
+        self.waiting_on = waiting_on
+        msg = f"peer {peer} lost while waiting on {waiting_on}"
+        if detail:
+            msg += f": {detail}"
+        super().__init__(msg)
+
+
+class ClusterAborted(FabricError):
+    """A peer broadcast a poison frame: it hit a failure and the whole
+    mesh is aborting at a consistent point.  Survivors raise this from
+    every blocking fabric call instead of timing out one by one."""
+
+    def __init__(self, reason: str):
+        self.reason = reason
+        super().__init__(f"cluster aborted by a peer: {reason}")
+
+
+class _FaultClose(Exception):
+    """Internal: a chaos `close` action severed this sender's socket."""
 
 
 class _PeerSender(threading.Thread):
@@ -130,6 +174,7 @@ class _PeerSender(threading.Thread):
                 len(self.data) >= _MAX_QUEUED_FRAMES
                 and not self.stopped
                 and self.fabric._dead is None
+                and self.fabric._poisoned is None
             ):
                 self.cond.wait(timeout=0.5)
             self.fabric._check()
@@ -140,6 +185,8 @@ class _PeerSender(threading.Thread):
     def put_ctl(self, item: tuple) -> None:
         with self.cond:
             self.fabric._check()
+            if item[0] == "h" and any(old[0] == "h" for old in self.ctl):
+                return  # one pending heartbeat is as good as many
             if item[0] == "M":
                 # coalesce: one pending mark per logical time — the newest
                 # cursor/counts supersede (both monotone per time)
@@ -197,8 +244,17 @@ class _PeerSender(threading.Thread):
                         return
                     ctl_batch = list(self.ctl)
                     self.ctl.clear()
-                    data_batch = list(self.data)
-                    self.data.clear()
+                    # Round-13: cap the data drained per cycle — a
+                    # near-full queue encoded as ONE payload would write
+                    # nothing (heartbeats included) for the whole pickle
+                    # window, tripping peers' liveness deadlines on a
+                    # healthy loaded mesh.  Leftovers stay queued (FIFO);
+                    # idle stays False so flush() still waits them out.
+                    data_batch = [
+                        self.data.popleft()
+                        for _ in range(min(len(self.data),
+                                           _MAX_DRAIN_FRAMES))
+                    ]
                     self.idle = False
                     self.fabric.stats["sender_queue_depth"] = (
                         self._total_depth()
@@ -206,9 +262,27 @@ class _PeerSender(threading.Thread):
                     self.cond.notify_all()
                 if self.delay_s:
                     _time.sleep(self.delay_s)
+                # chaos harness (faults.py): when any fault is armed,
+                # every logical frame passes a fabric.send.{ctl,data}
+                # fault point (delay/drop/close).  Checked per CYCLE —
+                # cheap enough off the compute thread, and late
+                # faults.install() calls are honored
+                if faults.active():
+                    ctl_batch, data_batch = self._apply_chaos(
+                        ctl_batch, data_batch
+                    )
                 t0 = _time.perf_counter()
-                frames = [self._encode_ctl(it) for it in ctl_batch]
-                frames.extend(self._coalesce(data_batch))
+                # ctl lane written FIRST as its own payload: heartbeats
+                # and marks hit the wire before this cycle's (possibly
+                # large) data encode+write, keeping liveness signals
+                # flowing while bulk frames serialize
+                ctl_frames = [self._encode_ctl(it) for it in ctl_batch]
+                ctl_payload = b"".join(
+                    _LEN.pack(len(b)) + b for b in ctl_frames
+                )
+                if ctl_payload:
+                    self.sock.sendall(ctl_payload)
+                frames = self._coalesce(data_batch)
                 payload = b"".join(
                     _LEN.pack(len(b)) + b for b in frames
                 )
@@ -218,11 +292,26 @@ class _PeerSender(threading.Thread):
                 with self.fabric._cond:
                     st["sender_s"] += _time.perf_counter() - t0
                     st["sender_flushes"] += 1
-                    st["send_count"] += len(frames)
-                    st["send_bytes"] += len(payload)
+                    st["send_count"] += len(ctl_frames) + len(frames)
+                    st["send_bytes"] += len(ctl_payload) + len(payload)
                 with self.cond:
                     self.idle = True
                     self.cond.notify_all()
+        except _FaultClose:
+            # chaos `close`: sever the connection abruptly, exactly like
+            # a mid-run network partition — both directions die (the
+            # peer sees EOF; our recv loop errors on the same socket)
+            try:
+                self.sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                self.sock.close()
+            except OSError:
+                pass
+            self.fabric._sender_died(
+                self.peer, ConnectionResetError("fault-injected close")
+            )
         except Exception as exc:  # noqa: BLE001 — pickling moved off the
             # compute thread, so a serialization failure (unpicklable
             # update value) surfaces HERE now; it must kill the fabric
@@ -233,6 +322,32 @@ class _PeerSender(threading.Thread):
                 self.idle = True
                 self.stopped = True
                 self.cond.notify_all()
+
+    def _apply_chaos(self, ctl_batch: list, data_batch: list
+                     ) -> tuple[list, list]:
+        """Pass every logical frame through its fault point.  `drop`
+        silently discards the frame (its announced count is never
+        satisfied — the receiver's wait deadline converts that into a
+        typed PeerLostError); `close` severs the socket; `delay` already
+        slept inside fire()."""
+        ctl_kept: list = []
+        for it in ctl_batch:
+            act = faults.fire("fabric.send.ctl", peer=self.peer, kind=it[0])
+            if act == "drop":
+                continue
+            if act == "close":
+                raise _FaultClose()
+            ctl_kept.append(it)
+        data_kept: list = []
+        for it in data_batch:
+            act = faults.fire("fabric.send.data", peer=self.peer,
+                              time=it[1], pos=it[2])
+            if act == "drop":
+                continue
+            if act == "close":
+                raise _FaultClose()
+            data_kept.append(it)
+        return ctl_kept, data_kept
 
     @staticmethod
     def _encode_ctl(item: tuple) -> bytes:
@@ -303,7 +418,26 @@ class Fabric:
         self._done_peers: set[int] = set()  # peers past their shutdown barrier
         self._ctl: "queue.Queue[Any]" = queue.Queue()
         self._dead: str | None = None
+        self._dead_peer: int | None = None  # which peer killed the fabric
+        self._poisoned: str | None = None  # a peer's coordinated-abort reason
         self._closed = False
+        # Round-13 liveness: heartbeats on the ctl lane + a deadline on
+        # every blocking recv.  _last_seen[peer] advances on ANY frame
+        # from the peer; a peer silent past _peer_timeout_s while this
+        # process is blocked on it raises PeerLostError instead of
+        # hanging the mesh.
+        self._hb_interval = float(os.environ.get(_HB_ENV, "2.0") or 0.0)
+        self._peer_timeout_s = float(
+            os.environ.get(_PEER_TIMEOUT_ENV, "15.0") or 0.0
+        )
+        wait_to = float(os.environ.get(_WAIT_TIMEOUT_ENV, "120") or 120.0)
+        # 0 disables, like the sibling liveness knobs — an operator
+        # opting out of the barrier deadline must not get an
+        # instantly-expiring one
+        self._wait_timeout_s = wait_to if wait_to > 0 else float("inf")
+        self._last_seen: dict[int, float] = {
+            p: _time.monotonic() for p in self.peers
+        }
         # observability (VERDICT r3): where exchange wall-time goes.
         # Round-12 split: send_s is the COMPUTE thread's enqueue cost
         # (including backpressure blocking); sender_s is the sender
@@ -354,6 +488,30 @@ class Fabric:
             self._threads.append(th)
         for snd in self._senders.values():
             snd.start()
+        self._hb_thread = None
+        if self._hb_interval > 0 and self.peers:
+            self._hb_thread = threading.Thread(
+                target=self._heartbeat_loop, daemon=True,
+                name="pw-fabric-hb",
+            )
+            self._hb_thread.start()
+
+    def _heartbeat_loop(self) -> None:
+        """Keep-alive on the ctl lane: proves this process is making
+        progress even when it has no protocol traffic (a long compute
+        stretch, an idle streaming worker), so peers blocked on us can
+        tell 'slow' from 'dead'."""
+        while True:
+            _time.sleep(self._hb_interval)
+            with self._cond:
+                if (self._closed or self._dead is not None
+                        or self._poisoned is not None):
+                    return
+            for snd in self._senders.values():
+                try:
+                    snd.put_ctl(("h",))
+                except FabricError:
+                    return
 
     def _bump(self, key: str, n: int) -> None:
         with self._cond:
@@ -507,6 +665,7 @@ class Fabric:
         with self._cond:
             if not self._closed and peer not in self._done_peers:
                 self._dead = f"send path to peer {peer} failed: {exc!r}"
+                self._dead_peer = peer
                 self._ctl.put(("__peer_lost__", peer))
             self._cond.notify_all()
         for snd in self._senders.values():
@@ -602,6 +761,8 @@ class Fabric:
                 break
             self.stats["recv_count"] += 1
             self.stats["recv_bytes"] += len(blob) + _LEN.size
+            # any frame proves the peer is alive (GIL-atomic store)
+            self._last_seen[peer] = _time.monotonic()
             msg = pickle.loads(blob)
             kind = msg[0]
             if kind == "d":
@@ -643,22 +804,74 @@ class Fabric:
                     self._cond.notify_all()
             elif kind == "c":
                 self._ctl.put(msg[1])
+            elif kind == "h":
+                pass  # heartbeat: _last_seen above is the whole payload
+            elif kind == "p":
+                # coordinated abort: a peer failed and poisoned the mesh —
+                # every blocking wait on this process raises ClusterAborted
+                # from here on, and anyone blocked right now wakes up
+                with self._cond:
+                    if self._poisoned is None:
+                        self._poisoned = str(msg[1])
+                    self._ctl.put(("__poison__", self._poisoned))
+                    self._cond.notify_all()
+                for snd in self._senders.values():
+                    with snd.cond:
+                        snd.cond.notify_all()
         with self._cond:
             if not self._closed and peer not in self._done_peers:
                 self._dead = f"peer {peer} disconnected"
+                self._dead_peer = peer
                 self._ctl.put(("__peer_lost__", peer))
             self._cond.notify_all()
         for snd in self._senders.values():
             with snd.cond:
                 snd.cond.notify_all()
 
-    def _check_locked(self) -> None:
+    def _check_locked(self, waiting_on: str = "fabric") -> None:
+        if self._poisoned is not None:
+            raise ClusterAborted(self._poisoned)
         if self._dead is not None:
+            if self._dead_peer is not None:
+                raise PeerLostError(self._dead_peer, waiting_on, self._dead)
             raise FabricError(self._dead)
 
-    def _check(self) -> None:
-        if self._dead is not None:
-            raise FabricError(self._dead)
+    def _check(self, waiting_on: str = "fabric") -> None:
+        self._check_locked(waiting_on)
+
+    def _peer_stalled_locked(self, peer: int,
+                             waiting_on: str) -> PeerLostError | None:
+        """Liveness verdict for one peer this process is blocked on:
+        silent past the heartbeat deadline => PeerLostError (None while
+        heartbeats are disabled or the peer is within deadline)."""
+        if self._hb_interval <= 0 or self._peer_timeout_s <= 0:
+            return None
+        age = _time.monotonic() - self._last_seen.get(peer, 0.0)
+        if age <= self._peer_timeout_s:
+            return None
+        return PeerLostError(
+            peer, waiting_on,
+            f"no frames for {age:.1f}s (deadline {self._peer_timeout_s}s)",
+        )
+
+    def poison(self, reason: str) -> None:
+        """Broadcast a coordinated-abort frame to every peer (best
+        effort; bypasses the dead-fabric check — the whole point is that
+        something already failed).  Survivors raise ClusterAborted from
+        their current blocking wait instead of each timing out alone."""
+        for snd in self._senders.values():
+            try:
+                with snd.cond:
+                    if snd.stopped:
+                        continue
+                    snd.ctl.append(("p", reason))
+                    snd.cond.notify_all()
+            except Exception:  # noqa: BLE001 - poison is best-effort
+                pass
+        try:
+            self.flush(timeout_s=5.0)
+        except Exception:  # noqa: BLE001 - a dead sender cannot flush
+            pass
 
     # -- counted mark-point wait -------------------------------------------
     def _mark_ready(self, peer: int, time: int, pos: int) -> bool:
@@ -673,7 +886,8 @@ class Fabric:
         need = ann.get(pos, 0)
         return self._recv_pos_counts[(peer, time, pos)] >= need
 
-    def wait_marks(self, time: int, pos: int, timeout_s: float = 120.0) -> None:
+    def wait_marks(self, time: int, pos: int,
+                   timeout_s: float | None = None) -> None:
         """Block until every peer's (time, pos) exchange point is
         count-proven complete (cursor >= pos and announced-frame counts
         matched).  Quiet points complete on the control-lane mark alone;
@@ -682,7 +896,17 @@ class Fabric:
         Round-11: the wait is attributed PER PEER — each peer's
         ``wait_marks_s_p<pid>`` accumulates how long it kept this process
         at the barrier, so a 2-proc `wait_marks_s` spike names its
-        straggler — and waits land as ``fabric.wait_marks`` spans."""
+        straggler — and waits land as ``fabric.wait_marks`` spans.
+
+        Round-13: the wait is DEADLINED.  A peer silent past the
+        heartbeat deadline, or an exchange point still incomplete at
+        ``timeout_s`` (default ``PW_FABRIC_WAIT_TIMEOUT_S``), raises a
+        typed :class:`PeerLostError` naming the peer and the barrier —
+        a dropped frame or dead process aborts the mesh instead of
+        hanging it."""
+        waiting_on = f"marks(t={time}, pos={pos})"
+        if timeout_s is None:
+            timeout_s = self._wait_timeout_s
         deadline = _time.monotonic() + timeout_s
         t0 = _time.perf_counter()
         remaining = set(self.peers)
@@ -700,15 +924,23 @@ class Fabric:
                     obs.record_span("fabric.wait_marks", t0, now,
                                     ctx=self._obs_ctx, time=time, pos=pos)
                     return
-                self._check_locked()
+                self._check_locked(waiting_on)
+                for p in remaining:
+                    err = self._peer_stalled_locked(p, waiting_on)
+                    if err is not None:
+                        raise err
                 if not self._cond.wait(timeout=min(1.0, deadline - _time.monotonic())):
                     if _time.monotonic() > deadline:
-                        raise FabricError(
-                            f"pid {self.pid}: mark barrier timeout at "
-                            f"(t={time}, pos={pos})"
+                        raise PeerLostError(
+                            min(remaining), waiting_on,
+                            f"barrier still incomplete after {timeout_s}s "
+                            f"(peers {sorted(remaining)})",
                         )
 
-    def wait_eot(self, time: int, timeout_s: float = 120.0) -> None:
+    def wait_eot(self, time: int, timeout_s: float | None = None) -> None:
+        waiting_on = f"eot(t={time})"
+        if timeout_s is None:
+            timeout_s = self._wait_timeout_s
         deadline = _time.monotonic() + timeout_s
         t0 = _time.perf_counter()
         with self._cond:
@@ -720,11 +952,21 @@ class Fabric:
                         self._marks[p].pop(time, None)
                     self.stats["wait_eot_s"] += _time.perf_counter() - t0
                     return
-                self._check_locked()
+                self._check_locked(waiting_on)
+                for p in self.peers:
+                    if (p, time) in self._eot:
+                        continue
+                    err = self._peer_stalled_locked(p, waiting_on)
+                    if err is not None:
+                        raise err
                 if not self._cond.wait(timeout=min(1.0, deadline - _time.monotonic())):
                     if _time.monotonic() > deadline:
-                        raise FabricError(
-                            f"pid {self.pid}: eot barrier timeout at t={time}"
+                        stalled = [p for p in self.peers
+                                   if (p, time) not in self._eot]
+                        raise PeerLostError(
+                            min(stalled) if stalled else -1, waiting_on,
+                            f"eot barrier still incomplete after "
+                            f"{timeout_s}s (peers {sorted(stalled)})",
                         )
 
     # -- vouched sends (round-12 progress/EOT accounting) ------------------
@@ -776,22 +1018,50 @@ class Fabric:
         batches.sort(key=lambda b: (b[0], b[1]))  # (producer, seq)
         return batches
 
-    def recv_ctl(self, timeout_s: float = 120.0) -> Any:
+    def recv_ctl(self, timeout_s: float | None = None,
+                 waiting_on: str = "ctl") -> Any:
         # NOTE: no blanket wait_ctl_s accounting here — a streaming
         # worker blocks in recv_ctl waiting for the coordinator's next
         # TICK (idle scheduling, not round cost), which would swamp the
         # time split.  ClusterRunner._timed_recv_ctl bills its waits to
         # an explicit stat (wait_ctl_s inside the min round, wait_sync_s
         # for gather/broadcast rendezvous).
-        try:
-            msg = self._ctl.get(timeout=timeout_s)
-        except queue.Empty:
-            raise FabricError(f"pid {self.pid}: ctl recv timeout")
-        if isinstance(msg, tuple) and msg and msg[0] == "__peer_lost__":
-            if self._closed:
-                raise FabricError("fabric closed")
-            raise FabricError(f"peer {msg[1]} disconnected")
-        return msg
+        #
+        # Round-13: the blocking get polls in 1s slices so peer-liveness
+        # and poison are checked while waiting — a dead coordinator (or
+        # a poisoned mesh) raises typed within the heartbeat deadline
+        # instead of sitting out the full ctl timeout.
+        if timeout_s is None:
+            timeout_s = self._wait_timeout_s
+        deadline = _time.monotonic() + timeout_s
+        while True:
+            try:
+                msg = self._ctl.get(
+                    timeout=min(1.0, max(deadline - _time.monotonic(), 0.01))
+                )
+            except queue.Empty:
+                with self._cond:
+                    if self._poisoned is not None:
+                        raise ClusterAborted(self._poisoned)
+                    for p in self.peers:
+                        if p in self._done_peers:
+                            continue
+                        err = self._peer_stalled_locked(p, waiting_on)
+                        if err is not None:
+                            raise err
+                if _time.monotonic() > deadline:
+                    raise FabricError(
+                        f"pid {self.pid}: ctl recv timeout "
+                        f"(waiting on {waiting_on})"
+                    )
+                continue
+            if isinstance(msg, tuple) and msg and msg[0] == "__peer_lost__":
+                if self._closed:
+                    raise FabricError("fabric closed")
+                raise PeerLostError(msg[1], waiting_on, "peer disconnected")
+            if isinstance(msg, tuple) and msg and msg[0] == "__poison__":
+                raise ClusterAborted(str(msg[1]))
+            return msg
 
     _SHUTDOWN_T = -(1 << 62)
 

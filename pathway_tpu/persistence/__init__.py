@@ -736,12 +736,22 @@ def _wrap_source_with_persistence(source, backend: Backend, stream: str,
     def _append(events, offsets):
         import time as _t
 
+        from .. import faults
+
+        # chaos points (Round-13): `persistence.append` fires BEFORE the
+        # journal write (kill here = die mid-ingest, the row was consumed
+        # but never journaled); `persistence.commit` fires AFTER it (kill
+        # here = die post-commit, journaled but its effects never
+        # flushed).  Exactly-once replay must survive both; a `raise` on
+        # append models a failing backend.
+        faults.fire("persistence.append", stream=stream)
         seq_holder[stream] += 1
         # wall-clock stamp: realtime_replay paces a later restart by the
         # recorded inter-record gaps
         backend.append(
             stream, pickle.dumps((seq_holder[stream], events, offsets, _t.time()))
         )
+        faults.fire("persistence.commit", stream=stream)
 
     # restore the reader's offset frontier so already-consumed rows are not
     # re-read (reference: rewind_from_disk_snapshot + frontier_for,

@@ -22,6 +22,7 @@ from .admission import (
     AdmissionController,
     AdmissionPolicy,
     DeadlineExceededError,
+    EngineFailedError,
     Priority,
     QueueFullError,
     RateLimitedError,
@@ -36,6 +37,7 @@ __all__ = [
     "AdmissionController",
     "AdmissionPolicy",
     "DeadlineExceededError",
+    "EngineFailedError",
     "Priority",
     "QueueFullError",
     "RateLimitedError",

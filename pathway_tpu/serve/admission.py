@@ -75,6 +75,26 @@ class SchedulerClosedError(ShedError):
         super().__init__(reason, retry_after_s=0.0)
 
 
+class EngineFailedError(RuntimeError):
+    """A request died because the serving engine failed (and, when
+    supervised restart is on, its restart budget ran out).  NOT a
+    ShedError — admission rejected nothing; the device tier broke.  The
+    HTTP layer maps this to ``503 + Retry-After`` (a restarting engine
+    is a transient outage worth retrying) with the trace id in the body,
+    distinct from admission's 429 (io/http.py).
+
+    Attributes: ``retry_after_s`` (hint for the 503), ``trace_id`` (the
+    engine-run trace whose flight-recorder dump shows the failure) and
+    ``dump_path`` (that dump's file, when one was written)."""
+
+    def __init__(self, reason: str, *, retry_after_s: float = 5.0,
+                 trace_id: str | None = None, dump_path: str | None = None):
+        super().__init__(reason)
+        self.retry_after_s = max(0.0, float(retry_after_s))
+        self.trace_id = trace_id
+        self.dump_path = dump_path
+
+
 class AdmissionPolicy(str, enum.Enum):
     BLOCK = "block"
     SHED = "shed"

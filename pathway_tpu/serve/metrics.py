@@ -182,6 +182,15 @@ class KVCacheStats:
         self.chain_slots = 0
         self.chain_emitted = 0
         self.host_gap_s = 0.0
+        # Round-13 failure domain: supervised engine restarts (pool
+        # rebuild + recompute re-admission), their cost, and degraded
+        # handoffs when the restart budget ran out
+        self.engine_restarts = 0
+        self.engine_restart_rebuild_s = 0.0
+        self.engine_recovery_count = 0
+        self.engine_recovery_s_sum = 0.0
+        self.last_engine_recovery_s = 0.0
+        self.engine_degraded = 0
         # bounded recent observations so callers (bench.py) can compute
         # percentiles without a second instrumentation channel
         from collections import deque as _deque
@@ -237,6 +246,26 @@ class KVCacheStats:
         the next chain being queued on the device."""
         with self._lock:
             self.host_gap_s += seconds
+
+    def record_engine_restart(self, rebuild_seconds: float) -> None:
+        """One supervised engine restart (pool rebuild time only; the
+        failure -> first-recovered-token window lands separately via
+        :meth:`record_engine_recovery`)."""
+        with self._lock:
+            self.engine_restarts += 1
+            self.engine_restart_rebuild_s += rebuild_seconds
+
+    def record_engine_recovery(self, seconds: float) -> None:
+        """Failure -> first recovered token (the engine_restart_s MTTR
+        the bench reports)."""
+        with self._lock:
+            self.engine_recovery_count += 1
+            self.engine_recovery_s_sum += seconds
+            self.last_engine_recovery_s = seconds
+
+    def record_engine_degrade(self, n: int = 1) -> None:
+        with self._lock:
+            self.engine_degraded += n
 
     def record_ttft(self, seconds: float) -> None:
         with self._lock:
@@ -298,6 +327,12 @@ class KVCacheStats:
                 "chain_emitted": self.chain_emitted,
                 "chain_occupancy": self.chain_occupancy,
                 "host_gap_s": self.host_gap_s,
+                "engine_restarts": self.engine_restarts,
+                "engine_restart_rebuild_s": self.engine_restart_rebuild_s,
+                "engine_recovery_count": self.engine_recovery_count,
+                "engine_recovery_s_sum": self.engine_recovery_s_sum,
+                "last_engine_recovery_s": self.last_engine_recovery_s,
+                "engine_degraded": self.engine_degraded,
             }
 
 
@@ -432,6 +467,10 @@ def _render_kv_lines() -> list[str]:
         "# TYPE pathway_kv_chain_emitted_total counter",
         "# TYPE pathway_kv_chain_occupancy gauge",
         "# TYPE pathway_kv_host_gap_seconds_total counter",
+        "# TYPE pathway_kv_engine_restarts_total counter",
+        "# TYPE pathway_kv_engine_restart_seconds_total counter",
+        "# TYPE pathway_kv_engine_recovery_seconds_total counter",
+        "# TYPE pathway_kv_engine_degraded_total counter",
     ]
     for s in stats:
         snap = s.snapshot()
@@ -525,6 +564,26 @@ def _render_kv_lines() -> list[str]:
             f"pathway_kv_host_gap_seconds_total{{{lbl}}} "
             f"{snap['host_gap_s']:.6f}"
         )
+        lines.append(
+            f"pathway_kv_engine_restarts_total{{{lbl}}} "
+            f"{snap['engine_restarts']}"
+        )
+        # restart_seconds = pool REBUILD cost; recovery_seconds = the
+        # failure -> first-recovered-token MTTR (includes the recompute
+        # prefill of every survivor) — distinct on purpose, dashboards
+        # dividing by restarts_total get the mean of what the name says
+        lines.append(
+            f"pathway_kv_engine_restart_seconds_total{{{lbl}}} "
+            f"{snap['engine_restart_rebuild_s']:.6f}"
+        )
+        lines.append(
+            f"pathway_kv_engine_recovery_seconds_total{{{lbl}}} "
+            f"{snap['engine_recovery_s_sum']:.6f}"
+        )
+        lines.append(
+            f"pathway_kv_engine_degraded_total{{{lbl}}} "
+            f"{snap['engine_degraded']}"
+        )
     return lines
 
 
@@ -560,7 +619,7 @@ def otlp_points(now_ns: str) -> list[dict]:
                     "cow_copies", "prefix_evictions", "blocks_in_use",
                     "prefill_chunks", "mixed_steps", "mixed_step_rows",
                     "ttft_count", "chain_count", "chain_slots",
-                    "chain_emitted"):
+                    "chain_emitted", "engine_restarts", "engine_degraded"):
             points.append({
                 "asInt": str(snap[key]),
                 "timeUnixNano": now_ns,
@@ -569,7 +628,8 @@ def otlp_points(now_ns: str) -> list[dict]:
                     {"key": "counter", "value": {"stringValue": key}},
                 ],
             })
-        for dkey in ("ttft_sum", "host_gap_s"):
+        for dkey in ("ttft_sum", "host_gap_s", "engine_recovery_s_sum",
+                     "engine_restart_rebuild_s"):
             points.append({
                 "asDouble": snap[dkey],
                 "timeUnixNano": now_ns,

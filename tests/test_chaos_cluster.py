@@ -1,0 +1,392 @@
+"""Round-13 failure domains: the chaos matrix and multi-process
+kill-and-recover (ISSUE 14 acceptance).
+
+Three tiers:
+
+  - the CHAOS MATRIX — {delay, drop, close, kill} x {ctl lane, data
+    lane} injected into a real 2-proc spawn via PW_FAULT; every cell
+    must end in either byte-identical output or a clean TYPED abort
+    (PeerLostError / ClusterAborted / ctl-deadline) within the wait
+    deadline — never a hang (SIGALRM-bounded);
+  - 2-proc KILL-AND-RECOVER — a worker killed mid-ingest, mid-exchange
+    and post-commit (three distinct chaos points) under the restart
+    supervisor; the persistence journal resumes the mesh and the final
+    squashed output passes the exactly-once check at every kill point;
+  - unit tests for the faults registry (spec parsing, nth counting,
+    stamp-dir once semantics, the obs event) and the fabric liveness
+    primitives (PeerLostError from a silent peer / wait deadline,
+    ClusterAborted from a poison frame).
+"""
+
+import json
+import os
+import textwrap
+import threading
+import time
+from pathlib import Path
+
+import pytest
+
+from .utils import bare_fabric, hard_alarm, spawn_cluster
+
+pytestmark = pytest.mark.filterwarnings("ignore::ResourceWarning")
+
+
+@pytest.fixture(autouse=True)
+def _hard_timeout():
+    """No chaos cell may hang the tier-1 run (acceptance: every cell
+    finishes within the deadline)."""
+    with hard_alarm(120):
+        yield
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    from pathway_tpu import faults
+
+    faults.clear()
+    yield
+    faults.clear()
+
+
+# chaos env for failure cells: tight deadlines so a typed abort lands in
+# seconds, not the production 120s
+_CHAOS_ENV = {
+    "PW_FABRIC_WAIT_TIMEOUT_S": "4",
+    "PW_FABRIC_HEARTBEAT_S": "0.5",
+    "PW_FABRIC_PEER_TIMEOUT_S": "3",
+}
+
+# stderr markers of a CLEAN TYPED abort (vs a hang, a pickle crash, a
+# stuck deadlock): the typed error names, the poison path, the deadlined
+# ctl recv, or the injected kill itself.  Deliberately NO loose
+# substrings ("peer") — a mesh-formation flake or raw traceback must not
+# pass as a typed abort.
+_TYPED_ABORT_MARKERS = (
+    "PeerLostError",
+    "ClusterAborted",
+    "cluster aborted",
+    "ctl recv timeout",
+    "fault.injected kill",
+)
+
+
+def _wordcount_script(tmp: Path, out: Path, inp: Path | None = None) -> Path:
+    # NOTE: row keys are derived from (content, source path), so every
+    # run that must be byte-comparable reads the SAME input file
+    inp = inp or (tmp / "input.csv")
+    if not inp.exists():
+        words = ["alpha", "beta", "gamma", "delta", "epsilon", "zeta"]
+        lines = [
+            " ".join(words[(i + j) % len(words)] for j in range(3))
+            for i in range(240)
+        ]
+        inp.write_text("line\n" + "\n".join(f'"{l}"' for l in lines) + "\n")
+    script = tmp / f"app_{out.stem}.py"
+    script.write_text(textwrap.dedent(f"""
+        import pathway_tpu as pw
+
+        class S(pw.Schema):
+            line: str
+
+        t = pw.io.csv.read({str(inp)!r}, schema=S, mode="static")
+        words = t.select(word=pw.apply(lambda s: s.split(), t.line)).flatten(
+            pw.this.word
+        )
+        counts = words.groupby(words.word).reduce(
+            words.word, count=pw.reducers.count()
+        )
+        pw.io.jsonlines.write(counts, {str(out)!r})
+        pw.run()
+    """))
+    return script
+
+
+@pytest.fixture(scope="module")
+def serial_baseline(tmp_path_factory):
+    """The 1-proc x 2-thread walk's bytes — the identity oracle every
+    successful chaos cell must match."""
+    tmp = tmp_path_factory.mktemp("chaos_baseline")
+    out = tmp / "serial.jsonl"
+    spawn_cluster(_wordcount_script(tmp, out), processes=1, threads=2)
+    data = out.read_bytes()
+    assert data
+    return tmp, data
+
+
+@pytest.mark.parametrize("action", ["delay", "drop", "close", "kill"])
+@pytest.mark.parametrize("lane", ["ctl", "data"])
+def test_chaos_matrix_cell(serial_baseline, tmp_path, action, lane):
+    """Acceptance: every {action} x {lane} cell ends in either
+    byte-identical output or a clean typed abort within the deadline —
+    never a hang."""
+    tmp, serial = serial_baseline
+    out = tmp_path / f"cell_{action}_{lane}.jsonl"
+    # read the baseline's input FILE (keys are content+path-derived, so
+    # a copied file would shift shard routing and the output bytes)
+    script = _wordcount_script(tmp_path, out, inp=tmp / "input.csv")
+    arg_ms = 30 if action == "delay" else 0
+    nth = 0 if action == "delay" else 2  # delay every frame; fail the 2nd
+    env = dict(_CHAOS_ENV)
+    env["PW_FAULT"] = f"fabric.send.{lane}:{action}:{nth}:{arg_ms}:1"
+    t0 = time.monotonic()
+    res = spawn_cluster(script, processes=2, threads=1, timeout=90,
+                        extra_env=env, check=False)
+    elapsed = time.monotonic() - t0
+    if action == "delay":
+        # a pure delay must not change ONE byte of output
+        assert res.returncode == 0, res.stderr[-3000:]
+        assert out.read_bytes() == serial
+        return
+    # self-healing is legal (e.g. the dropped frame was a heartbeat, or a
+    # later coalesced mark re-announced the counts): identical output
+    if res.returncode == 0:
+        assert out.read_bytes() == serial
+        return
+    # otherwise: a clean TYPED abort, within the deadline budget (wait
+    # deadline 4s + teardown), with the typed marker in stderr.  A
+    # mesh-formation flake (retries exhausted) is neither outcome — it
+    # means the chaos path was never exercised, so fail it explicitly
+    from .utils import fabric_mesh_flake
+
+    blob = res.stderr + res.stdout
+    assert not fabric_mesh_flake(res.stderr), (
+        f"mesh never formed, cell not exercised:\n{res.stderr[-2000:]}"
+    )
+    assert any(m in blob for m in _TYPED_ABORT_MARKERS), blob[-3000:]
+    assert elapsed < 80, f"cell took {elapsed:.0f}s — not a bounded abort"
+
+
+# -- multi-proc kill-and-recover (tentpole acceptance) ---------------------
+
+
+def _squash_jsonl_words(path: Path) -> dict:
+    state: dict = {}
+    for ln in path.read_text().strip().splitlines():
+        if not ln:
+            continue
+        e = json.loads(ln)
+        key = (e["word"], e["count"])
+        state[key] = state.get(key, 0) + e["diff"]
+    return {w: c for (w, c), m in state.items() if m}
+
+
+@pytest.mark.parametrize("point,nth,label", [
+    ("persistence.append", 1, "mid_ingest"),
+    ("fabric.mark", 3, "mid_exchange"),
+    ("persistence.commit", 1, "post_commit"),
+])
+def test_kill_and_recover_exactly_once_2proc(tmp_path, point, nth, label):
+    """A worker killed at three distinct points (before its journal
+    append, at an exchange mark, after a journal commit) under the
+    restart supervisor: the relaunched mesh resumes from the persistence
+    journal and the squashed output is exactly-once at every kill
+    point."""
+    data = tmp_path / "data"
+    data.mkdir()
+    words = ["red", "green", "blue", "cyan", "plum"]
+    for f in range(4):
+        (data / f"part{f:02d}.txt").write_text(
+            "\n".join(words[(f + i) % len(words)] for i in range(20)) + "\n"
+        )
+    out = tmp_path / f"out_{label}.jsonl"
+    pdir = tmp_path / f"pstore_{label}"
+    stamp = tmp_path / f"stamps_{label}"
+    script = tmp_path / f"app_{label}.py"
+    script.write_text(textwrap.dedent(f"""
+        import pathway_tpu as pw
+
+        t = pw.io.plaintext.read({str(data)!r} + "/*.txt", mode="streaming")
+        counts = t.groupby(t.data).reduce(
+            word=t.data, count=pw.reducers.count()
+        )
+        pw.io.jsonlines.write(counts, {str(out)!r})
+        pw.run(persistence_config=pw.persistence.Config(
+            pw.persistence.Backend.filesystem({str(pdir)!r})),
+            idle_stop_s=1.5)
+    """))
+    env = dict(_CHAOS_ENV)
+    env["PW_FAULT"] = f"{point}:kill:{nth}:0:1"
+    env["PW_FAULT_STAMP_DIR"] = str(stamp)
+    spawn_cluster(script, processes=2, timeout=150, extra_env=env,
+                  restart=2)
+    # the fault provably fired (and fired once): the stamp exists
+    assert list(stamp.glob("*.fired")), (
+        f"{point} fault never fired — the kill point was not exercised"
+    )
+    final = _squash_jsonl_words(out)
+    expect: dict = {}
+    for f in range(4):
+        for i in range(20):
+            w = words[(f + i) % len(words)]
+            expect[w] = expect.get(w, 0) + 1
+    assert final == expect, (
+        f"exactly-once violated at {label}: {final} != {expect}"
+    )
+
+
+# -- faults registry units -------------------------------------------------
+
+
+def test_fault_spec_parsing_and_nth_counting():
+    from pathway_tpu import faults
+
+    spec = faults.parse_spec("fabric.send.data:drop:3:0:1")
+    assert (spec.point, spec.action, spec.nth, spec.pid) == (
+        "fabric.send.data", "drop", 3, 1
+    )
+    with pytest.raises(ValueError):
+        faults.parse_spec("no-action-here")
+    with pytest.raises(ValueError):
+        faults.parse_spec("x:explode")
+
+    faults.install("p.q", "drop", nth=3)
+    assert faults.fire("p.q") is None
+    assert faults.fire("p.q") is None
+    assert faults.fire("p.q") == "drop"
+    assert faults.fire("p.q") is None  # one-shot once nth passed
+
+
+def test_fault_env_arming_and_pid_filter(monkeypatch):
+    from pathway_tpu import faults
+
+    monkeypatch.setenv("PW_FAULT", "a.b:drop:1:0:7")
+    monkeypatch.setenv("PATHWAY_PROCESS_ID", "3")
+    faults.clear()  # re-read env
+    assert faults.fire("a.b") is None  # wrong pid: never fires
+    monkeypatch.setenv("PATHWAY_PROCESS_ID", "7")
+    faults.clear()
+    assert faults.fire("a.b") == "drop"
+
+
+def test_fault_raise_and_obs_event():
+    from pathway_tpu import faults, obs
+
+    faults.install("engine.dispatch.chain", "raise", nth=1)
+    with pytest.raises(faults.InjectedFault):
+        faults.fire("engine.dispatch.chain")
+    names = [s.name for s in obs.recorder().snapshot()]
+    assert "fault.injected" in names
+
+
+def test_fault_stamp_dir_once_semantics(tmp_path, monkeypatch):
+    """The stamp disarms a spec across process incarnations — the
+    supervisor's restart must not re-kill forever."""
+    from pathway_tpu import faults
+
+    monkeypatch.setenv("PW_FAULT_STAMP_DIR", str(tmp_path))
+    faults.install("x.y", "drop", nth=1)
+    assert faults.fire("x.y") == "drop"
+    assert list(tmp_path.glob("*.fired"))
+    # a "new process": same spec re-armed, but the stamp exists
+    faults.clear()
+    faults.install("x.y", "drop", nth=1)
+    assert faults.fire("x.y") is None
+
+
+# -- fabric liveness units -------------------------------------------------
+
+
+def test_wait_marks_deadline_raises_typed_peer_lost():
+    """A peer whose frames never arrive converts the wait deadline into
+    a typed PeerLostError naming the peer and the barrier."""
+    from pathway_tpu.parallel.comm import PeerLostError
+
+    f = bare_fabric(pid=0, peers=(1,))
+    with pytest.raises(PeerLostError) as ei:
+        f.wait_marks(4, 2, timeout_s=0.3)
+    assert ei.value.peer == 1
+    assert "marks(t=4, pos=2)" in ei.value.waiting_on
+
+
+def test_wait_marks_heartbeat_silence_raises_before_deadline():
+    """With heartbeats on, a peer silent past PW_FABRIC_PEER_TIMEOUT_S
+    aborts the wait long before the barrier deadline."""
+    from pathway_tpu.parallel.comm import PeerLostError
+
+    f = bare_fabric(pid=0, peers=(1,))
+    f._hb_interval = 0.1
+    f._peer_timeout_s = 0.25
+    f._last_seen[1] = time.monotonic() - 1.0  # long silent
+    t0 = time.monotonic()
+    with pytest.raises(PeerLostError) as ei:
+        f.wait_marks(7, 1, timeout_s=30.0)
+    assert time.monotonic() - t0 < 2.0  # typed abort, not the 30s wait
+    assert "no frames for" in str(ei.value)
+
+
+def test_poison_frame_aborts_blocking_waits():
+    """A poison landing mid-wait raises ClusterAborted immediately (the
+    coordinated-abort consistency point)."""
+    from pathway_tpu.parallel.comm import ClusterAborted
+
+    f = bare_fabric(pid=0, peers=(1,))
+
+    def poison_late():
+        time.sleep(0.1)
+        with f._cond:
+            f._poisoned = "pid 1: InjectedFault: boom"
+            f._cond.notify_all()
+
+    th = threading.Thread(target=poison_late)
+    th.start()
+    t0 = time.monotonic()
+    with pytest.raises(ClusterAborted, match="boom"):
+        f.wait_marks(3, 1, timeout_s=30.0)
+    th.join()
+    assert time.monotonic() - t0 < 2.0
+
+
+def test_recv_ctl_surfaces_poison_and_peer_loss():
+    from pathway_tpu.parallel.comm import ClusterAborted, PeerLostError
+
+    f = bare_fabric(pid=1, peers=(0,))
+    import queue as _q
+
+    f._ctl = _q.Queue()
+    f._ctl.put(("__poison__", "pid 0: dead"))
+    with pytest.raises(ClusterAborted, match="dead"):
+        f.recv_ctl(timeout_s=1.0)
+    f._ctl.put(("__peer_lost__", 0))
+    with pytest.raises(PeerLostError) as ei:
+        f.recv_ctl(timeout_s=1.0)
+    assert ei.value.peer == 0
+
+
+def test_peer_death_detected_over_real_sockets():
+    """End-to-end over a real loopback pair: abruptly closing one side's
+    sockets surfaces a typed PeerLostError on the survivor's next
+    blocking wait."""
+    from pathway_tpu.parallel.comm import Fabric, PeerLostError
+
+    from .utils import fabric_port_block
+
+    os.environ.setdefault("PATHWAY_FABRIC_SECRET", "test-run-secret")
+    for attempt in range(4):
+        port = fabric_port_block(2)
+        fabrics: dict = {}
+        errs: dict = {}
+
+        def mk(pid):
+            try:
+                fabrics[pid] = Fabric(pid, 2, port, connect_timeout_s=8.0)
+            except Exception as exc:  # noqa: BLE001
+                errs[pid] = exc
+
+        ts = [threading.Thread(target=mk, args=(p,)) for p in (0, 1)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=30)
+        if not errs:
+            break
+        if attempt == 3:
+            raise AssertionError(f"mesh formation failed: {errs}")
+    f0, f1 = fabrics[0], fabrics[1]
+    # simulate pid 1 dying abruptly (socket close without shutdown
+    # barrier — what an os._exit looks like from the outside)
+    f1.close()
+    with pytest.raises(PeerLostError) as ei:
+        f0.wait_marks(2, 1, timeout_s=10.0)
+    assert ei.value.peer == 1
+    f0.close()

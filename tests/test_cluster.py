@@ -19,35 +19,16 @@ import pytest
 REPO = Path(__file__).resolve().parent.parent
 
 
-from .utils import fabric_mesh_flake, fabric_port_block
+from .utils import spawn_cluster
 
 
 def _spawn(script: Path, processes: int, threads: int = 1,
            timeout: int = 120, extra_env: dict | None = None,
            attempts: int = 4) -> None:
-    env = dict(os.environ)
-    env["PYTHONPATH"] = str(REPO)
-    env["PW_FABRIC_CONNECT_TIMEOUT_S"] = "8"  # cheap mesh retries
-    env.pop("PATHWAY_THREADS", None)
-    env.pop("PATHWAY_PROCESSES", None)
-    if extra_env:
-        env.update(extra_env)
-    last = ""
-    for _attempt in range(attempts):
-        cmd = [
-            sys.executable, "-m", "pathway_tpu", "spawn",
-            "--threads", str(threads), "--processes", str(processes),
-            "--first-port", str(fabric_port_block(processes)),
-            "--", sys.executable, str(script),
-        ]
-        res = subprocess.run(cmd, env=env, capture_output=True, text=True,
-                             timeout=timeout)
-        if res.returncode == 0:
-            return
-        last = f"stdout={res.stdout}\nstderr={res.stderr}"
-        if not fabric_mesh_flake(res.stderr):
-            break  # real failure: surface it, never retry it away
-    raise AssertionError(last)
+    """Shared tests/utils.spawn_cluster idiom (fixed port range +
+    mesh-flake retry)."""
+    spawn_cluster(script, processes, threads=threads, timeout=timeout,
+                  extra_env=extra_env, attempts=attempts)
 
 
 def _wordcount_script(tmp: Path, inp: Path, out: Path) -> Path:

@@ -1,0 +1,249 @@
+"""Round-13 serving watchdog + supervised engine restart (ISSUE 14).
+
+Pins the serving-plane failure guarantees:
+
+- RESTART TOKEN IDENTITY: an engine that fails mid-run (raise at the
+  Nth chain dispatch) rebuilds its BlockPool and re-admits every
+  in-flight sequence by recompute over prompt + emitted — recovered
+  outputs are byte-equal to an uninterrupted run across >= 8
+  mixed-length in-flight sessions (the acceptance bar);
+- WATCHDOG: a dispatch wedged past ``watchdog_timeout_s`` raises a
+  typed EngineHungError (instead of blocking forever) and feeds the
+  same supervised-restart path, still token-identical;
+- EXHAUSTION: with no restart budget, every stranded request — waiter
+  or batch-origin — fails with a typed EngineFailedError (503-mappable,
+  trace id attached, original error embedded in the message);
+- DEGRADE HANDOFF: with a ``degrade_fn``, stranded requests complete
+  through the cheaper tier instead of failing;
+- OBSERVABILITY: restarts/recovery-time land in KVCacheStats and the
+  Prometheus render; EngineFailedError maps to HTTP 503 + Retry-After
+  with the trace id in the body (distinct from admission's 429).
+"""
+
+import json
+import urllib.error
+import urllib.request
+
+import jax
+import numpy as np
+import pytest
+
+from pathway_tpu import faults
+from pathway_tpu.kvcache import EngineHungError, PagedDecodeEngine
+from pathway_tpu.models.decoder import DecoderConfig, init_decoder_params
+from pathway_tpu.serve.admission import EngineFailedError
+
+_CFG = DecoderConfig(
+    vocab_size=64, d_model=64, n_layers=2, n_heads=8, d_ff=128, max_len=128
+)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_decoder_params(_CFG, jax.random.PRNGKey(0))
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+def _engine(params, name, **kw):
+    kw.setdefault("num_blocks", 96)
+    kw.setdefault("block_size", 4)
+    kw.setdefault("max_batch_size", 8)
+    kw.setdefault("seq_buckets", (16, 32, 64))
+    kw.setdefault("prefill_chunk", 8)
+    kw.setdefault("chain_steps", 4)
+    return PagedDecodeEngine(_CFG, params, name=name, **kw)
+
+
+def _mixed_requests():
+    """>= 8 mixed-length in-flight sessions (the acceptance shape)."""
+    rng = np.random.default_rng(11)
+    lengths = [3, 5, 7, 9, 12, 15, 21, 27]
+    return [
+        (list(rng.integers(1, _CFG.vocab_size, size=n)), 6 + (i % 5))
+        for i, n in enumerate(lengths)
+    ]
+
+
+def test_restart_is_token_identical_across_8_mixed_sessions(params):
+    """Acceptance: recovered sequences' outputs byte-equal an
+    uninterrupted run, with the restart visible in stats."""
+    reqs = _mixed_requests()
+    clean = _engine(params, "t_restart_clean").generate_batch(
+        [(list(p), n) for p, n in reqs]
+    )
+
+    eng = _engine(params, "t_restart_faulty", max_restarts=1)
+    # fail the 2nd chained dispatch: by then several sessions have
+    # emitted tokens, so the restart must recompute prompt + emitted
+    faults.install("engine.dispatch.chain", "raise", nth=2)
+    got = eng.generate_batch([(list(p), n) for p, n in reqs])
+    assert got == clean, "restart changed emitted tokens"
+    assert eng.pool.stats.engine_restarts >= 1
+    assert eng.pool.stats.engine_recovery_count >= 1
+    assert eng.pool.stats.last_engine_recovery_s > 0
+    # the pool really was rebuilt and no sequence leaked into it (the
+    # prefix cache legitimately retains finished prompts' full blocks)
+    assert eng.pool.sequences() == []
+
+
+def test_watchdog_hang_restarts_token_identical(params):
+    """A wedged sync (chaos `hang` inside the device->host pull) trips
+    the watchdog deadline, and the supervised restart still produces
+    byte-equal output."""
+    reqs = _mixed_requests()
+    clean = _engine(params, "t_wd_clean").generate_batch(
+        [(list(p), n) for p, n in reqs]
+    )
+    eng = _engine(params, "t_wd_faulty", max_restarts=1,
+                  watchdog_timeout_s=0.5)
+    faults.install("engine.sync", "hang", nth=2, arg_ms=2500)
+    got = eng.generate_batch([(list(p), n) for p, n in reqs])
+    assert got == clean
+    assert eng.pool.stats.engine_restarts == 1
+
+
+def test_watchdog_without_budget_raises_typed_hung(params):
+    """No restart budget: the hung dispatch surfaces to the caller as a
+    typed EngineFailedError carrying the watchdog's EngineHungError
+    message."""
+    eng = _engine(params, "t_wd_nobudget", max_restarts=0,
+                  watchdog_timeout_s=0.4)
+    faults.install("engine.sync", "hang", nth=1, arg_ms=2000)
+    with pytest.raises(EngineFailedError) as ei:
+        eng.generate_batch([([1, 2, 3], 4)])
+    # the wrap names the typed hung error and its deadline
+    assert EngineHungError.__name__ in str(ei.value)
+    assert "watchdog deadline" in str(ei.value)
+
+
+def test_exhausted_restarts_fail_waiters_typed(params):
+    """poll_inflight waiters AND batch-origin callers get a typed
+    EngineFailedError (trace id attached, original error embedded) —
+    the contract the HTTP 503 mapping builds on."""
+    eng = _engine(params, "t_exhaust", max_restarts=0)
+    faults.install("engine.dispatch.chain", "raise", nth=1)
+    got = {}
+    polled = [(
+        ([1, 2, 3], 4), 1,
+        lambda r: got.setdefault("done", r),
+        lambda e: got.setdefault("err", e),
+    )]
+
+    def poll(n):
+        items, polled[:] = list(polled), []
+        return items
+
+    with pytest.raises(EngineFailedError, match="injected fault"):
+        eng.generate_batch([([4, 5, 6], 4)], poll=poll)
+    err = got.get("err")
+    assert isinstance(err, EngineFailedError), err
+    assert err.trace_id
+    assert "injected fault" in str(err)
+    assert eng.pool.blocks_in_use == 0
+
+
+def test_restart_budget_spent_then_typed_failure(params):
+    """Budget 1, two injected failures: the first restarts, the second
+    fails typed — the budget is per-run, not per-request."""
+    eng = _engine(params, "t_budget1", max_restarts=1)
+    faults.install("engine.dispatch.chain", "raise", nth=1)
+    faults.install("engine.dispatch.chain", "raise", nth=2)
+    # max_new large enough that the restarted run dispatches at least
+    # one more chain (where the second spec fires)
+    with pytest.raises(EngineFailedError, match="after 1 restart"):
+        eng.generate_batch([([1, 2, 3, 4], 16)])
+    assert eng.pool.stats.engine_restarts >= 1
+
+
+def test_degrade_handoff_completes_stranded_requests(params):
+    """With restarts exhausted and a degrade_fn (the host-tier hook),
+    stranded requests COMPLETE through the cheaper tier — emitted
+    tokens are kept and the remainder comes from the degrade fn."""
+    calls = []
+
+    def degrade(prompt, n_remaining, emitted):
+        calls.append((list(prompt), n_remaining, list(emitted)))
+        return [7] * n_remaining
+
+    eng = _engine(params, "t_degrade", max_restarts=0, degrade_fn=degrade)
+    faults.install("engine.dispatch.chain", "raise", nth=1)
+    got = eng.generate_batch([([1, 2, 3], 5), ([4, 5], 4)])
+    assert calls, "degrade_fn never invoked"
+    for out, (_p, n) in zip(got, [([1, 2, 3], 5), ([4, 5], 4)]):
+        assert len(out) == n
+        assert out[-1] == 7  # tail came from the degrade tier
+    assert eng.pool.stats.engine_degraded == 2
+
+
+def test_restart_metrics_render_prometheus(params):
+    from pathway_tpu.serve.metrics import render_prometheus_lines
+
+    eng = _engine(params, "t_restart_prom", max_restarts=1)
+    faults.install("engine.dispatch.chain", "raise", nth=1)
+    eng.generate_batch([([1, 2, 3, 4], 6)])
+    text = "\n".join(render_prometheus_lines())
+    assert 'pathway_kv_engine_restarts_total{pool="t_restart_prom"} 1' \
+        in text
+    assert "pathway_kv_engine_restart_seconds_total" in text
+    assert "pathway_kv_engine_degraded_total" in text
+
+
+def test_scheduler_waiters_get_typed_error_e2e(params):
+    """Through the real serve path: submit() callers of a scheduler
+    whose engine dies see EngineFailedError, not a generic 500-shaped
+    RuntimeError."""
+    from pathway_tpu.serve.scheduler import RequestScheduler
+
+    eng = _engine(params, "t_sched_fail", max_restarts=0)
+    faults.install("engine.dispatch.chain", "raise", nth=1)
+    sched = RequestScheduler(
+        lambda reqs: eng.serve_batch(reqs, scheduler=sched_holder[0]),
+        name="t_sched_fail", max_batch_size=4, batch_linger_ms=0.0,
+    )
+    sched_holder = [sched]
+    with pytest.raises(EngineFailedError):
+        sched.submit(([1, 2, 3], 4), timeout_s=20.0)
+    sched.shutdown(drain=False)
+
+
+def test_http_503_with_retry_after_and_trace(params):
+    """An engine failure surfacing through an HTTP handler returns 503 +
+    Retry-After with the trace id in the body — distinct from
+    admission's 429."""
+    from pathway_tpu.io.http import PathwayWebserver
+
+    ws = PathwayWebserver("127.0.0.1", 0, with_schema_endpoint=False)
+
+    def handler(_payload):
+        raise EngineFailedError(
+            "decode engine failed after 2 restart(s): InjectedFault",
+            retry_after_s=7.0, trace_id="engineruntrace",
+        )
+
+    ws.register("/gen", ["POST"], handler)
+    ws._ensure_started()
+    port = ws._server.server_address[1]
+    try:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/gen", data=b"{}", method="POST",
+            headers={"Content-Type": "application/json",
+                     "X-Pathway-Trace": "reqtrace123"},
+        )
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=10)
+        resp = ei.value
+        assert resp.code == 503
+        assert resp.headers.get("Retry-After") == "7"
+        body = json.loads(resp.read().decode())
+        assert body["trace"] == "reqtrace123"  # the request's trace id
+        assert body["engine_trace"] == "engineruntrace"
+        assert "decode engine failed" in body["error"]
+        assert body["retry_after_s"] == 7.0
+    finally:
+        ws.shutdown()

@@ -136,7 +136,8 @@ def test_spawn_supervisor_clamps_processes(no_license, capsys, monkeypatch):
     pw.set_license_key("demo-license-key-no-telemetry")  # lacks the ent
     calls = []
 
-    def fake_spawn_once(program, threads, processes, first_port):
+    def fake_spawn_once(program, threads, processes, first_port,
+                        fail_fast=False):
         calls.append((threads, processes))
         return 0
 

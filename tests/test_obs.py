@@ -423,19 +423,9 @@ def test_fabric_wait_marks_attributed_per_peer():
     marks are COUNTED — a peer's exchange point completes when its
     cursor passed the position and its announced frame counts matched
     the received ones (`_mark_ready`)."""
-    from pathway_tpu.parallel.comm import Fabric
+    from .utils import bare_fabric
 
-    f = Fabric.__new__(Fabric)
-    f.pid = 0
-    f.peers = [1, 2]
-    f._cond = threading.Condition()
-    f._marks = defaultdict(dict)
-    f._announced = {}
-    f._recv_pos_counts = defaultdict(int)
-    f._dead = None
-    f.stats = {"wait_marks_s": 0.0, "wait_marks_s_p1": 0.0,
-               "wait_marks_s_p2": 0.0}
-    f._obs_ctx = (obs.new_trace_id(), 0)
+    f = bare_fabric(pid=0, peers=(1, 2))
     f._marks[1][5] = 3  # peer 1 already marked before the wait starts
 
     def late_mark():

@@ -45,50 +45,20 @@ pytestmark = pytest.mark.filterwarnings("ignore::ResourceWarning")
 def _hard_timeout():
     """Hard per-test timeout (CI satellite): a wedged 2-proc rendezvous
     must fail the test, not the whole tier-1 run."""
-    def boom(_sig, _frm):
-        raise TimeoutError("test exceeded its 180s hard timeout")
-
-    old = signal.signal(signal.SIGALRM, boom)
-    signal.alarm(180)
-    try:
+    with hard_alarm(180):
         yield
-    finally:
-        signal.alarm(0)
-        signal.signal(signal.SIGALRM, old)
 
 
-from .utils import fabric_mesh_flake, fabric_port_block
+from .utils import fabric_port_block, hard_alarm, spawn_cluster
 
 
 def _spawn(script: Path, processes: int, threads: int = 1,
            timeout: int = 150, extra_env: dict | None = None,
            attempts: int = 4) -> None:
     """CLI-supervisor spawn with mesh-formation retry on a fresh port
-    block (cheap: the connect deadline is lowered via env)."""
-    env = dict(os.environ)
-    env["PYTHONPATH"] = str(REPO)
-    env["JAX_PLATFORMS"] = "cpu"
-    env["PW_FABRIC_CONNECT_TIMEOUT_S"] = "8"
-    env.pop("PATHWAY_THREADS", None)
-    env.pop("PATHWAY_PROCESSES", None)
-    if extra_env:
-        env.update(extra_env)
-    last = ""
-    for _ in range(attempts):
-        cmd = [
-            sys.executable, "-m", "pathway_tpu", "spawn",
-            "--threads", str(threads), "--processes", str(processes),
-            "--first-port", str(fabric_port_block(processes)),
-            "--", sys.executable, str(script),
-        ]
-        res = subprocess.run(cmd, env=env, capture_output=True, text=True,
-                             timeout=timeout)
-        if res.returncode == 0:
-            return
-        last = res.stderr
-        if not fabric_mesh_flake(last):
-            break  # real failure: do not mask it behind retries
-    raise AssertionError(f"spawn failed:\n{last[-3000:]}")
+    block — the shared tests/utils.spawn_cluster idiom."""
+    spawn_cluster(script, processes, threads=threads, timeout=timeout,
+                  extra_env=extra_env, attempts=attempts)
 
 
 def _wordcount_script(tmp: Path, out: Path) -> Path:
@@ -415,20 +385,9 @@ def test_counted_mark_wait_only_blocks_on_inflight_frames():
     """A peer whose cursor passed the position with NO announced frames
     completes the wait instantly; announced-but-unlanded frames block
     until the data arrives (count-proof, not FIFO)."""
-    from pathway_tpu.parallel.comm import Fabric
+    from .utils import bare_fabric
 
-    f = Fabric.__new__(Fabric)
-    f.pid = 0
-    f.peers = [1]
-    f._cond = threading.Condition()
-    f._marks = defaultdict(dict)
-    f._announced = {}
-    f._recv_pos_counts = defaultdict(int)
-    f._dead = None
-    f.stats = {"wait_marks_s": 0.0, "wait_marks_s_p1": 0.0}
-    from pathway_tpu import obs
-
-    f._obs_ctx = (obs.new_trace_id(), 0)
+    f = bare_fabric(pid=0, peers=(1,))
 
     # quiet point: cursor past pos, nothing announced -> instant
     f._marks[1][4] = 9

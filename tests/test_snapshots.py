@@ -310,27 +310,11 @@ def test_cluster_coordinated_snapshots(tmp_path):
         ), idle_stop_s=1.2)
     """))
 
-    from .utils import fabric_mesh_flake, fabric_port_block
+    from .utils import spawn_cluster
 
     def spawn():
-        env = dict(os.environ)
-        env["PYTHONPATH"] = str(REPO)
-        env["PW_FABRIC_CONNECT_TIMEOUT_S"] = "8"
-        last = ""
-        for _attempt in range(4):
-            res = subprocess.run(
-                [sys.executable, "-m", "pathway_tpu", "spawn",
-                 "--processes", "2",
-                 "--first-port", str(fabric_port_block(2)),
-                 "--", sys.executable, str(script)],
-                env=env, capture_output=True, text=True, timeout=120,
-            )
-            if res.returncode == 0:
-                return
-            last = res.stderr
-            if not fabric_mesh_flake(last):
-                break  # real failure — surface it, don't retry it away
-        raise AssertionError(last)
+        # shared tests/utils idiom: fixed port range + mesh-flake retry
+        spawn_cluster(script, processes=2, timeout=120)
 
     spawn()
     first = _squash_jsonl_words(out)

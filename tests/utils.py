@@ -159,12 +159,14 @@ def assert_key_entries_in_stream_consistent(table: pw.Table) -> None:
         state[e.key] = cur
 
 
-# -- multi-process fabric test plumbing (round-12) -------------------------
-# One shared implementation of the fixed-range port anchor and the
-# mesh-formation retry predicate: this container's loopback aborts
-# connects intermittently, and ephemeral-range (bind-to-0) anchors race
-# its own outbound connections.  Used by test_cluster, test_snapshots,
-# and test_overlap_fabric — keep the retryable-error set HERE only.
+# -- multi-process fabric test plumbing (round-12/13) ----------------------
+# One shared implementation of the fixed-range port anchor, the
+# mesh-formation retry predicate, the CLI-supervisor spawn idiom and the
+# SIGALRM hard timeout: this container's loopback aborts connects
+# intermittently, and ephemeral-range (bind-to-0) anchors race its own
+# outbound connections.  Used by test_cluster, test_snapshots,
+# test_overlap_fabric and test_chaos_cluster — keep the retryable-error
+# set HERE only.
 
 def fabric_port_block(n: int = 4) -> int:
     """Bindable anchor from the fixed 21000-28000 range; the fabric uses
@@ -192,3 +194,114 @@ def fabric_mesh_flake(stderr: str) -> bool:
     return ("cannot reach peer" in stderr
             or "peers connected" in stderr
             or "cannot bind fabric port" in stderr)
+
+
+def spawn_cluster(script, processes: int, threads: int = 1,
+                  timeout: int = 150, extra_env: dict | None = None,
+                  attempts: int = 4, restart: int = 0, check: bool = True):
+    """The shared spawn-with-fixed-port-range + mesh-flake-retry idiom
+    (previously duplicated across test_overlap_fabric / test_cluster /
+    test_snapshots).  Runs the script under the CLI supervisor and
+    returns the final CompletedProcess; a mesh-formation flake retries
+    on a fresh port block, a real failure is surfaced (when ``check``)
+    or returned for the caller to assert on (chaos cells that EXPECT a
+    typed abort pass ``check=False``)."""
+    import subprocess
+    import sys
+    from pathlib import Path
+
+    repo = Path(__file__).resolve().parent.parent
+    env = dict(os.environ)
+    env["PYTHONPATH"] = str(repo)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.setdefault("PW_FABRIC_CONNECT_TIMEOUT_S", "8")  # cheap mesh retries
+    env.pop("PATHWAY_THREADS", None)
+    env.pop("PATHWAY_PROCESSES", None)
+    if extra_env:
+        env.update(extra_env)
+    res = None
+    for _attempt in range(attempts):
+        cmd = [
+            sys.executable, "-m", "pathway_tpu", "spawn",
+            "--threads", str(threads), "--processes", str(processes),
+            "--first-port", str(fabric_port_block(processes)),
+        ]
+        if restart:
+            cmd += ["--restart", str(restart)]
+        cmd += ["--", sys.executable, str(script)]
+        res = subprocess.run(cmd, env=env, capture_output=True, text=True,
+                             timeout=timeout)
+        if res.returncode == 0:
+            return res
+        if not fabric_mesh_flake(res.stderr):
+            break  # real failure: surface it, never retry it away
+    if check:
+        raise AssertionError(
+            f"spawn failed (rc={res.returncode}):\n"
+            f"stdout={res.stdout[-1500:]}\nstderr={res.stderr[-3000:]}"
+        )
+    return res
+
+
+class hard_alarm:
+    """SIGALRM-based hard timeout (context manager): a wedged
+    multi-process rendezvous fails the test, never the whole tier-1
+    run.  Usable as the body of an autouse fixture or inline."""
+
+    def __init__(self, seconds: int = 180):
+        self.seconds = int(seconds)
+        self._old = None
+
+    def __enter__(self):
+        import signal
+
+        def boom(_sig, _frm):
+            raise TimeoutError(
+                f"test exceeded its {self.seconds}s hard timeout"
+            )
+
+        self._old = signal.signal(signal.SIGALRM, boom)
+        signal.alarm(self.seconds)
+        return self
+
+    def __exit__(self, *exc):
+        import signal
+
+        signal.alarm(0)
+        signal.signal(signal.SIGALRM, self._old)
+        return False
+
+
+def bare_fabric(pid: int = 0, peers=(1,)):
+    """A Fabric with no sockets/threads — just the shared-state attrs the
+    counted-mark/liveness wait paths read.  Unit tests for wait_marks
+    and friends build on this instead of each re-listing the attrs."""
+    import threading as _threading
+    from collections import defaultdict as _dd
+
+    from pathway_tpu import obs
+    from pathway_tpu.parallel.comm import Fabric
+
+    f = Fabric.__new__(Fabric)
+    f.pid = pid
+    f.peers = list(peers)
+    f._cond = _threading.Condition()
+    f._marks = _dd(dict)
+    f._announced = {}
+    f._recv_pos_counts = _dd(int)
+    f._eot = set()
+    f._done_peers = set()
+    f._dead = None
+    f._dead_peer = None
+    f._poisoned = None
+    f._closed = False
+    # liveness defaults: heartbeats off (no threads here), generous wait
+    f._hb_interval = 0.0
+    f._peer_timeout_s = 0.0
+    f._wait_timeout_s = 120.0
+    f._last_seen = {p: 0.0 for p in peers}
+    f.stats = {"wait_marks_s": 0.0, "wait_eot_s": 0.0}
+    for p in peers:
+        f.stats[f"wait_marks_s_p{p}"] = 0.0
+    f._obs_ctx = (obs.new_trace_id(), 0)
+    return f
