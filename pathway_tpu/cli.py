@@ -181,6 +181,23 @@ def main(argv: list[str] | None = None) -> int:
     sub.add_parser("dashboard", add_help=False,
                    help="serve the web dashboard over recorded metrics")
 
+    pp = sub.add_parser(
+        "profile",
+        help="ranked per-program device cost table from a running "
+             "process (fetches its /debug/profile endpoint — the same "
+             "plumbing as the SIGUSR1/flight-recorder dumps)",
+    )
+    pp.add_argument("--url", default="http://127.0.0.1:20000",
+                    help="base URL of the process's metrics server or "
+                         "webserver (default: the MetricsServer port)")
+    pp.add_argument("--memory", action="store_true",
+                    help="include memory_analysis temp/arg/output bytes "
+                         "(compiles each program once more, first call "
+                         "only)")
+    pp.add_argument("--json", action="store_true",
+                    help="print the raw /debug/profile JSON instead of "
+                         "the table")
+
     rp = sub.add_parser("run", help="run a YAML app template")
     rp.add_argument("template", help="path to app.yaml")
     rp.add_argument("--host", default="0.0.0.0")
@@ -205,10 +222,99 @@ def main(argv: list[str] | None = None) -> int:
                      restart=args.restart)
     if args.command == "spawn-from-env":
         return spawn_from_env()
+    if args.command == "profile":
+        return profile_command(args.url, memory=args.memory,
+                               as_json=args.json)
     if args.command == "run":
         return run_template(args.template, host=args.host, port=args.port,
                             timeout_s=args.timeout_s)
     return 2
+
+
+def format_profile_table(data: dict) -> str:
+    """The ranked per-program device cost table (Round-14): one row per
+    (program, bucket), ordered by total dispatch seconds — the "which
+    kernel to fuse first" view of ``/debug/profile``."""
+    cols = ("program", "disp", "ms p50", "share", "GFLOP", "MB", "AI",
+            "MFU", "bound", "compiles", "compile s")
+    rows = []
+    progs = data.get("programs") or []
+    total_disp = sum(r.get("dispatch_s_total") or 0.0 for r in progs) or 1.0
+
+    def fmt(v, scale=1.0, digits=2):
+        return f"{v / scale:.{digits}f}" if v not in (None, 0) else "-"
+
+    for r in progs:
+        roof = r.get("roofline") or {}
+        rows.append((
+            (r.get("program") or "?")[:28],
+            str(r.get("dispatches") or 0),
+            fmt(r.get("dispatch_ms_p50")),
+            f"{(r.get('dispatch_s_total') or 0.0) / total_disp:.1%}",
+            fmt(r.get("flops"), 1e9, 3),
+            fmt(r.get("bytes_accessed"), 1e6, 1),
+            fmt(r.get("arithmetic_intensity"), 1, 1),
+            fmt(r.get("mfu"), 1, 5),
+            roof.get("bound") or "-",
+            str(r.get("n_compiles") or 0),
+            fmt(r.get("compile_s")),
+        ))
+    widths = [
+        max(len(cols[i]), *(len(row[i]) for row in rows)) if rows
+        else len(cols[i])
+        for i in range(len(cols))
+    ]
+    lines = [
+        "  ".join(c.ljust(widths[i]) for i, c in enumerate(cols)),
+        "  ".join("-" * w for w in widths),
+    ]
+    lines += [
+        "  ".join(cell.ljust(widths[i]) for i, cell in enumerate(row))
+        for row in rows
+    ]
+    totals = (
+        f"programs={data.get('n_device_programs')} "
+        f"compiles={data.get('n_compiles')} "
+        f"(recompiles={data.get('recompiles_total')}) "
+        f"compile_s_total={data.get('compile_s_total')} "
+        f"peak={fmt(data.get('peak_flops_per_s'), 1e9, 1)} GFLOP/s"
+    )
+    events = data.get("recompile_events") or []
+    if events:
+        lines.append("")
+        lines.append("recompile provenance (newest):")
+        for e in events[-4:]:
+            lines.append(
+                f"  #{e.get('seq')} {e.get('program')} "
+                f"[{e.get('bucket')}] {e.get('compile_s')}s"
+            )
+            for frame in e.get("stack") or []:
+                lines.append(f"    {frame}")
+    return "\n".join(lines + ["", totals])
+
+
+def profile_command(url: str, *, memory: bool = False,
+                    as_json: bool = False, out=None) -> int:
+    """``pathway-tpu profile``: fetch ``/debug/profile`` from a running
+    process and print the ranked table."""
+    import json
+    import urllib.request
+
+    out = out or sys.stdout
+    target = url.rstrip("/") + "/debug/profile" + (
+        "?memory=1" if memory else ""
+    )
+    try:
+        body = urllib.request.urlopen(target, timeout=30).read()
+        data = json.loads(body)
+    except Exception as exc:  # noqa: BLE001 - a CLI prints, not raises
+        print(f"cannot fetch {target}: {exc}", file=sys.stderr)
+        return 1
+    if as_json:
+        print(json.dumps(data, indent=1, default=str), file=out)
+    else:
+        print(format_profile_table(data), file=out)
+    return 0
 
 
 def run_template(path: str, *, host: str = "0.0.0.0", port: int = 8080,

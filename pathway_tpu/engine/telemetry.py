@@ -152,6 +152,40 @@ class MetricsServer:
                 f"<table><tr><th>time split</th><th>s</th></tr>{wait_rows}"
                 "</table>"
             )
+        prog_html = ""
+        try:
+            from ..obs import profiler as _profiler
+
+            # cached analysis only: the 2s-auto-refresh dashboard must
+            # never trigger lowering/compiles
+            prog_rows_src = _profiler.registry().summary(
+                analyze=False
+            )["programs"][:12]
+        except Exception:
+            prog_rows_src = []
+        if prog_rows_src:
+            def _fmt(v, scale=1.0, digits=1):
+                return f"{v / scale:.{digits}f}" if v else "-"
+
+            prog_rows = "".join(
+                f"<tr><td>{r['program']}</td>"
+                f"<td>{r['n_compiles']}</td>"
+                f"<td>{_fmt(r['compile_s'], 1, 2)}</td>"
+                f"<td>{r['dispatches']}</td>"
+                f"<td>{_fmt(r['dispatch_ms_p50'], 1, 2)}</td>"
+                f"<td>{_fmt(r['flops'], 1e9, 2)}</td>"
+                f"<td>{_fmt(r['bytes_accessed'], 1e6, 1)}</td>"
+                f"<td>{r.get('mfu') if r.get('mfu') is not None else '-'}"
+                f"</td></tr>"
+                for r in prog_rows_src
+            )
+            prog_html = (
+                "<h3>device programs (cost observatory)</h3>"
+                "<table><tr><th>program</th><th>compiles</th>"
+                "<th>compile s</th><th>dispatches</th><th>ms p50</th>"
+                "<th>GFLOP</th><th>MB touched</th><th>MFU</th></tr>"
+                f"{prog_rows}</table>"
+            )
         trace_html = ""
         try:
             from .. import obs as _obs
@@ -182,9 +216,10 @@ class MetricsServer:
             f"&middot; uptime={time.time() - self.started_at:.0f}s</h2>"
             "<table><tr><th>operator</th><th>id</th><th>rows in</th>"
             f"<th>rows out</th></tr>{rows}</table>"
-            f"{serve_html}{kv_html}{fabric_html}{trace_html}"
+            f"{serve_html}{kv_html}{fabric_html}{prog_html}{trace_html}"
             '<p><a href="/metrics">/metrics</a> &middot; '
-            '<a href="/debug/trace">/debug/trace</a></p></body></html>'
+            '<a href="/debug/trace">/debug/trace</a> &middot; '
+            '<a href="/debug/profile">/debug/profile</a></p></body></html>'
         )
 
     def start(self) -> None:
@@ -213,6 +248,18 @@ class MetricsServer:
                     from .. import obs as _obs
 
                     body = _obs.chrome_trace_dump(
+                        dict(_pq(self.path.partition("?")[2]))
+                    ).encode()
+                    ctype = "application/json"
+                elif self.path.split("?", 1)[0] == "/debug/profile":
+                    # device cost observatory (Round-14): per-program
+                    # compile/FLOPs/bytes/dispatch-ms/roofline table
+                    # (?memory=1 adds memory_analysis temp watermarks)
+                    from urllib.parse import parse_qsl as _pq
+
+                    from ..obs import profiler as _profiler
+
+                    body = _profiler.profile_dump(
                         dict(_pq(self.path.partition("?")[2]))
                     ).encode()
                     ctype = "application/json"
@@ -553,6 +600,31 @@ def otlp_export_metrics(endpoint: str, scheduler, fabric=None) -> None:
                 "dataPoints": serve_points,
             },
         })
+    # Round-14: device-program points ride their OWN metric families —
+    # mixing them into the monotonic pathway.serve.requests sum would
+    # corrupt that series, and a metric's data points must share one
+    # value type, so int counts and float seconds split into two.  All
+    # four profiler counters (compiles/dispatches/compile_s/dispatch_s)
+    # only ever grow: both sums are monotonic.
+    try:
+        from ..obs import profiler as _profiler
+
+        xla_points = _profiler.otlp_points(now)
+    except Exception:
+        xla_points = []
+    for fam_name, fam_points in (
+        ("pathway.xla", [p for p in xla_points if "asInt" in p]),
+        ("pathway.xla.seconds", [p for p in xla_points if "asDouble" in p]),
+    ):
+        if fam_points:
+            metrics.append({
+                "name": fam_name,
+                "sum": {
+                    "aggregationTemporality": 2,  # CUMULATIVE
+                    "isMonotonic": True,
+                    "dataPoints": fam_points,
+                },
+            })
     if fabric is not None:
         fabric_points = []
         for k, v in dict(fabric.stats).items():

@@ -274,10 +274,23 @@ class PathwayWebserver:
         # flight-recorder dump: Perfetto-loadable Chrome trace JSON of
         # recent spans (``?trace=<id>`` filters to one request's tree)
         self._routes[("GET", "/debug/trace")] = (self._trace_handler, True)
+        # device cost observatory (Round-14): the per-program
+        # compile/FLOPs/dispatch/roofline table (?memory=1 adds
+        # memory_analysis watermarks)
+        self._routes[("GET", "/debug/profile")] = (
+            self._profile_handler, True,
+        )
 
     def _trace_handler(self, _payload: dict, meta: dict) -> Any:
         return _RawText(
             obs.chrome_trace_dump(meta.get("params")), "application/json"
+        )
+
+    def _profile_handler(self, _payload: dict, meta: dict) -> Any:
+        from ..obs import profiler
+
+        return _RawText(
+            profiler.profile_dump(meta.get("params")), "application/json"
         )
 
     # -- OpenAPI -----------------------------------------------------------

@@ -42,11 +42,24 @@ _LIVE_POOLS: "weakref.WeakValueDictionary[str, BlockPool]" = (
 _LIVE_POOLS_LOCK = threading.Lock()
 
 
-@functools.partial(jax.jit, donate_argnums=(0,))
-def _cow_copy(pool_arr, src, dst):
+def _cow_copy_fn(pool_arr, src, dst):
     """pool_arr[:, dst] = pool_arr[:, src] with the buffer donated —
     an in-place one-block copy, not an O(pool) clone."""
     return pool_arr.at[:, dst].set(pool_arr[:, src])
+
+
+def _make_cow_copy():
+    # Round-14: registered in the device cost observatory like every
+    # other serving-path program (COW copies show up in the profile)
+    try:
+        from ..obs.profiler import profiled_jit
+
+        return profiled_jit("pw.cow_copy", _cow_copy_fn, donate_argnums=(0,))
+    except Exception:  # pragma: no cover - import-order edge
+        return functools.partial(jax.jit, donate_argnums=(0,))(_cow_copy_fn)
+
+
+_cow_copy = _make_cow_copy()
 
 
 class PoolExhausted(RuntimeError):

@@ -252,7 +252,9 @@ class PagedDecodeEngine:
                  chain_steps: int = 8, name: str = "paged_decoder",
                  watchdog_timeout_s: float | None = None,
                  max_restarts: int | None = None,
-                 degrade_fn: Callable | None = None):
+                 degrade_fn: Callable | None = None,
+                 hbm_budget_bytes: int | None = None,
+                 hbm_fit: str = "reject"):
         from ..models.encoder import _resolve_dtype
 
         self.cfg = cfg
@@ -274,6 +276,50 @@ class PagedDecodeEngine:
             params = shard_decoder_params(params, self.mesh)
         self.params = params
         head_dim = cfg.d_model // cfg.n_heads
+        # Round-14 pre-flight HBM fit (obs/memory.py): params + KV pool +
+        # step-temp watermark must fit the budget BEFORE any allocation —
+        # an unfittable (num_blocks, chain_steps, max_batch) is rejected
+        # (or, with hbm_fit="clamp", its pool shrunk) at construction
+        # with the budget and the largest fitting alternative named,
+        # instead of OOMing at first dispatch.  With no budget resolvable
+        # (the CPU fallback, no env override) the ledger is still
+        # computed but nothing is enforced.
+        from ..obs import memory as obs_memory
+
+        if hbm_fit not in ("reject", "clamp", "off"):
+            raise ValueError(
+                f"hbm_fit={hbm_fit!r} is not one of 'reject', 'clamp', "
+                "'off'"
+            )
+        self.hbm_plan = obs_memory.hbm_plan(
+            cfg, num_blocks=int(num_blocks), block_size=int(block_size),
+            max_batch_size=self.max_batch_size,
+            chain_steps=max(1, int(chain_steps)),
+            prefill_chunk=prefill_chunk, tp=self.tp,
+            dtype=_resolve_dtype(cfg.dtype), params=params,
+            budget_bytes=hbm_budget_bytes,
+            reference_attn=(self.attn != "pallas"),
+        )
+        if self.hbm_plan.budget_bytes is not None \
+                and not self.hbm_plan.fits and hbm_fit != "off":
+            clamped = (
+                self.hbm_plan.max_fitting_num_blocks()
+                if hbm_fit == "clamp" else None
+            )
+            if clamped is not None and clamped >= 2:
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "engine %s does not fit HBM at num_blocks=%d; "
+                    "clamping to %d (budget %.1fMB, %s)",
+                    name, int(num_blocks), clamped,
+                    self.hbm_plan.budget_bytes / 1048576,
+                    self.hbm_plan.budget_source,
+                )
+                num_blocks = clamped
+                self.hbm_plan = self.hbm_plan.with_(num_blocks=clamped)
+            else:
+                raise ValueError(self.hbm_plan.reject_message())
         # Round-13 failure domain: the pool's constructor args are kept so
         # a supervised restart can rebuild it from scratch (a failed or
         # hung dispatch may have consumed the donated K/V arrays)
@@ -445,14 +491,37 @@ class PagedDecodeEngine:
         # (B,) decode program and the (B, prefill_chunk) mixed program —
         # so a bucket-ladder workload compiles exactly twice (pinned by
         # tests/test_ragged_step.py's recompile guard); the legacy
-        # whole-bucket prefill specializes per (1, bucket) as before
-        self._step = jax.jit(_step_fn, donate_argnums=(1, 2))
-        self._mixed = jax.jit(_mixed_fn, donate_argnums=(1, 2))
+        # whole-bucket prefill specializes per (1, bucket) as before.
+        # Round-14: every program registers in the device cost
+        # observatory — compile wall/provenance at first lowering,
+        # FLOPs/bytes introspection, and the dispatch->sync windows the
+        # sync sites below attribute per program (obs/profiler.py)
+        from ..obs.profiler import profiled_jit
+
+        self._step = profiled_jit(
+            "pw.decode_step", _step_fn, donate_argnums=(1, 2)
+        )
+        self._mixed = profiled_jit(
+            "pw.mixed_step", _mixed_fn, donate_argnums=(1, 2)
+        )
         # the chained program's (B, chain_steps) shape is static, so the
         # whole multi-step hot loop is ONE additional compile on top of
         # the round-8 pair (K=1 rounds reuse the plain step program)
-        self._chained = jax.jit(_chained_fn, donate_argnums=(1, 2))
-        self._prefill = jax.jit(_prefill_fn, donate_argnums=(3, 4))
+        self._chained = profiled_jit(
+            "pw.chained_decode", _chained_fn, donate_argnums=(1, 2)
+        )
+        self._prefill = profiled_jit(
+            "pw.prefill", _prefill_fn, donate_argnums=(3, 4)
+        )
+
+    def _record_dispatch(self, prog, t_disp, t_end, items: int) -> None:
+        """Attribute one dispatch->sync window to ``prog``'s registry
+        record.  Guarded getattr: tests (and the bench's stall spies)
+        re-wrap the step attributes with plain closures, which simply
+        drop the attribution."""
+        rec = getattr(prog, "record_dispatch", None)
+        if rec is not None and t_disp is not None:
+            rec(t_end - t_disp, t_end=t_end, items=items)
 
     # -- public API --------------------------------------------------------
     def generate(self, prompt_ids, max_new: int, *,
@@ -1053,6 +1122,7 @@ class PagedDecodeEngine:
             scatter_bt[: len(shared)] = 0
             faults.fire("engine.dispatch.prefill")
             self._note_dispatch("prefill")
+            t_disp_pf = self._t_dispatch
             with _TraceAnnotation("pw.prefill"):
                 ids, self.pool.k, self.pool.v = self._prefill(
                     self.params, jnp.asarray(buf),
@@ -1064,6 +1134,8 @@ class PagedDecodeEngine:
             # sync (watchdog) with no restart budget must not leak the
             # just-prefilled blocks for the engine's lifetime
             first_id = int(self._sync_host(ids)[0])
+            self._record_dispatch(self._prefill, t_disp_pf,
+                                  time.perf_counter(), items=n)
             if self.prefix is not None:
                 # zip inside insert() truncates to the full-block keys, so
                 # a partial tail block (the live decode-write target) is
@@ -1274,6 +1346,8 @@ class PagedDecodeEngine:
                 obs.record_span("engine.chain", t_disp, t_sync1,
                                 ctx=act.req.ctx, k=kreal[i])
             done, n_emitted = self._scan_chain(acts, kreal, ids_np, running)
+            self._record_dispatch(self._chained, t_disp, t_sync1,
+                                  items=n_emitted)
             for act in done:
                 running.remove(act)
                 self.pool.free_sequence(act.seq_id)
@@ -1335,6 +1409,8 @@ class PagedDecodeEngine:
         t_sync1 = time.perf_counter()
         obs.record_span("engine.sync", t_sync0, t_sync1, ctx=self._run_ctx)
         self._note_sync()
+        self._record_dispatch(self._step, t_disp, t_sync1,
+                              items=len(reserved))
         for act, _slot in reserved:
             obs.record_span("engine.decode_step", t_disp, t_sync1,
                             ctx=act.req.ctx)
@@ -1467,6 +1543,7 @@ class PagedDecodeEngine:
         t_sync1 = time.perf_counter()
         obs.record_span("engine.sync", t_sync0, t_sync1, ctx=self._run_ctx)
         self._note_sync()
+        self._record_dispatch(self._mixed, t_disp, t_sync1, items=t)
         self.pool.stats.record_mixed_step(len(rows))
         n_decode = sum(1 for _a, _r, f in rows if f < 0)
         if n_decode:

@@ -223,9 +223,8 @@ def _paged_kernel(bt_ref, c0_ref, cl_ref, q_ref, k_ref, v_ref, o_ref,
         o_ref[:] = (acc_ref[:] / denom).astype(o_ref.dtype)
 
 
-@functools.partial(jax.jit, static_argnames=("d_true", "interpret"))
-def _paged_ragged(q, k_pool, v_pool, block_tables, c0, cl, *,
-                  d_true: int, interpret: bool = False):
+def _paged_ragged_fn(q, k_pool, v_pool, block_tables, c0, cl, *,
+                     d_true: int, interpret: bool = False):
     """q: (B, C, H, Dp); pools (num_blocks, BS, H, Dp), Dp lane-padded;
     c0/cl: (B,) per-row column-0 / last-column context lengths."""
     B, C, H, Dp = q.shape
@@ -264,6 +263,22 @@ def _paged_ragged(q, k_pool, v_pool, block_tables, c0, cl, *,
         interpret=interpret,
     )(block_tables, c0, cl, q, k_pool, v_pool)
     return out.transpose(0, 2, 1, 3)  # (B, C, H, Dp)
+
+
+def _make_paged_ragged():
+    """Jit the standalone kernel entry point through the device cost
+    observatory (Round-14); falls back to a plain jit while the obs
+    package is still importing (circular-import window)."""
+    kwargs = dict(static_argnames=("d_true", "interpret"))
+    try:
+        from ..obs.profiler import profiled_jit
+
+        return profiled_jit("pw.paged_attention", _paged_ragged_fn, **kwargs)
+    except Exception:  # pragma: no cover - import-order edge
+        return jax.jit(_paged_ragged_fn, **kwargs)
+
+
+_paged_ragged = _make_paged_ragged()
 
 
 def paged_attention(q, k_pool, v_pool, block_tables, context_lens=None, *,

@@ -732,10 +732,17 @@ class JaxDecoderLM:
 
         import threading
 
+        from ..obs.profiler import profiled_jit
+
         self._int8_gen_lock = threading.Lock()
-        self._prefill = jax.jit(_prefill_fn)
+        # Round-14: LM entry points register in the device cost
+        # observatory (compile provenance + FLOPs/bytes introspection),
+        # same as the engine's step programs
+        self._prefill = profiled_jit("pw.lm_prefill", _prefill_fn)
         # cache donated: each step consumes the previous cache buffers in place
-        self._step = jax.jit(_step_fn, donate_argnums=(1,))
+        self._step = profiled_jit(
+            "pw.lm_decode_step", _step_fn, donate_argnums=(1,)
+        )
         # fused generation: prefill + whole decode loop in ONE program,
         # compiled per (bucket, max_new, stop) — see generate_tokens_fused
         self._fused = functools.lru_cache(maxsize=16)(self._make_fused)
@@ -748,7 +755,13 @@ class JaxDecoderLM:
                 params, _cfg, token_ids, n_valid, max_new, stop_token
             )
 
-        return jax.jit(fn)
+        from ..obs.profiler import profiled_jit
+
+        # stop_token is baked into the traced program but invisible in
+        # the arg shapes: it must be part of the registry NAME or the
+        # (max_new, stop) variants would read as false RECOMPILEs
+        suffix = "" if stop_token is None else f"_s{stop_token}"
+        return profiled_jit(f"pw.lm_fused_k{max_new}{suffix}", fn)
 
     @classmethod
     def from_hf(cls, model_name_or_path: str, **kwargs) -> "JaxDecoderLM":

@@ -1,0 +1,251 @@
+"""Persistent cost-model store: measured per-program device costs.
+
+A JSON database keyed ``(program, shape bucket, backend fingerprint)``
+holding measured dispatch ms / bytes / MFU — the substrate the
+auto-planner (ROADMAP item 5) queries to choose tp/dp/chain/bucket
+settings without hand-tuning, and what PR 7's measured serving-tier
+A/B pick reads (a prior from earlier runs on the same backend) and
+writes (this run's measurements) through.
+
+Entries are small running aggregates (best/EWMA/count), merged on
+``observe``; a single daemon writer thread flushes dirty state to disk
+atomically every few seconds and drains on ``shutdown()`` (registered
+atexit; the test conftest closes it explicitly so pytest never leaks
+the thread).  The file format is documented in ARCHITECTURE.md
+(Round-14) and versioned for forward compatibility:
+
+```json
+{"version": 1,
+ "entries": {
+   "<program>|<bucket>|<backend fingerprint>": {
+     "program": "pw.chained_decode", "bucket": "tree(194)+f32[...]",
+     "fingerprint": "cpu:unknown:jax0.4.37",
+     "n": 12, "ms_best": 38.2, "ms_avg": 41.0, "ms_last": 40.1,
+     "flops": 1.2e9, "bytes": 3.4e8, "mfu": 0.021,
+     "extra": {"dispatches": 64}, "updated": 1770000000.0}}}
+```
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+_EWMA = 0.3  # weight of the newest observation in ms_avg
+
+
+def backend_fingerprint() -> str:
+    """Identifies what the measurements were taken ON: backend kind,
+    device kind, jax version — a cost measured on one machine must not
+    steer planning on another."""
+    try:
+        import jax
+
+        kind = "unknown"
+        try:
+            kind = jax.devices()[0].device_kind.replace(" ", "-")
+        except Exception:  # noqa: BLE001
+            pass
+        return f"{jax.default_backend()}:{kind}:jax{jax.__version__}"
+    except Exception:  # noqa: BLE001
+        return "unknown"
+
+
+def default_path() -> str:
+    env = os.environ.get("PW_COSTDB_PATH")
+    if env:
+        return env
+    base = os.environ.get("XDG_CACHE_HOME") or os.path.join(
+        os.path.expanduser("~"), ".cache"
+    )
+    return os.path.join(base, "pathway_tpu", "costdb.json")
+
+
+class CostDB:
+    """The persistent (program, bucket, backend) -> measured-cost map."""
+
+    def __init__(self, path: str | None = None,
+                 flush_interval_s: float = 5.0):
+        self.path = path or default_path()
+        self.flush_interval_s = flush_interval_s
+        self.fingerprint = backend_fingerprint()
+        self._lock = threading.Lock()
+        self._entries: dict[str, dict] = {}
+        self._dirty = False
+        self._writer: threading.Thread | None = None
+        self._stop_evt = threading.Event()
+        self._load()
+
+    # -- persistence -------------------------------------------------------
+    def _load(self) -> None:
+        try:
+            with open(self.path, encoding="utf-8") as fh:
+                raw = json.load(fh)
+            if isinstance(raw, dict) and isinstance(raw.get("entries"), dict):
+                self._entries = dict(raw["entries"])
+        except (OSError, ValueError):
+            pass  # missing or corrupt: start empty, next flush heals it
+
+    def flush(self) -> bool:
+        """Atomic write of the current state; returns False on IO failure
+        (a read-only filesystem must never take serving down).  The
+        on-disk entries are re-read and merged first so concurrent
+        processes sharing the file append to — rather than erase — each
+        other's keys (same-key conflicts resolve to this process's
+        fresher observation; best-effort, no file lock)."""
+        with self._lock:
+            if not self._dirty:
+                return True
+            ours = dict(self._entries)
+            self._dirty = False
+        merged = {}
+        try:
+            with open(self.path, encoding="utf-8") as fh:
+                disk = json.load(fh)
+            if isinstance(disk, dict) and isinstance(disk.get("entries"),
+                                                     dict):
+                merged.update(disk["entries"])
+        except (OSError, ValueError):
+            pass
+        merged.update(ours)
+        payload = {"version": 1, "entries": merged}
+        tmp = f"{self.path}.{os.getpid()}.tmp"
+        try:
+            os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+            with open(tmp, "w", encoding="utf-8") as fh:
+                json.dump(payload, fh, default=str)
+            os.replace(tmp, self.path)
+            return True
+        except OSError:
+            with self._lock:
+                self._dirty = True  # retry on the next tick
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            return False
+
+    def _writer_loop(self) -> None:
+        while not self._stop_evt.wait(self.flush_interval_s):
+            self.flush()
+        self.flush()  # final drain
+
+    def _ensure_writer(self) -> None:
+        # under the lock: two first-observers racing here must not each
+        # spawn a writer (duplicate flush loops for the process lifetime)
+        with self._lock:
+            if self._writer is not None and self._writer.is_alive():
+                return
+            if self._stop_evt.is_set():
+                return  # shut down: no resurrection, caller flushes
+            self._writer = threading.Thread(
+                target=self._writer_loop, daemon=True,
+                name="pw-costdb-writer",
+            )
+            self._writer.start()
+
+    def shutdown(self, timeout_s: float = 5.0) -> None:
+        """Stop the writer (final flush included).  Idempotent."""
+        self._stop_evt.set()
+        w = self._writer
+        if w is not None and w.is_alive():
+            w.join(timeout=timeout_s)
+        self._writer = None
+        self.flush()
+
+    @property
+    def writer_alive(self) -> bool:
+        return self._writer is not None and self._writer.is_alive()
+
+    # -- the map -----------------------------------------------------------
+    def key(self, program: str, bucket: str) -> str:
+        return f"{program}|{bucket}|{self.fingerprint}"
+
+    def observe(self, program: str, bucket: str, *, ms: float | None = None,
+                flops: float | None = None, bytes: float | None = None,
+                mfu: float | None = None, extra: dict | None = None) -> dict:
+        """Merge one measurement into the store (running best/EWMA) and
+        schedule a flush."""
+        k = self.key(program, bucket)
+        with self._lock:
+            e = self._entries.get(k)
+            if e is None:
+                e = self._entries[k] = {
+                    "program": program, "bucket": bucket,
+                    "fingerprint": self.fingerprint, "n": 0,
+                }
+            e["n"] = int(e.get("n", 0)) + 1
+            if ms is not None:
+                ms = float(ms)
+                e["ms_last"] = round(ms, 4)
+                e["ms_best"] = round(
+                    min(float(e.get("ms_best", ms)), ms), 4
+                )
+                prev = e.get("ms_avg")
+                e["ms_avg"] = round(
+                    ms if prev is None
+                    else (1 - _EWMA) * float(prev) + _EWMA * ms, 4
+                )
+            for name, val in (("flops", flops), ("bytes", bytes),
+                              ("mfu", mfu)):
+                if val is not None:
+                    e[name] = val
+            if extra:
+                e.setdefault("extra", {}).update(extra)
+            e["updated"] = round(time.time(), 1)
+            self._dirty = True
+            out = dict(e)
+        self._ensure_writer()
+        return out
+
+    def get(self, program: str, bucket: str) -> dict | None:
+        """The entry for (program, bucket) under THIS backend
+        fingerprint, or None — cross-backend entries are invisible by
+        construction."""
+        with self._lock:
+            e = self._entries.get(self.key(program, bucket))
+            return dict(e) if e else None
+
+    def entries(self, program: str | None = None) -> list[dict]:
+        with self._lock:
+            out = [dict(e) for e in self._entries.values()]
+        if program is not None:
+            out = [e for e in out if e.get("program") == program]
+        return out
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+
+_default: CostDB | None = None
+_default_lock = threading.Lock()
+
+
+def default_db() -> CostDB:
+    """The process-wide store at :func:`default_path` (override with
+    ``PW_COSTDB_PATH``)."""
+    global _default
+    with _default_lock:
+        if _default is None:
+            _default = CostDB()
+        return _default
+
+
+def shutdown(timeout_s: float = 5.0) -> None:
+    """Stop the default store's writer thread (final flush included).
+    Idempotent; registered atexit, and the test conftest calls it so a
+    pytest session never ends with the thread running."""
+    global _default
+    with _default_lock:
+        db = _default
+        _default = None
+    if db is not None:
+        db.shutdown(timeout_s)
+
+
+import atexit  # noqa: E402  (registration belongs with shutdown)
+
+atexit.register(shutdown)

@@ -1,0 +1,331 @@
+"""HBM ledger + pre-flight fit checks for the paged decode engine.
+
+A PagedDecodeEngine configuration that cannot fit HBM used to OOM at
+first dispatch — after compile, mid-request, with a driver error that
+names no knob.  Round-14 accounts for the three HBM consumers UP FRONT
+(in the spirit of "Memory Safe Computations with XLA", arxiv
+2206.14148) so an unfittable ``(num_blocks, chain_steps, max_batch)``
+is rejected at CONSTRUCTION with the budget and the largest fitting
+alternative named:
+
+- **params**: the decoder weights, per tensor-parallel shard;
+- **KV pool**: BlockPool's stacked K/V arrays — the same per-shard
+  formula as PR 4's ``shard_hbm_bytes`` gauge;
+- **step temps**: the transient working set of the largest step
+  program.  When the program registry (obs/profiler.py) already holds
+  a MEASURED ``memory_analysis()`` temp watermark for the engine's
+  programs, that is used; otherwise an analytic estimate covering the
+  reference path's gathered K/V copy, the score matrix, the packed
+  activation stream and the logits head.
+
+The budget resolves from (in order) an explicit argument, the
+``PW_HBM_BUDGET_BYTES`` env, or the device's ``memory_stats()`` limit
+on a real TPU backend.  With no budget known (the CPU test fallback),
+``hbm_plan`` still reports the ledger but ``fits`` is not enforced.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+
+
+def resolve_budget(explicit: int | None = None) -> tuple[int | None, str]:
+    """(budget_bytes | None, source)."""
+    if explicit:
+        return int(explicit), "explicit"
+    env = os.environ.get("PW_HBM_BUDGET_BYTES")
+    if env:
+        try:
+            return int(float(env)), "env:PW_HBM_BUDGET_BYTES"
+        except ValueError:
+            pass
+    try:
+        import jax
+
+        if jax.default_backend() == "tpu":
+            stats = jax.devices()[0].memory_stats() or {}
+            lim = stats.get("bytes_limit")
+            if lim:
+                return int(lim), "device:memory_stats"
+    except Exception:  # noqa: BLE001 - budget degrades to unenforced
+        pass
+    return None, "none"
+
+
+def _dtype_itemsize(dtype) -> int:
+    import numpy as np
+
+    try:
+        return np.dtype(dtype).itemsize
+    except TypeError:
+        # jax dtypes like bfloat16 that numpy cannot parse directly
+        return int(getattr(dtype, "itemsize", None)
+                   or getattr(dtype, "dtype", np.dtype("float32")).itemsize)
+
+
+def _params_bytes(cfg, params, tp: int, itemsize: int) -> int:
+    """Per-shard parameter bytes: exact when the live pytree is given
+    (its leaves may already be sharded jax arrays — global sizes divided
+    by tp approximate the per-shard slice; replicated biases are noise
+    at this scale), analytic from the config otherwise."""
+    if params is not None:
+        try:
+            import jax
+
+            total = sum(
+                l.size * _dtype_itemsize(l.dtype)
+                for l in jax.tree_util.tree_leaves(params)
+                if hasattr(l, "size")
+            )
+            return int(total // max(tp, 1))
+        except Exception:  # noqa: BLE001 - fall through to analytic
+            pass
+    d, v, ff, ln = cfg.d_model, cfg.vocab_size, cfg.d_ff, cfg.n_layers
+    n = v * d + cfg.max_len * d + ln * (4 * d * d + 2 * d * ff + 9 * d) \
+        + 2 * d
+    return int(n * itemsize // max(tp, 1))
+
+
+def kv_pool_bytes(cfg, *, num_blocks: int, block_size: int, tp: int,
+                  itemsize: int) -> int:
+    """K + V bytes held by EACH shard — BlockPool.per_shard_bytes
+    computed from the configuration before the pool exists."""
+    hd = cfg.d_model // cfg.n_heads
+    heads = max(cfg.n_heads // max(tp, 1), 1)
+    return 2 * cfg.n_layers * num_blocks * block_size * heads * hd * itemsize
+
+
+def _temp_bytes(cfg, *, num_blocks: int, block_size: int,
+                max_batch_size: int, chain_steps: int, prefill_chunk: int,
+                tp: int, itemsize: int, reference_attn: bool) -> int:
+    """Analytic transient working set of the LARGEST step program (the
+    ragged mixed step, or the chained program when its scan carries
+    dominate).  Used when the registry has no measured watermark yet —
+    construction time, before anything compiled."""
+    B = max_batch_size
+    C = max(prefill_chunk, 1)
+    T = B + C
+    d = cfg.d_model
+    hd = d // cfg.n_heads
+    heads = max(cfg.n_heads // max(tp, 1), 1)
+    vocab = cfg.vocab_size // max(tp, 1)
+    # a sequence's table can span at most the pool (minus the null block)
+    nb_seq = min(-(-cfg.max_len // block_size),
+                 max(num_blocks - 1, 1))
+    ctx = nb_seq * block_size
+    # the reference gather path materializes the gathered K/V copy
+    # (B, NB*BS, H, hd) x2 per layer plus the (B, H, C, NB*BS) scores;
+    # the Pallas kernel keeps both in VMEM (≈0 HBM temps)
+    gather = (
+        2 * B * ctx * heads * hd * itemsize + B * heads * C * ctx * 4
+        if reference_attn else 0
+    )
+    acts = 6 * T * max(d, cfg.d_ff) * itemsize  # packed stream residuals
+    logits = B * vocab * 4  # f32 head output
+    chain = B * max(chain_steps, 1) * 4 * 2  # [B, K] ids carry + stack
+    return int(gather + acts + logits + chain)
+
+
+@dataclasses.dataclass
+class HbmPlan:
+    """The ledger for one engine configuration.  ``fits`` is only
+    meaningful when ``budget_bytes`` resolved; ``fits_with`` re-plans
+    with overrides (the pre-flight what-if the auto-planner queries)."""
+
+    params_bytes: int
+    kv_bytes: int
+    temp_bytes: int
+    temp_source: str
+    budget_bytes: int | None
+    budget_source: str
+    num_blocks: int
+    block_size: int
+    max_batch_size: int
+    chain_steps: int
+    prefill_chunk: int
+    tp: int
+    _replan: "object" = dataclasses.field(default=None, repr=False)
+
+    @property
+    def total_bytes(self) -> int:
+        return self.params_bytes + self.kv_bytes + self.temp_bytes
+
+    @property
+    def fits(self) -> bool:
+        return self.budget_bytes is None or \
+            self.total_bytes <= self.budget_bytes
+
+    @property
+    def per_block_bytes(self) -> int:
+        return self.kv_bytes // max(self.num_blocks, 1)
+
+    def fits_with(self, *, num_blocks: int | None = None,
+                  chain_steps: int | None = None,
+                  max_batch_size: int | None = None) -> bool:
+        """Would ``(num_blocks, chain_steps, max_batch)`` fit the same
+        budget? — the check PagedDecodeEngine runs before allocating."""
+        return self.with_(
+            num_blocks=num_blocks, chain_steps=chain_steps,
+            max_batch_size=max_batch_size,
+        ).fits
+
+    def with_(self, *, num_blocks: int | None = None,
+              chain_steps: int | None = None,
+              max_batch_size: int | None = None) -> "HbmPlan":
+        return self._replan(
+            num_blocks=(self.num_blocks if num_blocks is None
+                        else int(num_blocks)),
+            chain_steps=(self.chain_steps if chain_steps is None
+                         else int(chain_steps)),
+            max_batch_size=(self.max_batch_size if max_batch_size is None
+                            else int(max_batch_size)),
+        )
+
+    def max_fitting_num_blocks(self) -> int | None:
+        """Largest ``num_blocks`` that fits at the current chain/batch
+        (temp depends weakly on num_blocks through the max table span,
+        so the closed form is verified and walked down if needed)."""
+        if self.budget_bytes is None:
+            return self.num_blocks
+        per_block = max(self.per_block_bytes, 1)
+        nb = (self.budget_bytes - self.params_bytes - self.temp_bytes) \
+            // per_block
+        nb = min(int(nb), self.num_blocks)
+        while nb >= 2 and not self.with_(num_blocks=nb).fits:
+            nb -= max(nb // 8, 1)
+        return nb if nb >= 2 else None
+
+    def largest_fitting(self) -> dict | None:
+        """The largest fitting alternative the rejection message names:
+        first shrink ``num_blocks``; if even a minimal pool cannot fit,
+        shrink ``max_batch_size`` then ``chain_steps`` too."""
+        nb = self.max_fitting_num_blocks()
+        if nb is not None:
+            return {"num_blocks": nb, "chain_steps": self.chain_steps,
+                    "max_batch_size": self.max_batch_size,
+                    "total_bytes": self.with_(num_blocks=nb).total_bytes}
+        for batch in (self.max_batch_size // 2, 2, 1):
+            if batch < 1:
+                continue
+            for k in (self.chain_steps, 1):
+                alt = self.with_(max_batch_size=batch, chain_steps=k)
+                nb = alt.max_fitting_num_blocks()
+                if nb is not None:
+                    return {"num_blocks": nb, "chain_steps": k,
+                            "max_batch_size": batch,
+                            "total_bytes":
+                                alt.with_(num_blocks=nb).total_bytes}
+        return None
+
+    def reject_message(self) -> str:
+        mb = 1024 * 1024
+        alt = self.largest_fitting()
+        alt_txt = (
+            f"largest fitting alternative: num_blocks={alt['num_blocks']} "
+            f"(chain_steps={alt['chain_steps']}, "
+            f"max_batch_size={alt['max_batch_size']}) at "
+            f"{alt['total_bytes'] / mb:.1f}MB"
+            if alt else
+            "no (num_blocks, chain_steps, max_batch) configuration fits"
+        )
+        return (
+            f"engine configuration cannot fit HBM: params "
+            f"{self.params_bytes / mb:.1f}MB + KV pool "
+            f"{self.kv_bytes / mb:.1f}MB ({self.num_blocks} blocks x "
+            f"{self.block_size} tokens, tp={self.tp}) + step temps "
+            f"{self.temp_bytes / mb:.1f}MB ({self.temp_source}) = "
+            f"{self.total_bytes / mb:.1f}MB > HBM budget "
+            f"{self.budget_bytes / mb:.1f}MB ({self.budget_source}); "
+            f"{alt_txt}"
+        )
+
+    def as_dict(self) -> dict:
+        return {
+            "params_bytes": self.params_bytes,
+            "kv_bytes": self.kv_bytes,
+            "temp_bytes": self.temp_bytes,
+            "temp_source": self.temp_source,
+            "total_bytes": self.total_bytes,
+            "budget_bytes": self.budget_bytes,
+            "budget_source": self.budget_source,
+            "fits": self.fits,
+            "num_blocks": self.num_blocks,
+            "block_size": self.block_size,
+            "max_batch_size": self.max_batch_size,
+            "chain_steps": self.chain_steps,
+            "tp": self.tp,
+        }
+
+
+def hbm_plan(cfg, *, num_blocks: int, block_size: int,
+             max_batch_size: int = 8, chain_steps: int = 8,
+             prefill_chunk: int | None = None, tp: int = 1, dtype=None,
+             params=None, budget_bytes: int | None = None,
+             reference_attn: bool = True) -> HbmPlan:
+    """Build the HBM ledger for one engine configuration.
+
+    ``params`` (the live pytree) makes the weights term exact;
+    ``dtype`` defaults to float32.  The temp watermark prefers a
+    MEASURED ``memory_analysis()`` value from the program registry when
+    one is already cached (a warmed engine re-planning), else the
+    analytic estimate."""
+    import numpy as np
+
+    itemsize = _dtype_itemsize(dtype) if dtype is not None \
+        else np.dtype("float32").itemsize
+    budget, budget_source = resolve_budget(budget_bytes)
+    pchunk = int(prefill_chunk) if prefill_chunk else 2 * int(block_size)
+    pb = _params_bytes(cfg, params, tp, itemsize)
+
+    def _measured_temp(num_blocks: int) -> int | None:
+        """Registry watermark restricted to THIS geometry: the step
+        programs' buckets carry the pool shape, so another model's (or
+        pool size's) measured temps never inflate this fit check."""
+        try:
+            from . import profiler as _profiler
+
+            hd = cfg.d_model // cfg.n_heads
+            # the pool array's GLOBAL shape: BlockPool allocates full
+            # n_heads even under tp (sharding splits the head axis but
+            # jax arrays — and so the bucket labels — report global dims)
+            pool_sig = (
+                f"[{cfg.n_layers},{num_blocks},{int(block_size)},"
+                f"{cfg.n_heads},{hd}]"
+            )
+            return _profiler.registry().max_temp_bytes(
+                prefix="pw.", bucket_contains=pool_sig,
+            )
+        except Exception:  # noqa: BLE001
+            return None
+
+    def _build(*, num_blocks: int, chain_steps: int,
+               max_batch_size: int) -> HbmPlan:
+        measured = _measured_temp(num_blocks)
+        kv = kv_pool_bytes(cfg, num_blocks=num_blocks,
+                           block_size=int(block_size), tp=tp,
+                           itemsize=itemsize)
+        analytic = _temp_bytes(
+            cfg, num_blocks=num_blocks, block_size=int(block_size),
+            max_batch_size=max_batch_size, chain_steps=chain_steps,
+            prefill_chunk=pchunk, tp=tp, itemsize=itemsize,
+            reference_attn=reference_attn,
+        )
+        temp, source = (
+            (max(measured, analytic), "measured+analytic")
+            if measured else (analytic, "analytic")
+        )
+        plan = HbmPlan(
+            params_bytes=pb, kv_bytes=kv, temp_bytes=temp,
+            temp_source=source, budget_bytes=budget,
+            budget_source=budget_source, num_blocks=int(num_blocks),
+            block_size=int(block_size),
+            max_batch_size=int(max_batch_size),
+            chain_steps=int(chain_steps), prefill_chunk=pchunk, tp=tp,
+        )
+        plan._replan = _build
+        return plan
+
+    return _build(num_blocks=int(num_blocks),
+                  chain_steps=max(1, int(chain_steps)),
+                  max_batch_size=int(max_batch_size))

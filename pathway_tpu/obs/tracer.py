@@ -225,6 +225,15 @@ class FlightRecorder:
                 "tid": s.tid,
                 "args": args,
             })
+        # Round-14: per-program dispatch-cost counter tracks from the
+        # device cost observatory ride in every dump, so Perfetto shows
+        # kernel cost curves next to the span timeline
+        try:
+            from . import profiler as _profiler
+
+            events.extend(_profiler.counter_events(_EPOCH_PERF, _PID))
+        except Exception:  # noqa: BLE001 - dumps must never fail on extras
+            pass
         return {"traceEvents": events, "displayTimeUnit": "ms"}
 
     def chrome_trace_json(self, trace_id: str | None = None) -> str:

@@ -60,14 +60,20 @@ def _jit_segment_sum(n_padded: int, n_groups_padded: int, dtype_str: str):
     fn = _jit_cache.get(key)
     if fn is None:
         import jax
-        import jax.numpy as jnp
 
         def prog(values, codes):
             return jax.ops.segment_sum(
                 values, codes, num_segments=n_groups_padded
             )
 
-        fn = jax.jit(prog)
+        # Round-14: the data plane's reduce program registers in the
+        # device cost observatory alongside the serving-path programs
+        try:
+            from ..obs.profiler import profiled_jit
+
+            fn = profiled_jit("pw.segment_sum", prog)
+        except Exception:  # pragma: no cover - import-order edge
+            fn = jax.jit(prog)
         _jit_cache[key] = fn
     return fn
 
@@ -110,10 +116,17 @@ def segment_sum(values, codes, n_groups: int, *, weights=None):
 
 def jit_map(fn):
     """map building block: element-wise `fn` vmapped+jitted once — the
-    per-shard transform of a map/reduce pipeline as one device program."""
+    per-shard transform of a map/reduce pipeline as one device program
+    (registered in the device cost observatory under the fn's name)."""
     import jax
 
-    return jax.jit(jax.vmap(fn))
+    name = getattr(fn, "__name__", "fn")
+    try:
+        from ..obs.profiler import profiled_jit
+
+        return profiled_jit(f"pw.map.{name}", jax.vmap(fn))
+    except Exception:  # pragma: no cover - import-order edge
+        return jax.jit(jax.vmap(fn))
 
 
 # -- exchange consolidation (aggregates-only fabric traffic) ---------------

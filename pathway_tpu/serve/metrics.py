@@ -394,11 +394,22 @@ def reset_registry() -> None:
         _kv_registry.clear()
 
 
+def _render_xla_lines() -> list[str]:
+    """Round-14 device-program lines (``pathway_xla_*``) from the cost
+    observatory — cached values only, a scrape never triggers lowering."""
+    try:
+        from ..obs import profiler
+
+        return profiler.render_prometheus_lines()
+    except Exception:
+        return []
+
+
 def render_prometheus_lines() -> list[str]:
     """Prometheus text-format lines, appended to MetricsServer.render()."""
     stats = all_stats()
     if not stats:
-        return _render_kv_lines()
+        return _render_kv_lines() + _render_xla_lines()
     lines = [
         "# TYPE pathway_serve_queue_depth gauge",
         "# TYPE pathway_serve_admitted_total counter",
@@ -440,6 +451,7 @@ def render_prometheus_lines() -> list[str]:
             f"{snap['time_in_queue_s']:.6f}"
         )
     lines.extend(_render_kv_lines())
+    lines.extend(_render_xla_lines())
     return lines
 
 

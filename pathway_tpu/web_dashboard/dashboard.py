@@ -67,6 +67,17 @@ class DashboardServer:
                 dict(parse_qsl(path.partition("?")[2]))
             ).encode()
             return 200, body, "application/json"
+        if path.split("?", 1)[0] == "/debug/profile":
+            # device cost observatory (round-14): this process's
+            # per-program compile/FLOPs/dispatch/roofline table
+            from urllib.parse import parse_qsl
+
+            from ..obs import profiler
+
+            body = profiler.profile_dump(
+                dict(parse_qsl(path.partition("?")[2]))
+            ).encode()
+            return 200, body, "application/json"
         if path.startswith("/metrics/") or path == "/graph":
             conn = self._ensure_conn()
             if path == "/metrics/latest":

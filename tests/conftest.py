@@ -48,10 +48,13 @@ def clear_parse_graph():
 
 @pytest.fixture(autouse=True, scope="session")
 def _obs_flusher_shutdown():
-    """Round-11 hygiene: the flight recorder's background flusher must
-    never outlive the test session (a dangling thread flakes
-    --continue-on-collection-errors runs)."""
+    """Round-11/14 hygiene: neither the flight recorder's background
+    flusher nor the cost store's writer thread may outlive the test
+    session (a dangling thread flakes --continue-on-collection-errors
+    runs)."""
     yield
     from pathway_tpu import obs
+    from pathway_tpu.obs import costdb
 
     obs.shutdown()
+    costdb.shutdown()

@@ -23,7 +23,6 @@ Pins the tentpole guarantees:
   the dashboard kv table.
 """
 
-import logging
 
 import jax
 import jax.numpy as jnp
@@ -271,44 +270,27 @@ def test_chained_second_pass_zero_recompiles(params):
     """The chained program's (B, chain_steps) shape is static: running
     the same quiet workload twice must not compile anything on the
     second pass (an accidentally K- or length-polymorphic input would
-    show up here as a per-chain compile)."""
+    show up here as a per-chain compile).  Round-14: registry-based
+    guard — a failure prints the offending program's recorded
+    provenance (triggering shapes + stack summary) via CompileWatch."""
+    from .utils import CompileWatch
+
     eng = _engine(params, "t_ch_compile", 8)
     prompts = _prompts((3, 9, 15, 21), seed=23)
     reqs = [(p, 11) for p in prompts]
-
-    class _Capture(logging.Handler):
-        def __init__(self):
-            super().__init__()
-            self.compiles = []
-
-        def emit(self, record):
-            msg = record.getMessage()
-            if msg.startswith("Compiling "):
-                self.compiles.append(msg)
-
-    jax_logger = logging.getLogger("jax")
-    old_level = jax_logger.level
-
-    def _run_captured():
-        handler = _Capture()
-        jax_logger.addHandler(handler)
-        jax_logger.setLevel(logging.WARNING)
-        try:
-            with jax.log_compiles(True):
-                eng.generate_batch(list(reqs))
-        finally:
-            jax_logger.removeHandler(handler)
-            jax_logger.setLevel(old_level)
-        return handler.compiles
-
-    first = _run_captured()
-    assert first, "capture mechanism saw no compiles on the cold pass"
+    watch = CompileWatch()
+    eng.generate_batch(list(reqs))
+    first = watch.events()
+    assert first, "registry saw no compiles on the cold pass"
+    # the chained program itself is among the cold-pass compiles, with
+    # its compile wall time recorded
+    assert any(
+        e.program == "pw.chained_decode" and e.compile_s > 0 for e in first
+    ), [e.program for e in first]
     snap = eng.pool.stats.snapshot()
     assert snap["chain_steps_sum"] > snap["chain_count"]  # really chained
-    second = _run_captured()
-    assert second == [], (
-        f"second pass recompiled {len(second)} programs: {second[:4]}"
-    )
+    eng.generate_batch(list(reqs))
+    watch.assert_no_compiles("second pass")
 
 
 # -- observability ------------------------------------------------------------

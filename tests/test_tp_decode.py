@@ -20,7 +20,6 @@ Pins the tentpole guarantees on the tier-1 virtual 8-device mesh
   dashboard with a ``shard=`` label.
 """
 
-import logging
 import re
 
 import jax
@@ -207,9 +206,13 @@ def test_tp_validation_fails_loudly(params):
 
 
 def test_tp8_second_pass_triggers_zero_recompiles(params):
-    """Chunked mode must still compile exactly its two static step shapes
-    under shard_map: a second pass over a bucket-straddling workload
-    triggers ZERO new XLA compilations."""
+    """Chunked mode must still compile only its static step shapes under
+    shard_map: a second pass over a bucket-straddling workload triggers
+    ZERO new XLA compilations.  Round-14: registry-based guard — a
+    failure prints the offending program's recorded provenance
+    (triggering shapes + stack) instead of a log-line count."""
+    from .utils import CompileWatch
+
     eng = _engine(params, 8, "t_tp_compile", block_size=8,
                   prefill_chunk=16)
     rng = np.random.default_rng(23)
@@ -217,38 +220,12 @@ def test_tp8_second_pass_triggers_zero_recompiles(params):
         ([int(t) for t in rng.integers(0, _CFG.vocab_size, size=n)], 5)
         for n in (3, 9, 15, 16, 21, 33, 40, 60)
     ]
-
-    class _Capture(logging.Handler):
-        def __init__(self):
-            super().__init__()
-            self.compiles = []
-
-        def emit(self, record):
-            msg = record.getMessage()
-            if msg.startswith("Compiling "):
-                self.compiles.append(msg)
-
-    jax_logger = logging.getLogger("jax")
-    old_level = jax_logger.level
-
-    def _run_captured():
-        handler = _Capture()
-        jax_logger.addHandler(handler)
-        jax_logger.setLevel(logging.WARNING)
-        try:
-            with jax.log_compiles(True):
-                eng.generate_batch(list(reqs))
-        finally:
-            jax_logger.removeHandler(handler)
-            jax_logger.setLevel(old_level)
-        return handler.compiles
-
-    first = _run_captured()
-    assert first, "capture mechanism saw no compiles on the cold pass"
-    second = _run_captured()
-    assert second == [], (
-        f"second pass recompiled {len(second)} programs: {second[:4]}"
-    )
+    watch = CompileWatch()
+    eng.generate_batch(list(reqs))
+    first = watch.events()
+    assert first, "registry saw no compiles on the cold pass"
+    eng.generate_batch(list(reqs))
+    watch.assert_no_compiles("second pass (tp=8)")
 
 
 # -- per-shard metrics surface ------------------------------------------------

@@ -305,3 +305,56 @@ def bare_fabric(pid: int = 0, peers=(1,)):
         f.stats[f"wait_marks_s_p{p}"] = 0.0
     f._obs_ctx = (obs.new_trace_id(), 0)
     return f
+
+
+class CompileWatch:
+    """Round-14 zero-recompile idiom, replacing the jax_log_compiles
+    log-string capture: compile events come from the device cost
+    observatory's program registry (pathway_tpu.obs.profiler), so a
+    guard failure prints each offender's RECORDED PROVENANCE — program
+    name, the triggering arg shapes/dtypes, and a stack summary —
+    instead of an opaque "Compiling ..." log line count.
+
+        watch = CompileWatch()
+        run_workload()          # cold pass
+        assert watch.events()   # the capture mechanism really sees
+        run_workload()          # warm pass
+        watch.assert_no_compiles("second pass")
+
+    Breadth note: besides the registry (wrapped programs, with
+    provenance), the watch also tracks jax.monitoring's process-wide
+    backend-compile counter, so a recompile of an UNWRAPPED jit — the
+    coverage the old log capture had — still fails the guard (with a
+    pointer to wrap it, instead of provenance).
+    """
+
+    def __init__(self):
+        from pathway_tpu.obs import profiler
+
+        self._profiler = profiler
+        self._reg = profiler.registry()
+        self._mark = self._reg.total_compiles()
+        self._backend_mark = profiler.total_backend_compiles()
+
+    def events(self):
+        """Registry compile events since the last call (or construction);
+        also re-marks the process-wide backend counter."""
+        evs = self._reg.compile_events(since=self._mark)
+        self._mark = self._reg.total_compiles()
+        self._backend_mark = self._profiler.total_backend_compiles()
+        return evs
+
+    def assert_no_compiles(self, label: str = "warm pass"):
+        backend_before = self._backend_mark
+        evs = self.events()
+        assert not evs, (
+            f"{label} recompiled {len(evs)} program(s); recorded "
+            "provenance:\n\n" + "\n\n".join(e.describe() for e in evs)
+        )
+        backend_grew = self._backend_mark - backend_before
+        assert backend_grew == 0, (
+            f"{label} triggered {backend_grew} XLA backend compile(s) "
+            "from a jit NOT registered in the device cost observatory "
+            "(no provenance available — wrap the entry point with "
+            "obs.profiler.profiled_jit to name it)"
+        )
