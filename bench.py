@@ -580,6 +580,135 @@ pw.run(persistence_config=pw.persistence.Config(
     return out
 
 
+def bench_fleet() -> dict:
+    """Round-15 replica-fleet rows (soft self-history gates):
+
+    - ``decode_tokens_per_s_sampled``: device-side temperature/top-k/
+      top-p decode throughput through the chained scan;
+    - ``replica_kill_recovery_s``: kill ONE replica of a 2-replica
+      fleet mid-decode (chaos ``raise`` + max_restarts=0), measure
+      failure -> first recovered token on the surviving peer, with
+      token identity verified against a clean greedy run;
+    - ``session_resume_ms_p99``: host-tier suspend/resume round-trip
+      latency across real conversation turns;
+    - ``sessions_resident_at_fixed_hbm`` (+ ``session_residency_gain``):
+      the computed ``hbm_plan`` ledger row — sessions resumable at the
+      engine's HBM budget with the host tier vs paged-only.
+
+    Any section degrades to an error note instead of failing the
+    bench."""
+    import threading as _threading
+
+    out: dict = {}
+    try:
+        import jax as _jax
+        import numpy as _np
+
+        from pathway_tpu import faults as _faults
+        from pathway_tpu.kvcache import PagedDecodeEngine
+        from pathway_tpu.kvcache.tiering import SessionStore
+        from pathway_tpu.models.decoder import (
+            DecoderConfig as _DC, init_decoder_params as _init,
+        )
+        from pathway_tpu.serve.fleet import ReplicaFleet
+
+        cfg = _DC(vocab_size=256, d_model=64, n_layers=2, n_heads=4,
+                  d_ff=128, max_len=128)
+        params = _init(cfg, _jax.random.PRNGKey(0))
+        ekw = dict(num_blocks=128, block_size=4, max_batch_size=8,
+                   seq_buckets=(16, 32, 64), prefill_chunk=8,
+                   chain_steps=4)
+        rng = _np.random.default_rng(7)
+        # ---- sampled decode throughput --------------------------------
+        eng = PagedDecodeEngine(
+            cfg, params, name="bench_fleet_sampled", **ekw
+        )
+        sreqs = [
+            (list(rng.integers(1, 256, size=6)), 32,
+             {"sampling": (0.9, 40, 0.95, 1000 + i)})
+            for i in range(8)
+        ]
+        eng.generate_batch(
+            [(list(p), n, dict(o)) for p, n, o in sreqs]
+        )  # warm: compiles the sampled step variants
+        t0 = time.perf_counter()
+        got = eng.generate_batch(
+            [(list(p), n, dict(o)) for p, n, o in sreqs]
+        )
+        el = time.perf_counter() - t0
+        out["decode_tokens_per_s_sampled"] = round(
+            sum(len(g) for g in got) / el, 1
+        )
+        # ---- replica kill -> recovery on a peer -----------------------
+        prompts = [list(rng.integers(1, 256, size=5)) for _ in range(6)]
+        clean = eng.generate_batch([(list(p), 12) for p in prompts])
+        store = SessionStore()
+        fleet = ReplicaFleet(
+            cfg, params, replicas=2, name="bench_fleet",
+            session_store=store, max_restarts=0, **ekw,
+        )
+        try:
+            _faults.clear()
+            _faults.install("engine.dispatch.chain", "raise", nth=3)
+            results: list = [None] * len(prompts)
+
+            def _run(i):
+                try:
+                    results[i] = fleet.submit(list(prompts[i]), 12)
+                except Exception as exc:  # noqa: BLE001 - recorded below
+                    results[i] = exc
+
+            threads = [
+                _threading.Thread(target=_run, args=(i,))
+                for i in range(len(prompts))
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=240)
+            _faults.clear()
+            fstats = fleet.stats()
+            rec = fstats["recovery_s"]
+            if rec:
+                out["replica_kill_recovery_s"] = round(max(rec), 4)
+                out["replica_kill_recoveries"] = len(rec)
+            else:
+                out["replica_kill_note"] = (
+                    "fault fired with no in-flight request stranded; "
+                    "no recovery window to measure"
+                )
+            out["replica_kill_token_identical"] = bool(results == clean)
+            out["replicas_live_after_kill"] = fstats["live"]
+            # ---- session tier: resume latency + residency ledger ------
+            for i in range(4):
+                p = list(rng.integers(1, 256, size=8))
+                turn1 = fleet.submit(p, 8, session=f"bench-sess-{i}")
+                fleet.submit(
+                    p + turn1 + [3], 8, session=f"bench-sess-{i}"
+                )
+            st = store.stats()
+            out["session_resume_ms_p99"] = round(st["resume_ms_p99"], 2)
+            out["session_resumes"] = st["resumes"]
+            live = fleet.live_replicas()
+            plan = (live[0] if live else fleet.replicas[0]).engine.hbm_plan
+            ledger = store.residency_ledger(
+                plan, session_tokens=64,
+                host_budget_bytes=256 * 1024 * 1024,
+            )
+            out["sessions_resident_at_fixed_hbm"] = (
+                ledger["sessions_resident"]
+            )
+            out["sessions_paged_only"] = ledger["paged_only_sessions"]
+            out["session_residency_gain"] = round(
+                ledger["residency_gain"], 1
+            )
+        finally:
+            fleet.shutdown(drain=False)
+    except Exception as exc:  # noqa: BLE001 - never cost the headline
+        out["fleet_error"] = f"{type(exc).__name__}: {exc}"[:300]
+    return out
+
+
 def bench_parallel(n_rows_per_file: int = 50_000, n_files: int = 16) -> dict:
     """Measured multi-process scaling of the engine data plane.  On a
     single-core host this honestly reports <= 1x (processes time-slice one
@@ -1781,6 +1910,25 @@ _HISTORY_BESTS = {
     # a regression here is a prompt to look at the registry's ranked
     # compile table, not a hard failure)
     "compile_s_total": ("min", lambda p: p.get("compile_s_total")),
+    # round-15 replica-fleet rows (SOFT — deliberately NOT in
+    # _GATED_METRICS): sampled decode throughput, replica-kill MTTR,
+    # host-tier resume latency, and the HBM-ledger session residency
+    "fleet.decode_tokens_per_s_sampled": (
+        "max",
+        lambda p: (p.get("fleet") or {}).get("decode_tokens_per_s_sampled"),
+    ),
+    "fleet.replica_kill_recovery_s": (
+        "min",
+        lambda p: (p.get("fleet") or {}).get("replica_kill_recovery_s"),
+    ),
+    "fleet.session_resume_ms_p99": (
+        "min",
+        lambda p: (p.get("fleet") or {}).get("session_resume_ms_p99"),
+    ),
+    "fleet.sessions_resident_at_fixed_hbm": (
+        "max",
+        lambda p: (p.get("fleet") or {}).get("sessions_resident_at_fixed_hbm"),
+    ),
 }
 
 
@@ -2368,6 +2516,9 @@ def main() -> None:
     _stage("resilience")
     resilience = bench_resilience()
     _PARTIAL["resilience"] = resilience
+    _stage("fleet")
+    fleet = bench_fleet()
+    _PARTIAL["fleet"] = fleet
 
     # last-chance TPU acquisition: if the tunnel healed since startup,
     # capture real TPU evidence (MFU / Pallas / fused generation) now and
@@ -2442,6 +2593,10 @@ def main() -> None:
         # round-13 MTTR rows: failure -> recovery latency per plane
         # (soft self-history gates; see bench_resilience)
         "resilience": resilience,
+        # round-15 replica-fleet rows: sampled decode throughput,
+        # replica-kill MTTR, session-tier resume p99 and the HBM-ledger
+        # residency row (soft self-history gates; see bench_fleet)
+        "fleet": fleet,
         "n_docs": n_docs,
         "embed_dim": enc.dimensions,
         "backend": backend,

@@ -229,6 +229,53 @@ class _HttpError(Exception):
         super().__init__(reason)
 
 
+class StreamingResponse:
+    """Handler return sentinel: stream the response as Server-Sent
+    Events with a per-event flush instead of one buffered body
+    (Round-15 token streaming).
+
+    ``events`` is any iterable; a dict event is JSON-encoded, a str is
+    sent verbatim — each as one ``data:`` frame, flushed immediately so
+    the client sees every token as it lands.  The response STATUS is
+    decided by the first event: an exception raised before it (a 429
+    shed, a 503 engine failure) propagates to the normal error mappings
+    with their Retry-After headers, because streaming only begins once
+    there is something to send.  An exception after the first frame —
+    the status line is already on the wire — emits a terminal
+    ``event: error`` frame instead.  The stream always ends with a
+    ``data: [DONE]`` frame on success."""
+
+    def __init__(self, events, *, headers: dict[str, str] | None = None):
+        self.events = events
+        self.headers = headers or {}
+
+
+_STREAM_END = object()
+
+
+def _sse_frame(event) -> bytes:
+    if isinstance(event, bytes):
+        data = event.decode(errors="replace")
+    elif isinstance(event, str):
+        data = event
+    else:
+        data = json.dumps(event, default=str)
+    return f"data: {data}\n\n".encode()
+
+
+def _map_stream_error(exc: Exception) -> Exception:
+    """Admission sheds raised inside a stream's submit worker map to the
+    same 429 + Retry-After a non-streamed request gets."""
+    from ..serve.admission import QueueFullError, ShedError
+
+    if isinstance(exc, (QueueFullError, ShedError)):
+        return _HttpError(
+            429, str(exc),
+            headers={"Retry-After": f"{max(1, round(exc.retry_after_s))}"},
+        )
+    return exc
+
+
 class PathwayWebserver:
     """Shared HTTP endpoint host (reference: io/http PathwayWebserver).
 
@@ -345,6 +392,110 @@ class PathwayWebserver:
         if endpoint_docs:
             self._openapi["paths"].setdefault(route, {}).update(endpoint_docs)
 
+    def register_stream(self, route: str, submit_fn, *,
+                        methods: Sequence[str] = ("POST",),
+                        timeout_s: float = 120.0) -> None:
+        """Register an SSE token-streaming decode endpoint (Round-15).
+
+        ``submit_fn(prompt, max_new, *, on_token, ...)`` — typically
+        :meth:`~pathway_tpu.serve.fleet.ReplicaFleet.submit` — runs on a
+        worker thread; every ``on_token`` callback flushes one
+        ``data: {"token": ..., "index": ...}`` frame to the client, so
+        the engine's TTFT is the user's time-to-first-frame.  The POST
+        body is ``{"prompt": [ids...], "max_new": n}`` plus optional
+        ``sampling`` (``[temperature, top_k, top_p, seed]`` or the dict
+        form), ``session`` (KV tiering key) and ``priority`` —
+        forwarded only if ``submit_fn`` accepts them.  The first frame
+        echoes the request's ``X-Pathway-Trace`` id; a shed or
+        engine-failure BEFORE the first token keeps the non-streamed
+        429/503 + Retry-After mapping, one after it ends the stream
+        with an ``event: error`` frame."""
+        import inspect
+        import queue as _queue
+
+        try:
+            accepted = set(inspect.signature(submit_fn).parameters)
+        except (TypeError, ValueError):
+            accepted = {"sampling", "session", "priority", "on_token"}
+
+        def handler(payload: dict, meta: dict) -> StreamingResponse:
+            prompt = payload.get("prompt")
+            if not isinstance(prompt, list) or not prompt:
+                raise _HttpError(
+                    400, "`prompt` (non-empty list of token ids) is required"
+                )
+            try:
+                prompt = [int(t) for t in prompt]
+                max_new = int(payload.get("max_new", 16))
+            except (TypeError, ValueError):
+                raise _HttpError(400, "`prompt`/`max_new` must be integral")
+            kwargs: dict[str, Any] = {}
+            for key in ("sampling", "session"):
+                if key in payload and key in accepted:
+                    kwargs[key] = payload[key]
+            hdr_priority = {
+                str(k).lower(): v for k, v in meta.get("headers", {}).items()
+            }.get("x-pathway-priority")
+            priority = payload.get("priority", hdr_priority)
+            if priority is not None and "priority" in accepted:
+                from ..serve.admission import Priority
+
+                try:
+                    kwargs["priority"] = Priority.parse(priority)
+                except ValueError:
+                    raise _HttpError(400, f"bad priority: {priority!r}")
+
+            q: "_queue.Queue[tuple[str, Any]]" = _queue.Queue()
+
+            def work():
+                try:
+                    out = submit_fn(
+                        prompt, max_new,
+                        on_token=lambda t: q.put(("tok", t)), **kwargs,
+                    )
+                    q.put(("done", out))
+                except Exception as exc:  # noqa: BLE001 - re-raised below
+                    q.put(("err", exc))
+
+            threading.Thread(
+                target=work, daemon=True, name=f"sse{route}"
+            ).start()
+
+            def _get():
+                try:
+                    return q.get(timeout=timeout_s)
+                except _queue.Empty:
+                    raise TimeoutError(
+                        f"stream stalled past {timeout_s}s"
+                    ) from None
+
+            def events():
+                kind, val = _get()
+                if kind == "err":
+                    raise _map_stream_error(val)
+                # first frame: the trace id, echoed ON the stream so a
+                # client that only reads the body can still fetch
+                # /debug/trace for this request
+                yield {"trace": meta["trace_id"]}
+                n = 0
+                while True:
+                    if kind == "tok":
+                        yield {"token": int(val), "index": n}
+                        n += 1
+                    elif kind == "done":
+                        yield {
+                            "done": True,
+                            "tokens": [int(t) for t in val],
+                        }
+                        return
+                    else:
+                        raise _map_stream_error(val)
+                    kind, val = _get()
+
+            return StreamingResponse(events())
+
+        self.register(route, list(methods), handler)
+
     def _ensure_started(self) -> None:
         if self._server is not None:
             return
@@ -370,6 +521,55 @@ class PathwayWebserver:
                 self.send_header("Content-Length", str(len(payload)))
                 self.end_headers()
                 self.wfile.write(payload)
+
+            def _stream_sse(self, result: StreamingResponse, first, it,
+                            access: dict, req_span, started: float):
+                """Write an SSE response: headers (trace id echoed on
+                the stream), one flushed ``data:`` frame per event, and
+                a terminal ``[DONE]`` — or ``event: error`` if the
+                source dies after the status line is on the wire."""
+                self.send_response(200)
+                self.send_header("Content-Type", "text/event-stream")
+                self.send_header("Cache-Control", "no-cache")
+                self.send_header("X-Pathway-Trace", req_span.trace_id)
+                for hk, hv in result.headers.items():
+                    self.send_header(hk, str(hv))
+                if ws.with_cors:
+                    self.send_header("Access-Control-Allow-Origin", "*")
+                    self.send_header("Access-Control-Allow-Headers", "*")
+                self.send_header("Connection", "close")
+                self.end_headers()
+                status = 200
+                try:
+                    if first is not _STREAM_END:
+                        self.wfile.write(_sse_frame(first))
+                        self.wfile.flush()
+                        for event in it:
+                            self.wfile.write(_sse_frame(event))
+                            self.wfile.flush()
+                    self.wfile.write(b"data: [DONE]\n\n")
+                    self.wfile.flush()
+                except Exception as exc:
+                    status = 500
+                    err = {"error": str(exc), "trace": req_span.trace_id}
+                    if isinstance(exc, _HttpError):
+                        err["status"] = exc.status
+                    if isinstance(exc, EngineFailedError):
+                        err["retry_after_s"] = exc.retry_after_s
+                    logging.error(json.dumps({
+                        "_type": "stream_failed", **err,
+                    }))
+                    try:  # the client may already be gone: best-effort
+                        self.wfile.write(b"event: error\n" + _sse_frame(err))
+                        self.wfile.flush()
+                    except Exception:
+                        pass
+                access["status"] = status
+                access["time_elapsed"] = f"{time.time() - started:.3f}"
+                (logging.info if status < 400 else logging.error)(
+                    json.dumps(access)
+                )
+                req_span.finish(status=status)
 
             def _handle(self, method: str):
                 session_id = "uuid-" + uuid.uuid4().hex
@@ -445,7 +645,19 @@ class PathwayWebserver:
                     except json.JSONDecodeError:
                         payload = {}
                     result = handler(payload, meta) if want_meta else handler(payload)
-                    if isinstance(result, _RawText):
+                    if isinstance(result, StreamingResponse):
+                        it = iter(result.events)
+                        # pulling the first event BEFORE sending any
+                        # header lets a pre-token failure (429 shed, 503
+                        # engine-failed) propagate to the arms below and
+                        # keep the exact non-streamed error mappings
+                        try:
+                            first = next(it)
+                        except StopIteration:
+                            first = _STREAM_END
+                        self._stream_sse(result, first, it, access,
+                                         req_span, started)
+                    elif isinstance(result, _RawText):
                         finish(200, result.text.encode(), result.ctype)
                     else:
                         finish(200, json.dumps(result, default=str).encode())

@@ -38,8 +38,10 @@ from .block_pool import BlockPool, PoolExhausted, SequenceState
 from .engine import EngineHungError, PagedDecodeEngine, resolve_tp
 from .paged_attention import paged_attention, paged_attention_reference
 from .prefix_cache import PrefixCache
+from .tiering import SessionStore
 
 __all__ = [
+    "SessionStore",
     "BlockPool",
     "EngineHungError",
     "PoolExhausted",

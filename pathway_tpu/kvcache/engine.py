@@ -146,23 +146,73 @@ if _TraceAnnotation is None:  # pragma: no cover - modern jax has it
         return contextlib.nullcontext()
 
 
+def _norm_sampling(s) -> tuple | None:
+    """Normalize a sampling spec (dict or 4-tuple) to the canonical
+    ``(temperature, top_k, top_p, seed)`` tuple the device programs
+    consume, or None for pure greedy.  A spec with temperature=0 is KEPT
+    (not folded to greedy): it still routes through the sampled program,
+    where the per-row jnp.where pins it to the exact greedy tokens —
+    that degeneration is part of the contract and stays testable."""
+    if s is None:
+        return None
+    if isinstance(s, dict):
+        return (float(s.get("temperature", 1.0)), int(s.get("top_k", 0)),
+                float(s.get("top_p", 1.0)), int(s.get("seed", 0)))
+    t, k, p, seed = s
+    return (float(t), int(k), float(p), int(seed))
+
+
+def _payload_extras(r) -> tuple[int, dict | None]:
+    """Parse the optional tail of a request/payload tuple: after
+    ``(prompt, max_new)`` may come a priority (int/str) and/or an options
+    dict (``sampling``/``session``/``on_token``), in either slot —
+    ``(p, n)``, ``(p, n, prio)``, ``(p, n, opts)`` and ``(p, n, prio,
+    opts)`` all parse; existing 2/3-tuple callers are untouched."""
+    priority: Any = 1
+    opts = None
+    for el in r[2:4]:
+        if isinstance(el, dict):
+            opts = el
+        elif el is not None:
+            priority = el
+    return priority, opts
+
+
 class _Request:
     __slots__ = ("prompt", "max_new", "priority", "stop_token", "emitted",
-                 "index", "on_done", "on_error", "t_arrival", "span", "ctx")
+                 "index", "on_done", "on_error", "t_arrival", "span", "ctx",
+                 "sampling", "session", "on_token")
 
     def __init__(self, prompt, max_new: int, *, priority: int = 1,
                  stop_token: int | None = None, index: int | None = None,
                  on_done: Callable | None = None,
                  on_error: Callable | None = None,
-                 trace: tuple | None = None):
+                 trace: tuple | None = None,
+                 sampling=None, session: str | None = None,
+                 on_token: Callable | None = None, emitted=None):
         self.prompt = list(prompt)
         self.max_new = int(max_new)
         self.priority = int(priority)
         self.stop_token = stop_token
-        self.emitted: list[int] = []
+        # `emitted` pre-populates already-produced tokens (fleet failover
+        # re-admission): the request CONTINUES — admission recomputes
+        # prompt + emitted and the emit-index seed schedule resumes at
+        # len(emitted), so sampled output stays bit-identical across the
+        # handoff.  max_new counts the TOTAL including these.
+        self.emitted: list[int] = (
+            [int(t) for t in emitted] if emitted else []
+        )
         self.index = index
         self.on_done = on_done
         self.on_error = on_error
+        # Round-15 serving-front fields: `sampling` is the normalized
+        # (temperature, top_k, top_p, seed) tuple or None for greedy;
+        # `session` names a KV tiering session (kvcache/tiering.py);
+        # `on_token` streams each emitted token to the transport as it
+        # lands (io/http.py SSE) — best-effort, exceptions are swallowed
+        self.sampling = _norm_sampling(sampling)
+        self.session = session
+        self.on_token = on_token
         self.t_arrival = time.perf_counter()
         # request-scoped tracing: the root span is opened the moment the
         # engine learns about the request (its trace id is minted here
@@ -178,7 +228,7 @@ class _Request:
 
 class _Active:
     __slots__ = ("seq_id", "req", "tokens", "n_filled", "n_diverted",
-                 "prefix_keys", "wait_writer")
+                 "prefix_keys", "wait_writer", "admitted", "emit_base")
 
     def __init__(self, seq_id: int, req: _Request):
         self.seq_id = seq_id
@@ -187,6 +237,14 @@ class _Active:
         # still being streamed in; None once prefill completes (or for
         # the legacy whole-bucket path, from the start)
         self.tokens: list[int] | None = None
+        # the trimmed token list this sequence was admitted with — kept
+        # past prefill completion (unlike `tokens`) so session suspension
+        # (kvcache/tiering.py) knows which tokens the resident K/V covers
+        self.admitted: list[int] | None = None
+        # len(req.emitted) at admission: tokens emitted AFTER admission
+        # are the ones whose K/V landed in THIS allocation's blocks (the
+        # session-suspend coverage rule needs the split)
+        self.emit_base = len(req.emitted)
         self.n_filled = 0
         self.n_diverted = 0  # positions < this are prefix-shared blocks
         self.prefix_keys: list | None = None
@@ -254,7 +312,8 @@ class PagedDecodeEngine:
                  max_restarts: int | None = None,
                  degrade_fn: Callable | None = None,
                  hbm_budget_bytes: int | None = None,
-                 hbm_fit: str = "reject"):
+                 hbm_fit: str = "reject",
+                 session_store=None):
         from ..models.encoder import _resolve_dtype
 
         self.cfg = cfg
@@ -352,6 +411,15 @@ class PagedDecodeEngine:
                                or 0)
         self.max_restarts = max(0, int(max_restarts))
         self.degrade_fn = degrade_fn
+        # Round-15 KV session tiering (kvcache/tiering.py SessionStore):
+        # requests carrying a `session` id suspend their blocks to host
+        # RAM at completion and resume by re-scatter at the next turn.
+        # Chunked-prefill mode only (resume rides the chunk divert rule).
+        self.session_store = session_store
+        # Round-15 sampled program variants — built LAZILY on the first
+        # sampled request (_sampled_programs), so a greedy-only workload
+        # compiles exactly the greedy set and nothing else
+        self._sampled: dict | None = None
         self._watchdog = (
             _WatchdogSync(f"pw-watchdog-{name}")
             if self.watchdog_timeout_s else None
@@ -514,6 +582,123 @@ class PagedDecodeEngine:
             "pw.prefill", _prefill_fn, donate_argnums=(3, 4)
         )
 
+    # -- Round-15: device-side temperature/top-k/top-p sampling ------------
+    def _sampled_programs(self) -> dict:
+        """The pw.*_sampled jitted programs, built on FIRST use.  Each
+        wraps its greedy twin's step math with the sampling head
+        (models/decoder.py) and takes five extra (B,) arrays:
+        temperature/top_k/top_p/seed/emit-index.  Greedy-only workloads
+        never call this, so the sampled variants are the ONLY programs
+        sampling adds — the zero-extra-compiles pin of the round."""
+        if self._sampled is not None:
+            return self._sampled
+        from ..obs.profiler import profiled_jit
+
+        _cfg, _attn, _mesh = self.cfg, self.attn, self.mesh
+
+        def _step_fn(p, k_pool, v_pool, token, positions, bt, sb, so,
+                     temp, tk, tpp, seed, emit):
+            from ..models.decoder import (paged_decode_step_sampled,
+                                          paged_decode_step_sampled_tp)
+
+            if _mesh is not None:
+                return paged_decode_step_sampled_tp(
+                    p, _cfg, _mesh, k_pool, v_pool, token, positions, bt,
+                    sb, so, temp, tk, tpp, seed, emit, attn=_attn,
+                )
+            return paged_decode_step_sampled(
+                p, _cfg, k_pool, v_pool, token, positions, bt, sb, so,
+                temp, tk, tpp, seed, emit, attn=_attn,
+            )
+
+        def _mixed_fn(p, k_pool, v_pool, tokens, positions, row_tables,
+                      row_start, row_nvalid, row_token_idx, tok_row,
+                      tok_col, sb, so, logit_idx, temp, tk, tpp, seed,
+                      emit):
+            from ..models.decoder import (paged_mixed_step_sampled,
+                                          paged_mixed_step_sampled_tp)
+
+            if _mesh is not None:
+                return paged_mixed_step_sampled_tp(
+                    p, _cfg, _mesh, k_pool, v_pool, tokens, positions,
+                    row_tables, row_start, row_nvalid, row_token_idx,
+                    tok_row, tok_col, sb, so, logit_idx, temp, tk, tpp,
+                    seed, emit, attn=_attn,
+                )
+            return paged_mixed_step_sampled(
+                p, _cfg, k_pool, v_pool, tokens, positions, row_tables,
+                row_start, row_nvalid, row_token_idx, tok_row, tok_col,
+                sb, so, logit_idx, temp, tk, tpp, seed, emit, attn=_attn,
+            )
+
+        def _chained_fn(p, k_pool, v_pool, token, positions, bt, sb, so,
+                        temp, tk, tpp, seed, emit0):
+            from ..models.decoder import (paged_chained_decode_sampled,
+                                          paged_chained_decode_sampled_tp)
+
+            if _mesh is not None:
+                return paged_chained_decode_sampled_tp(
+                    p, _cfg, _mesh, k_pool, v_pool, token, positions, bt,
+                    sb, so, temp, tk, tpp, seed, emit0, attn=_attn,
+                )
+            return paged_chained_decode_sampled(
+                p, _cfg, k_pool, v_pool, token, positions, bt, sb, so,
+                temp, tk, tpp, seed, emit0, attn=_attn,
+            )
+
+        def _prefill_fn(p, token_ids, n_valid, k_pool, v_pool, bt,
+                        temp, tk, tpp, seed, emit):
+            from ..models.decoder import (paged_prefill_sampled,
+                                          paged_prefill_sampled_tp)
+
+            if _mesh is not None:
+                return paged_prefill_sampled_tp(
+                    p, _cfg, _mesh, token_ids, n_valid, k_pool, v_pool,
+                    bt, temp, tk, tpp, seed, emit,
+                )
+            return paged_prefill_sampled(
+                p, _cfg, token_ids, n_valid, k_pool, v_pool, bt, temp,
+                tk, tpp, seed, emit,
+            )
+
+        self._sampled = {
+            "step": profiled_jit(
+                "pw.decode_step_sampled", _step_fn, donate_argnums=(1, 2)
+            ),
+            "mixed": profiled_jit(
+                "pw.mixed_step_sampled", _mixed_fn, donate_argnums=(1, 2)
+            ),
+            "chained": profiled_jit(
+                "pw.chained_decode_sampled", _chained_fn,
+                donate_argnums=(1, 2),
+            ),
+            "prefill": profiled_jit(
+                "pw.prefill_sampled", _prefill_fn, donate_argnums=(3, 4)
+            ),
+        }
+        return self._sampled
+
+    def _sampling_arrays(self, entries, B: int):
+        """Per-row sampling arrays for one dispatch, or None when EVERY
+        row is greedy (the round then uses the greedy program — no
+        sampled compile).  ``entries``: (row_index, _Request) pairs.
+        Greedy rows riding a sampled dispatch get temperature=0, which
+        the device head pins to the exact argmax."""
+        if not any(req.sampling is not None for _i, req in entries):
+            return None
+        temp = np.zeros(B, np.float32)
+        top_k = np.zeros(B, np.int32)
+        top_p = np.ones(B, np.float32)
+        seed = np.zeros(B, np.int32)
+        emit = np.zeros(B, np.int32)
+        for i, req in entries:
+            emit[i] = len(req.emitted)
+            if req.sampling is not None:
+                t, k, p, s = req.sampling
+                temp[i], top_k[i], top_p[i], seed[i] = t, k, p, s
+        return (jnp.asarray(temp), jnp.asarray(top_k), jnp.asarray(top_p),
+                jnp.asarray(seed), jnp.asarray(emit))
+
     def _record_dispatch(self, prog, t_disp, t_end, items: int) -> None:
         """Attribute one dispatch->sync window to ``prog``'s registry
         record.  Guarded getattr: tests (and the bench's stall spies)
@@ -547,7 +732,11 @@ class PagedDecodeEngine:
                 items = []
                 for w in scheduler.poll_inflight(n):
                     items.append((
-                        (list(w.payload[0]), int(w.payload[1])),
+                        # extras past (prompt, n_new) — the Round-15
+                        # options dict (sampling/session/on_token) —
+                        # ride along for _admit_arrivals to parse
+                        (list(w.payload[0]), int(w.payload[1]))
+                        + tuple(w.payload[2:4]),
                         int(w.priority),
                         functools.partial(scheduler.complete_inflight, w),
                         functools.partial(scheduler.fail_inflight, w),
@@ -575,12 +764,13 @@ class PagedDecodeEngine:
                 for w in getattr(scheduler, "_inflight_waiters", ()) or ()
             ]
 
+        def _norm(r):
+            priority, opts = _payload_extras(r)
+            base = (list(r[0]), int(r[1]), _prio(priority))
+            return base + (opts,) if opts is not None else base
+
         return self.generate_batch(
-            [
-                (list(r[0]), int(r[1])) if len(r) < 3
-                else (list(r[0]), int(r[1]), _prio(r[2]))
-                for r in reqs
-            ],
+            [_norm(r) for r in reqs],
             poll=poll,
             return_exceptions=True,
             traces=traces,
@@ -591,7 +781,11 @@ class PagedDecodeEngine:
                        return_exceptions: bool = False,
                        traces: Sequence | None = None) -> list[list[int]]:
         """Greedy-decode a batch of ``(prompt_ids, max_new)`` requests (an
-        optional third element is a serve.admission.Priority value).
+        optional third element is a serve.admission.Priority value; a
+        trailing dict element carries per-request options —
+        ``sampling=(temperature, top_k, top_p, seed)`` or the dict form,
+        ``session=<id>`` for KV tiering, ``on_token=<callable>`` for
+        per-token streaming).
 
         ``poll(n)``, when given, is called at every step boundary and may
         return up to ``n`` newly arrived ``(payload, priority, on_done,
@@ -608,10 +802,13 @@ class PagedDecodeEngine:
         pending: deque[_Request] = deque()
         for i, r in enumerate(requests):
             prompt, max_new = r[0], r[1]
-            priority = r[2] if len(r) > 2 else 1
+            priority, opts = _payload_extras(r)
+            opts = opts or {}
             pending.append(_Request(
                 prompt, max_new, priority=priority, stop_token=stop, index=i,
                 trace=traces[i] if traces and i < len(traces) else None,
+                sampling=opts.get("sampling"), session=opts.get("session"),
+                on_token=opts.get("on_token"), emitted=opts.get("emitted"),
             ))
         results: list[Any] = [None] * len(requests)
         errors: list[tuple[int, BaseException]] = []
@@ -828,8 +1025,14 @@ class PagedDecodeEngine:
         """Degrade-to-host-tier handoff: complete one stranded request
         through ``degrade_fn(prompt, n_remaining, emitted)`` (the serial
         tier).  Tokens already emitted by the dead engine are kept —
-        the degrade tier continues the sequence, it does not restart
-        it."""
+        the degrade tier continues the sequence, it does not restart it.
+
+        A degrade_fn accepting a ``req`` keyword gets the full _Request
+        (the fleet failover hook: a peer replica needs the sampling spec,
+        session id and streaming callback to continue the request
+        token-identically); such a hook forwards streaming itself, so
+        on_token is NOT re-fired for the tokens it returns."""
+        import inspect
         import logging
 
         try:
@@ -838,11 +1041,29 @@ class PagedDecodeEngine:
                 req.stop_token is None
                 or req.stop_token not in req.emitted
             ):
-                extra = self.degrade_fn(
-                    list(req.prompt), remaining, list(req.emitted)
-                )
+                takes_req = False
+                try:
+                    takes_req = "req" in inspect.signature(
+                        self.degrade_fn
+                    ).parameters
+                except (TypeError, ValueError):
+                    pass
+                if takes_req:
+                    extra = self.degrade_fn(
+                        list(req.prompt), remaining, list(req.emitted),
+                        req=req,
+                    )
+                else:
+                    extra = self.degrade_fn(
+                        list(req.prompt), remaining, list(req.emitted)
+                    )
                 for t in list(extra)[:remaining]:
                     req.emitted.append(int(t))
+                    if not takes_req and req.on_token is not None:
+                        try:
+                            req.on_token(int(t))
+                        except Exception:  # noqa: BLE001
+                            pass
                     if req.stop_token is not None \
                             and int(t) == req.stop_token:
                         break  # same EOS truncation as _scan_chain
@@ -874,12 +1095,16 @@ class PagedDecodeEngine:
             # (serve_batch's poll wrapper supplies it; bare 4-tuples from
             # direct poll= callers mint a fresh trace at admission)
             trace = item[4] if len(item) > 4 else None
+            _p, opts = _payload_extras(payload)
+            opts = opts or {}
             # priority-ordered like _requeue: an urgent arrival
             # must not queue behind a lower-priority victim
             self._requeue(pending, _Request(
                 payload[0], payload[1], priority=priority,
                 stop_token=stop, on_done=on_done, on_error=on_error,
-                trace=trace,
+                trace=trace, sampling=opts.get("sampling"),
+                session=opts.get("session"), on_token=opts.get("on_token"),
+                emitted=opts.get("emitted"),
             ))
 
     def _loop_body(self, running, pending, deliver, poll, stop):
@@ -963,6 +1188,18 @@ class PagedDecodeEngine:
         its time-to-first-token window (preemption does not reopen it —
         a victim re-admitted mid-decode already emitted)."""
         req.emitted.append(token_id)
+        if req.on_token is not None:
+            # per-token streaming (Round-15): best-effort — a broken
+            # stream consumer must not take the whole batch down with it
+            try:
+                req.on_token(token_id)
+            except Exception:  # noqa: BLE001
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "on_token callback failed; continuing decode",
+                    exc_info=True,
+                )
         if len(req.emitted) == 1:
             self.pool.stats.record_ttft(
                 time.perf_counter() - req.t_arrival
@@ -1018,13 +1255,26 @@ class PagedDecodeEngine:
         n = len(tokens)
         self._seq_counter += 1
         seq_id = self._seq_counter
+        # Round-15 session tiering (chunked mode only): a session-tagged
+        # request resumes its suspended K/V from the host tier instead of
+        # going through the prefix cache — sessions are PRIVATE
+        # continuity (one conversation's history), not shared prefixes,
+        # so the cross-request sharing machinery (and its in-flight
+        # writer gates) is deliberately bypassed for them
+        sess_entry = None
+        use_session = (
+            self.chunked_prefill and req.session is not None
+            and self.session_store is not None
+        )
+        if use_session:
+            sess_entry = self.session_store.match(req.session, tokens)
         state = None
         attempt = 0
         writer = None
         while state is None:
             shared, keys = ([], [])
             writer = None
-            if self.prefix is not None:
+            if self.prefix is not None and not use_session:
                 # sharing is safe even when it covers EVERY prompt block:
                 # full blocks are never decode-write targets (appends open
                 # a fresh block at the boundary) and shared blocks are
@@ -1084,6 +1334,22 @@ class PagedDecodeEngine:
         if self.chunked_prefill:
             act = _Active(seq_id, req)
             act.tokens = tokens
+            act.admitted = tokens
+            if use_session:
+                resident = 0
+                if sess_entry is not None:
+                    resident = self.session_store.resume_into(
+                        self.pool, sess_entry, state.block_ids
+                    )
+                # resumed positions ride the chunk divert rule exactly
+                # like prefix-shared blocks: their K/V is already
+                # resident, so chunk writes for pos < n_diverted go to
+                # the null block — but the prompt's LAST token always
+                # recomputes to produce the next-token logits
+                act.n_filled = min(resident, n - 1)
+                act.n_diverted = resident
+                running.append(act)
+                return "admitted"
             # prefix-shared leading blocks need no recompute: their K/V
             # is already (or will be, gated on the writer) resident, so
             # chunking starts after them — the compute saving the
@@ -1123,18 +1389,38 @@ class PagedDecodeEngine:
             faults.fire("engine.dispatch.prefill")
             self._note_dispatch("prefill")
             t_disp_pf = self._t_dispatch
-            with _TraceAnnotation("pw.prefill"):
-                ids, self.pool.k, self.pool.v = self._prefill(
-                    self.params, jnp.asarray(buf),
-                    jnp.asarray([n], jnp.int32),
-                    self.pool.k, self.pool.v,
-                    jnp.asarray(scatter_bt[None, :]),
-                )
+            if req.sampling is None:
+                prog_pf = self._prefill
+                with _TraceAnnotation("pw.prefill"):
+                    ids, self.pool.k, self.pool.v = prog_pf(
+                        self.params, jnp.asarray(buf),
+                        jnp.asarray([n], jnp.int32),
+                        self.pool.k, self.pool.v,
+                        jnp.asarray(scatter_bt[None, :]),
+                    )
+            else:
+                # first token's emit index is len(emitted): a restart /
+                # failover re-admission resumes the seed schedule exactly
+                # where the dead engine left off
+                tv, kv, pv, sv = req.sampling
+                prog_pf = self._sampled_programs()["prefill"]
+                with _TraceAnnotation("pw.prefill_sampled"):
+                    ids, self.pool.k, self.pool.v = prog_pf(
+                        self.params, jnp.asarray(buf),
+                        jnp.asarray([n], jnp.int32),
+                        self.pool.k, self.pool.v,
+                        jnp.asarray(scatter_bt[None, :]),
+                        jnp.asarray([tv], jnp.float32),
+                        jnp.asarray([kv], jnp.int32),
+                        jnp.asarray([pv], jnp.float32),
+                        jnp.asarray([sv], jnp.int32),
+                        jnp.asarray([len(req.emitted)], jnp.int32),
+                    )
             # the sync stays INSIDE the failure cleanup: a hung/failed
             # sync (watchdog) with no restart budget must not leak the
             # just-prefilled blocks for the engine's lifetime
             first_id = int(self._sync_host(ids)[0])
-            self._record_dispatch(self._prefill, t_disp_pf,
+            self._record_dispatch(prog_pf, t_disp_pf,
                                   time.perf_counter(), items=n)
             if self.prefix is not None:
                 # zip inside insert() truncates to the full-block keys, so
@@ -1156,6 +1442,32 @@ class PagedDecodeEngine:
             return "done"
         running.append(act)
         return "admitted"
+
+    def _release_seq(self, act: _Active) -> None:
+        """Completion-time release of a finished sequence's blocks.  A
+        session-tagged request (chunked mode, session_store attached)
+        SUSPENDS instead: its context K/V — the admitted tokens plus
+        every emitted-and-fed-back token — is copied to the host tier so
+        the session's next turn resumes by re-scatter rather than
+        recompute.  The final emitted token was never written to the
+        pool (it is output, not input), so coverage stops one short."""
+        req = act.req
+        store = self.session_store
+        if (store is not None and req.session is not None
+                and act.admitted is not None):
+            emitted = [int(t) for t in req.emitted[act.emit_base:]]
+            context = list(act.admitted) + emitted[:-1]
+            try:
+                store.suspend(req.session, self.pool, act.seq_id, context)
+                return
+            except Exception:  # noqa: BLE001 - tiering is best-effort
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "session suspend failed for %r; freeing blocks",
+                    req.session, exc_info=True,
+                )
+        self.pool.free_sequence(act.seq_id)
 
     def _is_done(self, req: _Request, seq_id: int) -> bool:
         if len(req.emitted) >= req.max_new:
@@ -1221,9 +1533,10 @@ class PagedDecodeEngine:
     def _dispatch_chain(self, running, pending):
         """Pre-extend every decode row's block table by its chain budget
         and dispatch ONE K-step device program.  Returns ``(acts, kreal,
-        ids)`` with ``ids`` the un-synced [B, K] device array (its host
-        copy is started asynchronously), or None when nothing could be
-        reserved (every row was preempted into pending)."""
+        ids, t_disp, prog)`` with ``ids`` the un-synced [B, K] device
+        array (its host copy is started asynchronously), or None when
+        nothing could be reserved (every row was preempted into
+        pending)."""
         K = self.chain_steps
         pool = self.pool
 
@@ -1264,15 +1577,31 @@ class PagedDecodeEngine:
             bt[i, : len(seq.block_ids)] = seq.block_ids
             acts.append(act)
             kreal.append(len(slots))
+        samp = self._sampling_arrays(
+            [(i, act.req) for i, act in enumerate(acts)], B
+        )
         faults.fire("engine.dispatch.chain")
         self._note_dispatch("chain")
         t_disp = self._t_dispatch
-        with _TraceAnnotation("pw.chain_dispatch"):
-            ids, pool.k, pool.v = self._chained(
-                self.params, pool.k, pool.v, jnp.asarray(token),
-                jnp.asarray(positions), jnp.asarray(bt), jnp.asarray(sb),
-                jnp.asarray(so),
-            )
+        if samp is None:
+            prog = self._chained
+            with _TraceAnnotation("pw.chain_dispatch"):
+                ids, pool.k, pool.v = prog(
+                    self.params, pool.k, pool.v, jnp.asarray(token),
+                    jnp.asarray(positions), jnp.asarray(bt),
+                    jnp.asarray(sb), jnp.asarray(so),
+                )
+        else:
+            # the per-row PRNG key rides the scan carry; emit0 is the
+            # row's absolute emit index at the chain's first step, so a
+            # chain of K tokens lands bit-identically to K single steps
+            prog = self._sampled_programs()["chained"]
+            with _TraceAnnotation("pw.chain_dispatch_sampled"):
+                ids, pool.k, pool.v = prog(
+                    self.params, pool.k, pool.v, jnp.asarray(token),
+                    jnp.asarray(positions), jnp.asarray(bt),
+                    jnp.asarray(sb), jnp.asarray(so), *samp,
+                )
         try:
             # start the device->host copy NOW so it overlaps the chain's
             # tail and the host's bookkeeping; np.asarray later just
@@ -1280,7 +1609,7 @@ class PagedDecodeEngine:
             ids.copy_to_host_async()
         except Exception:  # noqa: BLE001 - optional fast path (CPU arrays)
             pass
-        return acts, kreal, ids, t_disp
+        return acts, kreal, ids, t_disp, prog
 
     def _scan_chain(self, acts, kreal, ids_np, running
                     ) -> tuple[list[_Active], int]:
@@ -1331,7 +1660,7 @@ class PagedDecodeEngine:
             # arrival discovered here lands in pending and adapts the
             # NEXT round to K=1 (this chain is the bounded latency cost)
             self._admit_arrivals(running, pending, poll, stop)
-            acts, kreal, ids_dev, t_disp = inflight
+            acts, kreal, ids_dev, t_disp, prog = inflight
             t_sync0 = time.perf_counter()
             ids_np = self._sync_host(ids_dev)  # ONE sync per K-token chain
             t_sync1 = time.perf_counter()
@@ -1346,11 +1675,11 @@ class PagedDecodeEngine:
                 obs.record_span("engine.chain", t_disp, t_sync1,
                                 ctx=act.req.ctx, k=kreal[i])
             done, n_emitted = self._scan_chain(acts, kreal, ids_np, running)
-            self._record_dispatch(self._chained, t_disp, t_sync1,
+            self._record_dispatch(prog, t_disp, t_sync1,
                                   items=n_emitted)
             for act in done:
                 running.remove(act)
-                self.pool.free_sequence(act.seq_id)
+                self._release_seq(act)
             nxt = None
             if running and not pending \
                     and self._chain_headroom(running) >= 2:
@@ -1395,21 +1724,35 @@ class PagedDecodeEngine:
             sb[i] = blk
             so[i] = off
             bt[i, : len(seq.block_ids)] = seq.block_ids
+        samp = self._sampling_arrays(
+            [(i, act.req) for i, (act, _s) in enumerate(reserved)], B
+        )
         faults.fire("engine.dispatch.step")
         self._note_dispatch("step")
         t_disp = self._t_dispatch
-        with _TraceAnnotation("pw.decode_step"):
-            ids, self.pool.k, self.pool.v = self._step(
-                self.params, self.pool.k, self.pool.v, jnp.asarray(token),
-                jnp.asarray(positions), jnp.asarray(bt), jnp.asarray(sb),
-                jnp.asarray(so),
-            )
+        if samp is None:
+            prog = self._step
+            with _TraceAnnotation("pw.decode_step"):
+                ids, self.pool.k, self.pool.v = prog(
+                    self.params, self.pool.k, self.pool.v,
+                    jnp.asarray(token), jnp.asarray(positions),
+                    jnp.asarray(bt), jnp.asarray(sb), jnp.asarray(so),
+                )
+        else:
+            prog = self._sampled_programs()["step"]
+            with _TraceAnnotation("pw.decode_step_sampled"):
+                ids, self.pool.k, self.pool.v = prog(
+                    self.params, self.pool.k, self.pool.v,
+                    jnp.asarray(token), jnp.asarray(positions),
+                    jnp.asarray(bt), jnp.asarray(sb), jnp.asarray(so),
+                    *samp,
+                )
         t_sync0 = time.perf_counter()
         ids = self._sync_host(ids)
         t_sync1 = time.perf_counter()
         obs.record_span("engine.sync", t_sync0, t_sync1, ctx=self._run_ctx)
         self._note_sync()
-        self._record_dispatch(self._step, t_disp, t_sync1,
+        self._record_dispatch(prog, t_disp, t_sync1,
                               items=len(reserved))
         for act, _slot in reserved:
             obs.record_span("engine.decode_step", t_disp, t_sync1,
@@ -1424,7 +1767,7 @@ class PagedDecodeEngine:
             self._emit(act.req, int(ids[i]))
             if self._is_done(act.req, act.seq_id):
                 running.remove(act)
-                self.pool.free_sequence(act.seq_id)
+                self._release_seq(act)
                 deliver(act.req)
 
     def _mixed_round(self, reserved, chunks, running, deliver) -> None:
@@ -1526,24 +1869,46 @@ class PagedDecodeEngine:
             raise RuntimeError(
                 "ragged step produced no rows (gated chunk cycle?)"
             )
+        # sampling rides per ROW: only rows emitting a token this round
+        # matter (decode rows; a chunk row's mid-prefill logits are
+        # discarded host-side either way, and its completing chunk's
+        # first token uses emit = len(emitted), same as a decode row)
+        samp = self._sampling_arrays(
+            [(r, act.req) for act, r, _f in rows], B
+        )
         faults.fire("engine.dispatch.mixed")
         self._note_dispatch("mixed")
         t_disp = self._t_dispatch
-        with _TraceAnnotation("pw.mixed_step"):
-            ids, self.pool.k, self.pool.v = self._mixed(
-                self.params, self.pool.k, self.pool.v, jnp.asarray(tokens),
-                jnp.asarray(positions), jnp.asarray(row_tables),
-                jnp.asarray(row_start), jnp.asarray(row_nvalid),
-                jnp.asarray(row_token_idx), jnp.asarray(tok_row),
-                jnp.asarray(tok_col), jnp.asarray(sb), jnp.asarray(so),
-                jnp.asarray(logit_idx),
-            )
+        if samp is None:
+            prog = self._mixed
+            with _TraceAnnotation("pw.mixed_step"):
+                ids, self.pool.k, self.pool.v = prog(
+                    self.params, self.pool.k, self.pool.v,
+                    jnp.asarray(tokens), jnp.asarray(positions),
+                    jnp.asarray(row_tables), jnp.asarray(row_start),
+                    jnp.asarray(row_nvalid), jnp.asarray(row_token_idx),
+                    jnp.asarray(tok_row), jnp.asarray(tok_col),
+                    jnp.asarray(sb), jnp.asarray(so),
+                    jnp.asarray(logit_idx),
+                )
+        else:
+            prog = self._sampled_programs()["mixed"]
+            with _TraceAnnotation("pw.mixed_step_sampled"):
+                ids, self.pool.k, self.pool.v = prog(
+                    self.params, self.pool.k, self.pool.v,
+                    jnp.asarray(tokens), jnp.asarray(positions),
+                    jnp.asarray(row_tables), jnp.asarray(row_start),
+                    jnp.asarray(row_nvalid), jnp.asarray(row_token_idx),
+                    jnp.asarray(tok_row), jnp.asarray(tok_col),
+                    jnp.asarray(sb), jnp.asarray(so),
+                    jnp.asarray(logit_idx), *samp,
+                )
         t_sync0 = time.perf_counter()
         ids = self._sync_host(ids)
         t_sync1 = time.perf_counter()
         obs.record_span("engine.sync", t_sync0, t_sync1, ctx=self._run_ctx)
         self._note_sync()
-        self._record_dispatch(self._mixed, t_disp, t_sync1, items=t)
+        self._record_dispatch(prog, t_disp, t_sync1, items=t)
         self.pool.stats.record_mixed_step(len(rows))
         n_decode = sum(1 for _a, _r, f in rows if f < 0)
         if n_decode:
@@ -1585,7 +1950,7 @@ class PagedDecodeEngine:
                 self._emit(act.req, int(ids[row]))
             if self._is_done(act.req, act.seq_id):
                 running.remove(act)
-                self.pool.free_sequence(act.seq_id)
+                self._release_seq(act)
                 deliver(act.req)
 
     def _drop_inflight_keys(self, act: _Active) -> None:

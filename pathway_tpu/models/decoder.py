@@ -112,6 +112,86 @@ def _head_out(embed, x, tp_axis):
     return jnp.min(cand, axis=0).astype(jnp.int32)
 
 
+# -- device-side sampling (Round-15) -----------------------------------------
+#
+# The sampled program variants thread per-row (temperature, top_k, top_p,
+# seed, emit-index) arrays through the SAME step math as the greedy
+# programs: only the vocab head changes, swapping the fused argmax for a
+# Gumbel-argmax draw over the top-k/top-p-masked scaled logits.  Two
+# contracts matter:
+#
+# - temperature=0 rows take the EXACT greedy result (a per-row jnp.where
+#   against the argmax, not a numerical limit), so a mixed batch of greedy
+#   and sampled rows stays token-identical to the greedy program for its
+#   greedy rows;
+# - the Gumbel noise for a row's n-th emitted token is keyed by
+#   fold_in(fold_in(root, seed), n) ONLY — no engine state, no batch
+#   position, no wall clock — so preemption-with-recompute, supervised
+#   restart, and cross-replica failover (serve/fleet.py) all reproduce
+#   sampled output bit-identically: recompute identity gives the same
+#   logits, the key schedule gives the same noise.
+
+
+def _row_sample_keys(seed: jax.Array, emit_idx: jax.Array) -> jax.Array:
+    """Per-row PRNG keys for the ``emit_idx``-th emitted token of requests
+    seeded by ``seed`` — a pure function of (seed, emit index), nothing
+    else.  seed/emit_idx: (B,) int32; returns (B, 2) uint32 raw keys."""
+
+    def one(s, e):
+        return jax.random.fold_in(jax.random.fold_in(jax.random.PRNGKey(0), s), e)
+
+    return jax.vmap(one)(seed, emit_idx)
+
+
+def _sample_rows(logits: jax.Array, greedy: jax.Array, temperature: jax.Array,
+                 top_k: jax.Array, top_p: jax.Array, keys: jax.Array) -> jax.Array:
+    """Row-wise temperature/top-k/top-p sampling over (B, V) f32 logits.
+
+    Each row sorts its logits descending (stable, so ties keep the
+    smallest id — the greedy tie-break), masks to the top-k ranks AND the
+    top-p nucleus (exclusive-prefix mass < top_p; the argmax token always
+    survives), then draws via Gumbel-argmax on the temperature-scaled
+    kept logits.  ``top_k <= 0`` and ``top_p = 1.0`` disable their masks.
+    temperature=0 rows return ``greedy`` exactly.  Returns (B,) int32."""
+    V = logits.shape[-1]
+    order = jnp.argsort(-logits, axis=-1)  # stable: ties -> smallest id
+    sorted_logits = jnp.take_along_axis(logits, order, axis=-1)
+    temp = jnp.maximum(temperature.astype(jnp.float32), 1e-6)[:, None]
+    scaled = sorted_logits / temp
+    probs = jax.nn.softmax(scaled, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    ranks = jnp.arange(V, dtype=jnp.int32)[None, :]
+    k = jnp.where(top_k > 0, top_k, V).astype(jnp.int32)[:, None]
+    keep = (ranks < k) & ((cum - probs) < top_p.astype(jnp.float32)[:, None])
+    keep = keep.at[:, 0].set(True)
+    gumbel = jax.vmap(lambda key: jax.random.gumbel(key, (V,), jnp.float32))(keys)
+    noisy = jnp.where(keep, scaled + gumbel, -jnp.inf)
+    choice_rank = jnp.argmax(noisy, axis=-1)
+    choice = jnp.take_along_axis(order, choice_rank[:, None], axis=-1)[:, 0]
+    return jnp.where(temperature <= 0.0, greedy, choice).astype(jnp.int32)
+
+
+def _sampling_head(temperature, top_k, top_p, keys):
+    """Build a vocab-head override (the ``head_fn`` hook on the paged step
+    functions) that samples instead of argmaxing.  Under ``tp_axis`` the
+    sharded (B, V/tp) logits slices are all_gather'd back to the full row
+    first — the one place device-side sampling pays the full-vocab ICI
+    transfer the greedy two-stage argmax avoids (O(B*V) floats per step;
+    the draw itself must see the whole nucleus).  temperature=0 rows
+    return the exact argmax of the gathered row, which equals the
+    two-stage :func:`_head_out` result bit-for-bit (same smallest-id
+    tie-break), so greedy rows stay token-identical under tp too."""
+
+    def head(embed, x, tp_axis):
+        logits = (x @ embed.astype(x.dtype).T).astype(jnp.float32)
+        if tp_axis is not None:
+            logits = jax.lax.all_gather(logits, tp_axis, axis=1, tiled=True)
+        greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return _sample_rows(logits, greedy, temperature, top_k, top_p, keys)
+
+    return head
+
+
 def _causal_attention(layer, x, n_heads: int):
     from .encoder import _proj
 
@@ -157,7 +237,7 @@ def forward_logits(params: dict, cfg: DecoderConfig, token_ids: jax.Array) -> ja
 
 def prefill(params: dict, cfg: DecoderConfig, token_ids: jax.Array,
             n_valid: jax.Array, *, flash: bool | None = None,
-            tp_axis: str | None = None):
+            tp_axis: str | None = None, head_fn=None):
     """Full-context forward over the (padded) prompt, emitting the KV cache
     and the logits at position n_valid-1 (the next-token distribution).
 
@@ -207,7 +287,9 @@ def prefill(params: dict, cfg: DecoderConfig, token_ids: jax.Array,
     last = jnp.take_along_axis(
         x, (n_valid - 1)[:, None, None].astype(jnp.int32), axis=1
     )[:, 0, :]
-    out = _head_out(params["embed"], last, tp_axis)
+    out = (_head_out if head_fn is None else head_fn)(
+        params["embed"], last, tp_axis
+    )
     return out, cache
 
 
@@ -254,7 +336,7 @@ def decode_step(params: dict, cfg: DecoderConfig, cache: list[dict],
 def paged_prefill(params: dict, cfg: DecoderConfig, token_ids: jax.Array,
                   n_valid: jax.Array, k_pool: jax.Array, v_pool: jax.Array,
                   block_tables: jax.Array, *, flash: bool | None = None,
-                  tp_axis: str | None = None):
+                  tp_axis: str | None = None, head_fn=None):
     """Prefill through the paged KV cache (kvcache/block_pool.py).
 
     Runs the exact dense :func:`prefill` (so prompt logits are bit-identical
@@ -269,7 +351,7 @@ def paged_prefill(params: dict, cfg: DecoderConfig, token_ids: jax.Array,
     Returns ``(logits, k_pool, v_pool)``.
     """
     logits, cache = prefill(params, cfg, token_ids, n_valid, flash=flash,
-                            tp_axis=tp_axis)
+                            tp_axis=tp_axis, head_fn=head_fn)
     B, T = token_ids.shape
     BS = k_pool.shape[2]
     nb = T // BS
@@ -288,7 +370,8 @@ def paged_decode_step(params: dict, cfg: DecoderConfig, k_pool: jax.Array,
                       v_pool: jax.Array, token: jax.Array,
                       positions: jax.Array, block_tables: jax.Array,
                       slot_blocks: jax.Array, slot_offsets: jax.Array, *,
-                      attn: str = "reference", tp_axis: str | None = None):
+                      attn: str = "reference", tp_axis: str | None = None,
+                      head_fn=None):
     """One batched incremental token through the paged cache.
 
     Unlike :func:`decode_step` (one shared scalar ``pos`` — the
@@ -337,7 +420,9 @@ def paged_decode_step(params: dict, cfg: DecoderConfig, k_pool: jax.Array,
         ff = act(_proj(layer, h, "w_up", "b_up"))
         x = x + _row_proj(layer, ff, "w_down", "b_down", tp_axis)
     x = _layer_norm(x, params["ln_f_scale"], params["ln_f_bias"], eps)
-    out = _head_out(params["embed"], x[:, 0, :], tp_axis)
+    out = (_head_out if head_fn is None else head_fn)(
+        params["embed"], x[:, 0, :], tp_axis
+    )
     return out, k_pool, v_pool
 
 
@@ -348,7 +433,8 @@ def paged_mixed_step(params: dict, cfg: DecoderConfig, k_pool: jax.Array,
                      row_token_idx: jax.Array, tok_row: jax.Array,
                      tok_col: jax.Array, slot_blocks: jax.Array,
                      slot_offsets: jax.Array, logit_idx: jax.Array, *,
-                     attn: str = "reference", tp_axis: str | None = None):
+                     attn: str = "reference", tp_axis: str | None = None,
+                     head_fn=None):
     """One RAGGED fused step over a token-PACKED mixed batch (Round-8;
     Ragged Paged Attention, arxiv 2604.15464).
 
@@ -432,7 +518,9 @@ def paged_mixed_step(params: dict, cfg: DecoderConfig, k_pool: jax.Array,
         x = x + _row_proj(layer, ff, "w_down", "b_down", tp_axis)
     x = _layer_norm(x, params["ln_f_scale"], params["ln_f_bias"], eps)
     sel = x[logit_idx]  # (B, D)
-    out = _head_out(params["embed"], sel, tp_axis)
+    out = (_head_out if head_fn is None else head_fn)(
+        params["embed"], sel, tp_axis
+    )
     return out, k_pool, v_pool
 
 
@@ -492,6 +580,127 @@ def paged_chained_decode(params: dict, cfg: DecoderConfig, k_pool: jax.Array,
         (slot_blocks.T, slot_offsets.T, jnp.arange(K, dtype=jnp.int32)),
     )
     return ids.T, k_pool, v_pool  # (B, K)
+
+
+# -- sampled program variants (Round-15) -------------------------------------
+#
+# Each wraps its greedy twin with the sampling head; the step math (and
+# therefore the logits, and therefore the greedy rows' output) is shared
+# code, not a copy.  The engine builds these as SEPARATE jitted programs
+# (pw.*_sampled) lazily, so greedy-only workloads never compile them.
+
+
+def paged_decode_step_sampled(params: dict, cfg: DecoderConfig,
+                              k_pool: jax.Array, v_pool: jax.Array,
+                              token: jax.Array, positions: jax.Array,
+                              block_tables: jax.Array, slot_blocks: jax.Array,
+                              slot_offsets: jax.Array, temperature: jax.Array,
+                              top_k: jax.Array, top_p: jax.Array,
+                              seed: jax.Array, emit_idx: jax.Array, *,
+                              attn: str = "reference",
+                              tp_axis: str | None = None):
+    """:func:`paged_decode_step` with per-row sampling: extra (B,) arrays
+    temperature (f32), top_k (int32, <=0 disables), top_p (f32, 1.0
+    disables), seed (int32, the request's fixed seed) and emit_idx (int32,
+    the absolute index of the token this step emits for the row).  Returns
+    ``(ids, k_pool, v_pool)`` with ids (B,) int32 in BOTH the single-device
+    and tp forms (logits never leave the program)."""
+    head = _sampling_head(temperature, top_k, top_p,
+                          _row_sample_keys(seed, emit_idx))
+    return paged_decode_step(
+        params, cfg, k_pool, v_pool, token, positions, block_tables,
+        slot_blocks, slot_offsets, attn=attn, tp_axis=tp_axis, head_fn=head,
+    )
+
+
+def paged_mixed_step_sampled(params: dict, cfg: DecoderConfig,
+                             k_pool: jax.Array, v_pool: jax.Array,
+                             tokens: jax.Array, positions: jax.Array,
+                             row_tables: jax.Array, row_start: jax.Array,
+                             row_nvalid: jax.Array, row_token_idx: jax.Array,
+                             tok_row: jax.Array, tok_col: jax.Array,
+                             slot_blocks: jax.Array, slot_offsets: jax.Array,
+                             logit_idx: jax.Array, temperature: jax.Array,
+                             top_k: jax.Array, top_p: jax.Array,
+                             seed: jax.Array, emit_idx: jax.Array, *,
+                             attn: str = "reference",
+                             tp_axis: str | None = None):
+    """:func:`paged_mixed_step` with per-row sampling (see
+    :func:`paged_decode_step_sampled` for the extra arrays; mid-prefill
+    rows' sampled ids are garbage the engine ignores, exactly like their
+    greedy logits).  Returns ``(ids, k_pool, v_pool)``, ids (B,) int32."""
+    head = _sampling_head(temperature, top_k, top_p,
+                          _row_sample_keys(seed, emit_idx))
+    return paged_mixed_step(
+        params, cfg, k_pool, v_pool, tokens, positions, row_tables,
+        row_start, row_nvalid, row_token_idx, tok_row, tok_col, slot_blocks,
+        slot_offsets, logit_idx, attn=attn, tp_axis=tp_axis, head_fn=head,
+    )
+
+
+def paged_chained_decode_sampled(params: dict, cfg: DecoderConfig,
+                                 k_pool: jax.Array, v_pool: jax.Array,
+                                 token: jax.Array, positions: jax.Array,
+                                 block_tables: jax.Array,
+                                 slot_blocks: jax.Array,
+                                 slot_offsets: jax.Array,
+                                 temperature: jax.Array, top_k: jax.Array,
+                                 top_p: jax.Array, seed: jax.Array,
+                                 emit0: jax.Array, *,
+                                 attn: str = "reference",
+                                 tp_axis: str | None = None):
+    """:func:`paged_chained_decode` with per-row sampling carried through
+    the scan: the per-row seed-derived base keys ride the scan CARRY
+    (device-resident for the whole chain, like the token ids), and step t
+    folds them with ``emit0 + t`` — so the noise for a row's n-th emitted
+    token depends only on (seed, n) regardless of how the chain was cut by
+    budgets, preemption, restart or failover.  ``emit0``: (B,) int32, the
+    absolute emit index of each row's step-0 token."""
+    K = slot_blocks.shape[1]
+    maxp = cfg.max_len - 1
+    base_keys = jax.vmap(
+        lambda s: jax.random.fold_in(jax.random.PRNGKey(0), s)
+    )(seed)
+
+    def body(carry, xs):
+        tok, kp, vp, keys = carry
+        sb, so, t = xs
+        pos = jnp.minimum(positions + t, maxp)
+        step_keys = jax.vmap(jax.random.fold_in)(keys, emit0 + t)
+        head = _sampling_head(temperature, top_k, top_p, step_keys)
+        ids, kp, vp = paged_decode_step(
+            params, cfg, kp, vp, tok, pos, block_tables, sb, so,
+            attn=attn, tp_axis=tp_axis, head_fn=head,
+        )
+        return (ids, kp, vp, keys), ids
+
+    (_last, k_pool, v_pool, _keys), ids = jax.lax.scan(
+        body, (token.astype(jnp.int32), k_pool, v_pool, base_keys),
+        (slot_blocks.T, slot_offsets.T, jnp.arange(K, dtype=jnp.int32)),
+    )
+    return ids.T, k_pool, v_pool  # (B, K)
+
+
+def paged_prefill_sampled(params: dict, cfg: DecoderConfig,
+                          token_ids: jax.Array, n_valid: jax.Array,
+                          k_pool: jax.Array, v_pool: jax.Array,
+                          block_tables: jax.Array, temperature: jax.Array,
+                          top_k: jax.Array, top_p: jax.Array,
+                          seed: jax.Array, emit_idx: jax.Array, *,
+                          flash: bool | None = None,
+                          tp_axis: str | None = None):
+    """:func:`paged_prefill` with first-token sampling fused in.
+    ``emit_idx`` is 0 for a fresh prompt but NOT after preemption or
+    restart re-admission, where the recompute prefill covers
+    prompt + emitted and its next token is emit index len(emitted).
+    Returns ``(ids, k_pool, v_pool)``, ids (B,) int32."""
+    head = _sampling_head(
+        temperature, top_k, top_p, _row_sample_keys(seed, emit_idx)
+    )
+    return paged_prefill(
+        params, cfg, token_ids, n_valid, k_pool, v_pool, block_tables,
+        flash=flash, tp_axis=tp_axis, head_fn=head,
+    )
 
 
 # -- shard_map wrappers: the tensor-parallel serving path (Round-9) ----------
@@ -608,6 +817,106 @@ def paged_prefill_tp(params: dict, cfg: DecoderConfig, mesh,
 
     return _tp_shard_map(fn, mesh, params, 2, 3)(
         params, k_pool, v_pool, token_ids, n_valid, block_tables,
+    )
+
+
+def paged_decode_step_sampled_tp(params: dict, cfg: DecoderConfig, mesh,
+                                 k_pool: jax.Array, v_pool: jax.Array,
+                                 token: jax.Array, positions: jax.Array,
+                                 block_tables: jax.Array,
+                                 slot_blocks: jax.Array,
+                                 slot_offsets: jax.Array,
+                                 temperature: jax.Array, top_k: jax.Array,
+                                 top_p: jax.Array, seed: jax.Array,
+                                 emit_idx: jax.Array, *,
+                                 attn: str = "reference"):
+    """:func:`paged_decode_step_sampled` over the tp mesh — the sampling
+    arrays ride as replicated inputs; the head all_gathers the sharded
+    logits row (see :func:`_sampling_head`) and the sampled (B,) ids are
+    identical on every shard, matching the replicated out_spec."""
+
+    def fn(p, k_pool, v_pool, *rest):
+        return paged_decode_step_sampled(
+            p, cfg, k_pool, v_pool, *rest, attn=attn, tp_axis="tp"
+        )
+
+    return _tp_shard_map(fn, mesh, params, 2, 10)(
+        params, k_pool, v_pool, token, positions, block_tables,
+        slot_blocks, slot_offsets, temperature, top_k, top_p, seed, emit_idx,
+    )
+
+
+def paged_mixed_step_sampled_tp(params: dict, cfg: DecoderConfig, mesh,
+                                k_pool: jax.Array, v_pool: jax.Array,
+                                tokens: jax.Array, positions: jax.Array,
+                                row_tables: jax.Array, row_start: jax.Array,
+                                row_nvalid: jax.Array,
+                                row_token_idx: jax.Array, tok_row: jax.Array,
+                                tok_col: jax.Array, slot_blocks: jax.Array,
+                                slot_offsets: jax.Array, logit_idx: jax.Array,
+                                temperature: jax.Array, top_k: jax.Array,
+                                top_p: jax.Array, seed: jax.Array,
+                                emit_idx: jax.Array, *,
+                                attn: str = "reference"):
+    """:func:`paged_mixed_step_sampled` over the tp mesh."""
+
+    def fn(p, k_pool, v_pool, *rest):
+        return paged_mixed_step_sampled(
+            p, cfg, k_pool, v_pool, *rest, attn=attn, tp_axis="tp"
+        )
+
+    return _tp_shard_map(fn, mesh, params, 2, 16)(
+        params, k_pool, v_pool, tokens, positions, row_tables, row_start,
+        row_nvalid, row_token_idx, tok_row, tok_col, slot_blocks,
+        slot_offsets, logit_idx, temperature, top_k, top_p, seed, emit_idx,
+    )
+
+
+def paged_chained_decode_sampled_tp(params: dict, cfg: DecoderConfig, mesh,
+                                    k_pool: jax.Array, v_pool: jax.Array,
+                                    token: jax.Array, positions: jax.Array,
+                                    block_tables: jax.Array,
+                                    slot_blocks: jax.Array,
+                                    slot_offsets: jax.Array,
+                                    temperature: jax.Array, top_k: jax.Array,
+                                    top_p: jax.Array, seed: jax.Array,
+                                    emit0: jax.Array, *,
+                                    attn: str = "reference"):
+    """:func:`paged_chained_decode_sampled` over the tp mesh — the scan
+    runs per shard with the replicated sampled ids as carry, exactly like
+    the greedy chain; the per-step logits gather is the only added
+    collective."""
+
+    def fn(p, k_pool, v_pool, *rest):
+        return paged_chained_decode_sampled(
+            p, cfg, k_pool, v_pool, *rest, attn=attn, tp_axis="tp"
+        )
+
+    return _tp_shard_map(fn, mesh, params, 2, 10)(
+        params, k_pool, v_pool, token, positions, block_tables,
+        slot_blocks, slot_offsets, temperature, top_k, top_p, seed, emit0,
+    )
+
+
+def paged_prefill_sampled_tp(params: dict, cfg: DecoderConfig, mesh,
+                             token_ids: jax.Array, n_valid: jax.Array,
+                             k_pool: jax.Array, v_pool: jax.Array,
+                             block_tables: jax.Array, temperature: jax.Array,
+                             top_k: jax.Array, top_p: jax.Array,
+                             seed: jax.Array, emit_idx: jax.Array, *,
+                             flash: bool | None = None):
+    """:func:`paged_prefill_sampled` over the tp mesh."""
+
+    def fn(p, k_pool, v_pool, token_ids, n_valid, bt, temperature, top_k,
+           top_p, seed, emit_idx):
+        return paged_prefill_sampled(
+            p, cfg, token_ids, n_valid, k_pool, v_pool, bt, temperature,
+            top_k, top_p, seed, emit_idx, flash=flash, tp_axis="tp",
+        )
+
+    return _tp_shard_map(fn, mesh, params, 2, 8)(
+        params, k_pool, v_pool, token_ids, n_valid, block_tables,
+        temperature, top_k, top_p, seed, emit_idx,
     )
 
 

@@ -30,7 +30,14 @@ from .admission import (
     ShedError,
     TokenBucket,
 )
-from .metrics import ServeStats, render_prometheus_lines, serve_stats
+from .fleet import ReplicaFleet
+from .metrics import (
+    FleetStats,
+    ServeStats,
+    fleet_stats,
+    render_prometheus_lines,
+    serve_stats,
+)
 from .scheduler import RequestScheduler, shared_scheduler
 
 __all__ = [
@@ -38,14 +45,17 @@ __all__ = [
     "AdmissionPolicy",
     "DeadlineExceededError",
     "EngineFailedError",
+    "FleetStats",
     "Priority",
     "QueueFullError",
     "RateLimitedError",
+    "ReplicaFleet",
     "RequestScheduler",
     "SchedulerClosedError",
     "ServeStats",
     "ShedError",
     "TokenBucket",
+    "fleet_stats",
     "render_prometheus_lines",
     "serve_stats",
     "shared_scheduler",

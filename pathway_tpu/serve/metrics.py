@@ -336,8 +336,106 @@ class KVCacheStats:
             }
 
 
+class FleetStats:
+    """Thread-safe counter block for one replica fleet (serve/fleet.py).
+
+    Prometheus names (rendered by :func:`render_prometheus_lines`):
+
+    - ``pathway_fleet_replicas{fleet}``               gauge (configured R)
+    - ``pathway_fleet_live_replicas{fleet}``          gauge
+    - ``pathway_fleet_replica_deaths_total{fleet}``   counter
+    - ``pathway_fleet_recoveries_total{fleet}``       counter (requests
+      that re-admitted on a peer and emitted a recovered token)
+    - ``pathway_fleet_recovery_seconds_total{fleet}`` counter (failure ->
+      first-recovered-token-on-a-peer, summed; /recoveries_total = mean)
+    - ``pathway_fleet_last_recovery_seconds{fleet}``  gauge
+    - ``pathway_fleet_affinity_hit_total{fleet}``     counter (routes that
+      landed on a replica already holding the prompt's prefix blocks)
+    - ``pathway_fleet_affinity_miss_total{fleet}``    counter
+    - ``pathway_fleet_replica_dead{fleet,replica}``          gauge (0/1)
+    - ``pathway_fleet_replica_inflight{fleet,replica}``      gauge
+    - ``pathway_fleet_replica_queue_depth{fleet,replica}``   gauge
+    - ``pathway_fleet_replica_handoffs_total{fleet,replica}``  counter
+      (requests this replica handed OFF when it died)
+    - ``pathway_fleet_replica_recovered_total{fleet,replica}`` counter
+      (requests this replica recovered FOR a dead peer)
+    """
+
+    def __init__(self, name: str, replicas: int = 0, live_fn=None,
+                 snapshot_fn=None):
+        self.name = name
+        self._lock = threading.Lock()
+        self.replicas = replicas
+        self._live_fn = live_fn
+        # fleet.stats() — pulled at render time for per-replica gauges;
+        # called OUTSIDE self._lock (it takes the fleet lock, and the
+        # fleet's hot path takes fleet lock then this lock)
+        self._snapshot_fn = snapshot_fn
+        self.replica_deaths = 0
+        self.recovery_count = 0
+        self.recovery_s_sum = 0.0
+        self.last_recovery_s = 0.0
+        self.affinity_hits = 0
+        self.affinity_misses = 0
+
+    def record_replica_death(self, n: int = 1) -> None:
+        with self._lock:
+            self.replica_deaths += n
+
+    def record_recovery(self, seconds: float) -> None:
+        """One stranded request's failure -> first recovered token on a
+        surviving peer."""
+        with self._lock:
+            self.recovery_count += 1
+            self.recovery_s_sum += seconds
+            self.last_recovery_s = seconds
+
+    def record_route(self, hit: bool) -> None:
+        with self._lock:
+            if hit:
+                self.affinity_hits += 1
+            else:
+                self.affinity_misses += 1
+
+    @property
+    def live(self) -> int:
+        if self._live_fn is None:
+            return 0
+        try:
+            return int(self._live_fn())
+        except Exception:
+            return 0
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            snap = {
+                "name": self.name,
+                "replicas": self.replicas,
+                "replica_deaths": self.replica_deaths,
+                "recovery_count": self.recovery_count,
+                "recovery_s_sum": self.recovery_s_sum,
+                "last_recovery_s": self.last_recovery_s,
+                "affinity_hits": self.affinity_hits,
+                "affinity_misses": self.affinity_misses,
+            }
+        snap["live"] = self.live
+        per_replica = []
+        if self._snapshot_fn is not None:
+            try:
+                per_replica = self._snapshot_fn().get("per_replica", [])
+            except Exception:
+                per_replica = []
+        snap["per_replica"] = per_replica
+        return snap
+
+
 _registry: dict[str, ServeStats] = {}
 _kv_registry: dict[str, KVCacheStats] = {}
+_fleet_registry: dict[str, FleetStats] = {}
+# SessionStore host tiers (kvcache/tiering.py) keyed by store name; the
+# store registers itself so pathway_kv_tier_* lines exist with or
+# without a fleet in front
+_tier_registry: dict[str, object] = {}
 _registry_lock = threading.Lock()
 
 
@@ -377,6 +475,38 @@ def kv_stats(name: str, blocks_in_use_fn=None, blocks_total: int | None = None,
         return stats
 
 
+def fleet_stats(name: str, replicas: int | None = None, live_fn=None,
+                store=None, snapshot_fn=None) -> FleetStats:
+    """Get-or-create the stats block for replica fleet `name` (same
+    contract as :func:`serve_stats`: counters stay monotonic across
+    fleet rebuilds).  A ``store`` (the fleet's shared SessionStore) is
+    forwarded to :func:`register_session_store` so its
+    ``pathway_kv_tier_*`` lines render too."""
+    with _registry_lock:
+        stats = _fleet_registry.get(name)
+        if stats is None:
+            stats = _fleet_registry[name] = FleetStats(
+                name, replicas or 0, live_fn, snapshot_fn,
+            )
+        else:
+            if replicas is not None:
+                stats.replicas = replicas
+            if live_fn is not None:
+                stats._live_fn = live_fn
+            if snapshot_fn is not None:
+                stats._snapshot_fn = snapshot_fn
+    if store is not None:
+        register_session_store(store)
+    return stats
+
+
+def register_session_store(store) -> None:
+    """Surface a kvcache/tiering.py SessionStore on /metrics + OTLP
+    (idempotent by store name; the store calls this from its ctor)."""
+    with _registry_lock:
+        _tier_registry[store.name] = store
+
+
 def all_stats() -> list[ServeStats]:
     with _registry_lock:
         return list(_registry.values())
@@ -387,11 +517,23 @@ def all_kv_stats() -> list[KVCacheStats]:
         return list(_kv_registry.values())
 
 
+def all_fleet_stats() -> list[FleetStats]:
+    with _registry_lock:
+        return list(_fleet_registry.values())
+
+
+def all_session_stores() -> list:
+    with _registry_lock:
+        return list(_tier_registry.values())
+
+
 def reset_registry() -> None:
     """Test hook: drop all registered stats blocks."""
     with _registry_lock:
         _registry.clear()
         _kv_registry.clear()
+        _fleet_registry.clear()
+        _tier_registry.clear()
 
 
 def _render_xla_lines() -> list[str]:
@@ -409,7 +551,8 @@ def render_prometheus_lines() -> list[str]:
     """Prometheus text-format lines, appended to MetricsServer.render()."""
     stats = all_stats()
     if not stats:
-        return _render_kv_lines() + _render_xla_lines()
+        return (_render_kv_lines() + _render_fleet_lines()
+                + _render_tier_lines() + _render_xla_lines())
     lines = [
         "# TYPE pathway_serve_queue_depth gauge",
         "# TYPE pathway_serve_admitted_total counter",
@@ -451,7 +594,138 @@ def render_prometheus_lines() -> list[str]:
             f"{snap['time_in_queue_s']:.6f}"
         )
     lines.extend(_render_kv_lines())
+    lines.extend(_render_fleet_lines())
+    lines.extend(_render_tier_lines())
     lines.extend(_render_xla_lines())
+    return lines
+
+
+def _render_fleet_lines() -> list[str]:
+    """Round-15 replica-fleet lines (``pathway_fleet_*``)."""
+    stats = all_fleet_stats()
+    if not stats:
+        return []
+    lines = [
+        "# TYPE pathway_fleet_replicas gauge",
+        "# TYPE pathway_fleet_live_replicas gauge",
+        "# TYPE pathway_fleet_replica_deaths_total counter",
+        "# TYPE pathway_fleet_recoveries_total counter",
+        "# TYPE pathway_fleet_recovery_seconds_total counter",
+        "# TYPE pathway_fleet_last_recovery_seconds gauge",
+        "# TYPE pathway_fleet_affinity_hit_total counter",
+        "# TYPE pathway_fleet_affinity_miss_total counter",
+        "# TYPE pathway_fleet_replica_dead gauge",
+        "# TYPE pathway_fleet_replica_inflight gauge",
+        "# TYPE pathway_fleet_replica_queue_depth gauge",
+        "# TYPE pathway_fleet_replica_handoffs_total counter",
+        "# TYPE pathway_fleet_replica_recovered_total counter",
+    ]
+    for s in stats:
+        snap = s.snapshot()
+        lbl = f'fleet="{s.name}"'
+        lines.append(f"pathway_fleet_replicas{{{lbl}}} {snap['replicas']}")
+        lines.append(f"pathway_fleet_live_replicas{{{lbl}}} {snap['live']}")
+        lines.append(
+            f"pathway_fleet_replica_deaths_total{{{lbl}}} "
+            f"{snap['replica_deaths']}"
+        )
+        lines.append(
+            f"pathway_fleet_recoveries_total{{{lbl}}} "
+            f"{snap['recovery_count']}"
+        )
+        lines.append(
+            f"pathway_fleet_recovery_seconds_total{{{lbl}}} "
+            f"{snap['recovery_s_sum']:.6f}"
+        )
+        lines.append(
+            f"pathway_fleet_last_recovery_seconds{{{lbl}}} "
+            f"{snap['last_recovery_s']:.6f}"
+        )
+        lines.append(
+            f"pathway_fleet_affinity_hit_total{{{lbl}}} "
+            f"{snap['affinity_hits']}"
+        )
+        lines.append(
+            f"pathway_fleet_affinity_miss_total{{{lbl}}} "
+            f"{snap['affinity_misses']}"
+        )
+        for rep in snap["per_replica"]:
+            rlbl = f'{lbl},replica="{rep["replica"]}"'
+            lines.append(
+                f"pathway_fleet_replica_dead{{{rlbl}}} "
+                f"{1 if rep['dead'] else 0}"
+            )
+            lines.append(
+                f"pathway_fleet_replica_inflight{{{rlbl}}} {rep['inflight']}"
+            )
+            lines.append(
+                f"pathway_fleet_replica_queue_depth{{{rlbl}}} "
+                f"{rep['queue_depth']}"
+            )
+            lines.append(
+                f"pathway_fleet_replica_handoffs_total{{{rlbl}}} "
+                f"{rep['handoffs_out']}"
+            )
+            lines.append(
+                f"pathway_fleet_replica_recovered_total{{{rlbl}}} "
+                f"{rep['recovered_in']}"
+            )
+    return lines
+
+
+def _render_tier_lines() -> list[str]:
+    """Round-15 host session-tier lines (``pathway_kv_tier_*``)."""
+    stores = all_session_stores()
+    if not stores:
+        return []
+    lines = [
+        "# TYPE pathway_kv_tier_suspended_sessions gauge",
+        "# TYPE pathway_kv_tier_host_bytes gauge",
+        "# TYPE pathway_kv_tier_host_budget_bytes gauge",
+        "# TYPE pathway_kv_tier_suspends_total counter",
+        "# TYPE pathway_kv_tier_resumes_total counter",
+        "# TYPE pathway_kv_tier_misses_total counter",
+        "# TYPE pathway_kv_tier_evictions_total counter",
+        "# TYPE pathway_kv_tier_resumed_tokens_total counter",
+        "# TYPE pathway_kv_tier_resume_ms_p99 gauge",
+    ]
+    for store in stores:
+        try:
+            snap = store.stats()
+        except Exception:
+            continue
+        lbl = f'store="{store.name}"'
+        lines.append(
+            f"pathway_kv_tier_suspended_sessions{{{lbl}}} "
+            f"{snap['suspended_sessions']}"
+        )
+        lines.append(
+            f"pathway_kv_tier_host_bytes{{{lbl}}} {snap['host_bytes']}"
+        )
+        lines.append(
+            f"pathway_kv_tier_host_budget_bytes{{{lbl}}} "
+            f"{snap['host_budget_bytes'] or 0}"
+        )
+        lines.append(
+            f"pathway_kv_tier_suspends_total{{{lbl}}} {snap['suspends']}"
+        )
+        lines.append(
+            f"pathway_kv_tier_resumes_total{{{lbl}}} {snap['resumes']}"
+        )
+        lines.append(
+            f"pathway_kv_tier_misses_total{{{lbl}}} {snap['misses']}"
+        )
+        lines.append(
+            f"pathway_kv_tier_evictions_total{{{lbl}}} {snap['evictions']}"
+        )
+        lines.append(
+            f"pathway_kv_tier_resumed_tokens_total{{{lbl}}} "
+            f"{snap['resumed_tokens']}"
+        )
+        lines.append(
+            f"pathway_kv_tier_resume_ms_p99{{{lbl}}} "
+            f"{snap['resume_ms_p99']:.3f}"
+        )
     return lines
 
 
@@ -665,4 +939,49 @@ def otlp_points(now_ns: str) -> list[dict]:
                         shard_attr,
                     ],
                 })
+    for s in all_fleet_stats():
+        snap = s.snapshot()
+        for key in ("replicas", "live", "replica_deaths", "recovery_count",
+                    "affinity_hits", "affinity_misses"):
+            points.append({
+                "asInt": str(snap[key]),
+                "timeUnixNano": now_ns,
+                "attributes": [
+                    {"key": "fleet", "value": {"stringValue": s.name}},
+                    {"key": "counter", "value": {"stringValue": key}},
+                ],
+            })
+        points.append({
+            "asDouble": snap["recovery_s_sum"],
+            "timeUnixNano": now_ns,
+            "attributes": [
+                {"key": "fleet", "value": {"stringValue": s.name}},
+                {"key": "counter",
+                 "value": {"stringValue": "recovery_s_sum"}},
+            ],
+        })
+    for store in all_session_stores():
+        try:
+            snap = store.stats()
+        except Exception:
+            continue
+        for key in ("suspended_sessions", "host_bytes", "suspends",
+                    "resumes", "misses", "evictions", "resumed_tokens"):
+            points.append({
+                "asInt": str(snap[key]),
+                "timeUnixNano": now_ns,
+                "attributes": [
+                    {"key": "store", "value": {"stringValue": store.name}},
+                    {"key": "counter", "value": {"stringValue": key}},
+                ],
+            })
+        points.append({
+            "asDouble": snap["resume_ms_p99"],
+            "timeUnixNano": now_ns,
+            "attributes": [
+                {"key": "store", "value": {"stringValue": store.name}},
+                {"key": "counter",
+                 "value": {"stringValue": "resume_ms_p99"}},
+            ],
+        })
     return points

@@ -78,6 +78,26 @@ class DashboardServer:
                 dict(parse_qsl(path.partition("?")[2]))
             ).encode()
             return 200, body, "application/json"
+        if path.split("?", 1)[0] == "/fleet":
+            # replica serving front (round-15): per-replica load,
+            # affinity hit rate, suspended sessions + resume p99 from
+            # THIS process's fleet/session-tier registries
+            from ..serve import metrics as serve_metrics
+
+            data = {
+                "fleets": [
+                    s.snapshot() for s in serve_metrics.all_fleet_stats()
+                ],
+                "stores": [],
+            }
+            for store in serve_metrics.all_session_stores():
+                try:
+                    snap = store.stats()
+                except Exception:
+                    continue
+                snap["name"] = store.name
+                data["stores"].append(snap)
+            return 200, json.dumps(data).encode(), "application/json"
         if path.startswith("/metrics/") or path == "/graph":
             conn = self._ensure_conn()
             if path == "/metrics/latest":

@@ -1,0 +1,360 @@
+"""Round-15 replica serving front (ISSUE 16).
+
+Pins the fleet-tier guarantees:
+
+- SAMPLING IS EXACT AT TEMP 0: a ``sampling=(0, ...)`` request decodes
+  byte-equal to greedy — including greedy rows riding inside a sampled
+  batch — and the sampled step variants are the ONLY extra compiled
+  programs (a greedy-only engine never builds them; a warmed mixed
+  workload recompiles nothing);
+- SAMPLING IS REPRODUCIBLE: a fixed seed replays the identical token
+  trajectory — across plain re-runs, across a supervised engine restart
+  (the emit-index seed schedule survives re-admission), and across a
+  replica failover;
+- FLEET ROUTING: prefix-affine routing sends a conversation's next turn
+  back to the replica holding its blocks; fleet output is byte-equal to
+  a single engine's;
+- REAL FAILOVER: killing one replica MID-decode (restart budget 0)
+  completes every in-flight request token-identically on a peer;
+  requests fail typed (EngineFailedError, 503-mappable) only when the
+  whole fleet is dead;
+- SESSION TIER: an idle session's blocks suspend to host RAM and the
+  next turn resumes token-identically through the shared store; the
+  ``residency_ledger`` proves >= 4x sessions at fixed HBM; LRU eviction
+  enforces the host budget;
+- STREAMING: register_stream turns on_token into per-token SSE frames —
+  trace echoed on the stream, ``data: [DONE]`` terminator, sheds keep
+  the 429 + Retry-After mapping, a dead fleet keeps 503.
+
+The module shares ONE reference engine and ONE 2-replica fleet; the
+destructive tests (kill-one, whole-fleet-dead) run LAST in file order.
+"""
+
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import jax
+import pytest
+
+from pathway_tpu import faults
+from pathway_tpu.kvcache import PagedDecodeEngine, SessionStore
+from pathway_tpu.models.decoder import DecoderConfig, init_decoder_params
+from pathway_tpu.serve import ReplicaFleet
+from pathway_tpu.serve.admission import EngineFailedError, QueueFullError
+
+from .utils import CompileWatch
+
+_CFG = DecoderConfig(
+    vocab_size=64, d_model=64, n_layers=2, n_heads=8, d_ff=128, max_len=128
+)
+
+_EKW = dict(num_blocks=96, block_size=4, max_batch_size=8,
+            seq_buckets=(16, 32, 64), prefill_chunk=8, chain_steps=4)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_decoder_params(_CFG, jax.random.PRNGKey(0))
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+@pytest.fixture(scope="module")
+def ref_eng(params):
+    return PagedDecodeEngine(_CFG, params, name="t_fleet_ref", **_EKW)
+
+
+@pytest.fixture(scope="module")
+def store():
+    return SessionStore(name="t_fleet_sessions")
+
+
+@pytest.fixture(scope="module")
+def fleet(params, store):
+    f = ReplicaFleet(_CFG, params, replicas=2, name="t_fleet",
+                     session_store=store, max_restarts=0, **_EKW)
+    yield f
+    f.shutdown(drain=False, timeout_s=5.0)
+
+
+# -- device-side sampling --------------------------------------------------
+
+
+def test_greedy_only_engine_builds_no_sampled_programs(ref_eng):
+    """A greedy workload must not pay for sampling: the pw.*_sampled
+    programs are built on FIRST sampled use, not eagerly."""
+    watch = CompileWatch()
+    out = ref_eng.generate_batch([([1, 2, 3], 8), ([5, 6, 7, 8, 9], 8)])
+    assert all(len(o) == 8 for o in out)
+    assert ref_eng._sampled is None
+    assert all("sampled" not in e.program for e in watch.events())
+
+
+def test_temp0_is_greedy_token_identical(ref_eng):
+    prompts = [[1, 2, 3], [5, 6, 7, 8, 9], [2] * 12, [7, 8]]
+    greedy = ref_eng.generate_batch([(p, 8) for p in prompts])
+    # temp-0 rows AND plain greedy rows riding the same sampled batch
+    mixed = [
+        (p, 8, {"sampling": (0.0, 0, 0.0, 100 + i)}) if i % 2 == 0
+        else (p, 8)
+        for i, p in enumerate(prompts)
+    ]
+    assert ref_eng.generate_batch(mixed) == greedy
+    # acceptance: zero extra compiled programs beyond the sampled step
+    # variants the first mixed pass just built
+    watch = CompileWatch()
+    assert ref_eng.generate_batch(mixed) == greedy
+    watch.assert_no_compiles("warm mixed greedy+temp0 pass")
+
+
+def test_fixed_seed_replays_identical_trajectory(ref_eng):
+    spec = (0.9, 8, 0.95, 1234)
+    a = ref_eng.generate_batch([([3, 1, 4, 1, 5], 10, {"sampling": spec})])[0]
+    b = ref_eng.generate_batch([([3, 1, 4, 1, 5], 10, {"sampling": spec})])[0]
+    assert a == b
+    c = ref_eng.generate_batch(
+        [([3, 1, 4, 1, 5], 10, {"sampling": (0.9, 8, 0.95, 4321)})]
+    )[0]
+    assert c != a  # a different seed draws a different trajectory
+
+
+def test_sampled_restart_token_identity(params, ref_eng):
+    """The emit-index seed schedule survives a supervised restart: the
+    re-admitted request resumes drawing at len(emitted), so sampled
+    output is bit-identical to an uninterrupted run."""
+    reqs = [
+        ([1 + i, 2, 3, 4], 12, {"sampling": (0.8, 0, 0.0, 40 + i)})
+        for i in range(4)
+    ]
+    ref = ref_eng.generate_batch([tuple(r) for r in reqs])
+    eng = PagedDecodeEngine(_CFG, params, name="t_fleet_restart",
+                            max_restarts=1, **_EKW)
+    faults.install("engine.dispatch.chain", "raise", nth=2)
+    assert eng.generate_batch([tuple(r) for r in reqs]) == ref
+
+
+# -- fleet routing + serving ----------------------------------------------
+
+
+def test_fleet_greedy_matches_engine_and_affinity_routes_back(fleet, ref_eng):
+    prompts = [[1, 2, 3, 4], [9, 8, 7, 6, 5], [4] * 10]
+    ref = ref_eng.generate_batch([(p, 8) for p in prompts])
+    outs = [fleet.submit(p, 8) for p in prompts]
+    assert outs == ref
+    # the conversation's next turn extends prompt+out, whose deepest
+    # digest now hits the affinity table
+    hits0 = fleet.affinity_hit_count
+    fleet.route(prompts[0] + outs[0] + [17])
+    assert fleet.affinity_hit_count == hits0 + 1
+
+
+def test_fleet_sampled_matches_engine(fleet, ref_eng):
+    spec = (0.9, 8, 0.95, 777)
+    ref = ref_eng.generate_batch(
+        [([2, 7, 1, 8, 2, 8], 10, {"sampling": spec})]
+    )[0]
+    assert fleet.submit([2, 7, 1, 8, 2, 8], 10, sampling=spec) == ref
+
+
+def test_session_tier_second_turn_token_identical(fleet, ref_eng, store):
+    sid = "conv-42"
+    p1 = [5, 4, 3, 2, 1, 0, 1, 2]
+    out1 = fleet.submit(p1, 8, session=sid)
+    assert out1 == ref_eng.generate(p1, 8)
+    assert store.n_suspends >= 1  # turn ended -> blocks left HBM
+    # second turn sends the running conversation back; the store's K/V
+    # re-scatters instead of recomputing the history prefill
+    p2 = p1 + out1 + [9, 9]
+    resumes0 = store.n_resumes
+    out2 = fleet.submit(p2, 8, session=sid)
+    assert store.n_resumes == resumes0 + 1
+    assert out2 == ref_eng.generate(p2, 8)
+
+
+# -- failover (destructive: kills fleet replicas) -------------------------
+
+
+def test_kill_one_replica_mid_decode_token_identical(fleet, ref_eng):
+    """A chain-dispatch fault with restart budget 0 kills one replica;
+    every in-flight request must complete on a peer, byte-equal to an
+    undisturbed run (the acceptance bar)."""
+    prompts = [[i + 1, i + 2, i + 3, 5] for i in range(6)]
+    ref = ref_eng.generate_batch([(p, 12) for p in prompts])
+    results: list = [None] * len(prompts)
+    errors: list = []
+
+    def run(i, p):
+        try:
+            results[i] = fleet.submit(p, 12, timeout_s=120.0)
+        except Exception as exc:  # noqa: BLE001 - asserted empty below
+            errors.append((i, exc))
+
+    faults.install("engine.dispatch.chain", "raise", nth=3)
+    threads = [
+        threading.Thread(target=run, args=(i, p))
+        for i, p in enumerate(prompts)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=180.0)
+    assert not errors, errors
+    assert results == ref
+    st = fleet.stats()
+    assert st["live"] == 1  # exactly one replica died
+    assert st["recovery_s"], "no failover was recorded"
+    assert sum(r["recovered_in"] for r in st["per_replica"]) >= 1
+    assert sum(r["handoffs_out"] for r in st["per_replica"]) >= 1
+
+
+def test_sse_streaming_tokens_match_submit(fleet):
+    from pathway_tpu.io.http import PathwayWebserver
+
+    ws = PathwayWebserver("127.0.0.1", 0, with_schema_endpoint=False)
+    ws.register_stream("/stream", fleet.submit)
+    ws._ensure_started()
+    port = ws._server.server_address[1]
+    try:
+        expect = fleet.submit([11, 12, 13, 14], 6)
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/stream",
+            data=json.dumps({"prompt": [11, 12, 13, 14],
+                             "max_new": 6}).encode(),
+            method="POST",
+            headers={"Content-Type": "application/json",
+                     "X-Pathway-Trace": "ssetrace1"},
+        )
+        with urllib.request.urlopen(req, timeout=60) as resp:
+            assert resp.headers["Content-Type"].startswith(
+                "text/event-stream"
+            )
+            assert resp.headers["X-Pathway-Trace"] == "ssetrace1"
+            raw = resp.read().decode()
+        frames = [ln[6:] for ln in raw.splitlines() if ln.startswith("data: ")]
+        assert frames[-1] == "[DONE]"
+        events = [json.loads(f) for f in frames[:-1]]
+        assert events[0]["trace"] == "ssetrace1"  # echoed ON the stream
+        tokens = [e["token"] for e in events if "token" in e]
+        done = [e for e in events if e.get("done")]
+        assert len(done) == 1
+        assert tokens == done[0]["tokens"] == expect
+    finally:
+        ws.shutdown()
+
+
+def test_sse_shed_before_first_token_maps_to_429():
+    from pathway_tpu.io.http import PathwayWebserver
+
+    ws = PathwayWebserver("127.0.0.1", 0, with_schema_endpoint=False)
+
+    def submit(prompt, max_new, *, on_token):
+        raise QueueFullError("request queue is full", retry_after_s=3.0)
+
+    ws.register_stream("/gen", submit)
+    ws._ensure_started()
+    port = ws._server.server_address[1]
+    try:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/gen",
+            data=json.dumps({"prompt": [1, 2], "max_new": 4}).encode(),
+            method="POST",
+            headers={"Content-Type": "application/json"},
+        )
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=30)
+        assert ei.value.code == 429
+        assert ei.value.headers.get("Retry-After") == "3"
+    finally:
+        ws.shutdown()
+
+
+def test_whole_fleet_dead_fails_typed_and_sse_maps_503(fleet):
+    from pathway_tpu.io.http import PathwayWebserver
+
+    for rep in fleet.replicas:
+        fleet.kill(rep.idx)
+    with pytest.raises(EngineFailedError) as ei:
+        fleet.submit([1, 2, 3, 4], 4)
+    assert ei.value.retry_after_s == 30.0
+    # a pre-first-token engine failure keeps the non-streamed mapping
+    ws = PathwayWebserver("127.0.0.1", 0, with_schema_endpoint=False)
+    ws.register_stream("/gen", fleet.submit)
+    ws._ensure_started()
+    port = ws._server.server_address[1]
+    try:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/gen",
+            data=json.dumps({"prompt": [1, 2, 3], "max_new": 4}).encode(),
+            method="POST",
+            headers={"Content-Type": "application/json"},
+        )
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=30)
+        assert ei.value.code == 503
+        assert ei.value.headers.get("Retry-After") == "30"
+    finally:
+        ws.shutdown()
+
+
+# -- observability + residency accounting ---------------------------------
+
+
+def test_fleet_and_tier_metrics_render(fleet, store):
+    from pathway_tpu.serve.metrics import otlp_points, render_prometheus_lines
+
+    text = "\n".join(render_prometheus_lines())
+    assert 'pathway_fleet_replicas{fleet="t_fleet"} 2' in text
+    assert 'pathway_fleet_replica_deaths_total{fleet="t_fleet"}' in text
+    assert 'pathway_fleet_affinity_hit_total{fleet="t_fleet"}' in text
+    assert 'fleet="t_fleet",replica="0"' in text
+    assert 'pathway_kv_tier_suspended_sessions{store="t_fleet_sessions"}' \
+        in text
+    assert 'pathway_kv_tier_resumes_total{store="t_fleet_sessions"}' in text
+    pts = otlp_points("123")
+    fleet_pts = [
+        p for p in pts
+        if any(a["key"] == "fleet"
+               and a["value"]["stringValue"] == "t_fleet"
+               for a in p["attributes"])
+    ]
+    store_pts = [
+        p for p in pts
+        if any(a["key"] == "store"
+               and a["value"]["stringValue"] == "t_fleet_sessions"
+               for a in p["attributes"])
+    ]
+    assert fleet_pts and store_pts
+
+
+def test_residency_ledger_reports_4x_at_fixed_hbm(fleet, store):
+    plan = fleet.replicas[0].engine.hbm_plan
+    row = store.residency_ledger(
+        plan, session_tokens=64, host_budget_bytes=256 * 1024 * 1024
+    )
+    assert row["paged_only_sessions"] >= 1
+    assert row["sessions_resident"] >= 4 * row["paged_only_sessions"]
+    assert row["residency_gain"] >= 4.0
+
+
+def test_session_store_lru_eviction_under_host_budget():
+    from pathway_tpu.kvcache.block_pool import BlockPool
+
+    pool = BlockPool(num_blocks=16, block_size=4, n_layers=1, n_heads=2,
+                     head_dim=4, name="t_evict_pool")
+    # one 8-token session: 2 blocks x [1, ., 4, 2, 4] f32 x (k+v) = 512 B;
+    # a 1200 B budget holds two
+    st = SessionStore(host_budget_bytes=1200, name="t_evict")
+    for i in range(4):
+        pool.allocate(i, 8)
+        st.suspend(f"s{i}", pool, i, list(range(8)))
+    assert st.n_evictions >= 2
+    assert st.host_bytes <= 1200
+    assert st.match("s0", list(range(8))) is None  # LRU victim
+    assert st.match("s3", list(range(8))) is not None  # most recent kept
