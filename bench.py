@@ -709,6 +709,126 @@ def bench_fleet() -> dict:
     return out
 
 
+def bench_ssd() -> dict:
+    """Round-16 constant-memory decode rows (SOFT self-history gates):
+
+    - ``live_sessions_at_fixed_hbm_vs_paged``: the ``hbm_plan``-computed
+      capacity headline — at one fixed HBM budget, live sequences the
+      state backend holds (budget / state_bytes_per_seq) over what the
+      paged pool holds at the same per-session context.  The acceptance
+      floor (>= 4x at 128-token sessions) is pinned in
+      tests/test_statecache.py; the bench commits the measured ratio.
+    - ``decode_tokens_per_s``: greedy chained-decode throughput through
+      ``StateDecodeEngine`` (same harness shape as the paged rows).
+    - ``session_resume_ms_p99``: host-tier suspend/resume round-trip
+      across real conversation turns — measured at SHORT (~128-token)
+      and LONG (~2k-token) session contexts separately; the state is a
+      fixed-size buffer, so the two must agree within noise
+      (``session_resume_ctx_ratio`` records long/short).
+
+    Any section degrades to an error note instead of failing the
+    bench."""
+    out: dict = {}
+    try:
+        import jax as _jax
+        import numpy as _np
+
+        from pathway_tpu.kvcache.statecache import StateDecodeEngine
+        from pathway_tpu.kvcache.tiering import SessionStore
+        from pathway_tpu.models.decoder import (
+            DecoderConfig as _DC, init_decoder_params as _init,
+        )
+        from pathway_tpu.obs.memory import hbm_plan as _hbm_plan
+
+        cfg = _DC(vocab_size=256, d_model=64, n_layers=2, n_heads=4,
+                  d_ff=128, max_len=128)
+        params = _init(cfg, _jax.random.PRNGKey(0))
+        rng = _np.random.default_rng(16)
+        # ---- capacity headline: state vs paged at one HBM budget ------
+        budget = 64 * 1024 * 1024
+        session_tokens = 128
+        block_size = 4
+        paged_plan = _hbm_plan(
+            cfg, num_blocks=128, block_size=block_size, max_batch_size=8,
+            chain_steps=4, params=params, budget_bytes=budget,
+            reference_attn=False,
+        )
+        eng = StateDecodeEngine(
+            cfg, params, name="bench_ssd", max_slots=64, max_batch_size=8,
+            prefill_chunk=16, chain_steps=8,
+        )
+        sbps = int(eng.hbm_plan.state_bytes_per_seq)
+        state_plan = _hbm_plan(
+            cfg, num_blocks=eng.pool.max_slots, block_size=block_size,
+            max_batch_size=8, chain_steps=8, params=params,
+            budget_bytes=budget, reference_attn=False,
+            state_bytes_per_seq=sbps,
+        )
+        cache_budget = (budget - state_plan.params_bytes
+                        - state_plan.temp_bytes)
+        state_sessions = cache_budget // sbps
+        blocks_per_session = -(-session_tokens // block_size)
+        paged_blocks = (budget - paged_plan.params_bytes
+                        - paged_plan.temp_bytes) \
+            // max(paged_plan.per_block_bytes, 1)
+        paged_sessions = paged_blocks // blocks_per_session
+        out["state_bytes_per_seq"] = sbps
+        out["session_tokens"] = session_tokens
+        out["live_sessions_state"] = int(state_sessions)
+        out["live_sessions_paged"] = int(paged_sessions)
+        out["live_sessions_at_fixed_hbm_vs_paged"] = round(
+            state_sessions / max(paged_sessions, 1), 1
+        )
+        # ---- chained greedy decode throughput -------------------------
+        reqs = [(list(rng.integers(1, 256, size=6)), 32) for _ in range(8)]
+        eng.generate_batch([(list(p), n) for p, n in reqs])  # warm
+        t0 = time.perf_counter()
+        got = eng.generate_batch([(list(p), n) for p, n in reqs])
+        el = time.perf_counter() - t0
+        out["decode_tokens_per_s"] = round(
+            sum(len(g) for g in got) / el, 1
+        )
+        # ---- resume latency vs context length -------------------------
+        # resume copies ONE fixed-size state buffer, so a 2k-token
+        # session must resume as fast as a 128-token one
+        def _resume_p99(ctx_tokens: int) -> float:
+            store = SessionStore()
+            seng = StateDecodeEngine(
+                cfg, params, name=f"bench_ssd_sess{ctx_tokens}",
+                max_slots=8, max_batch_size=4, prefill_chunk=16,
+                chain_steps=8, session_store=store,
+            )
+            # warm on a throwaway store: the first suspend/resume pays
+            # the pw.state_suspend/resume compile, not the copy
+            wp = list(rng.integers(1, 256, size=16))
+            wsess = {"session": f"ssd-warm-{ctx_tokens}"}
+            wt = seng.generate_batch([(wp, 4, dict(wsess))])[0]
+            seng.generate_batch([(wp + wt + [3], 4, dict(wsess))])
+            store = SessionStore()
+            seng.session_store = store
+            for i in range(4):
+                p = list(rng.integers(1, 256, size=ctx_tokens - 16))
+                sess = {"session": f"ssd-sess-{ctx_tokens}-{i}"}
+                t1 = seng.generate_batch([(p, 8, dict(sess))])[0]
+                seng.generate_batch([(p + t1 + [3], 8, dict(sess))])
+            st = store.stats()
+            out[f"session_resumes_ctx{ctx_tokens}"] = st["resumes"]
+            return float(st["resume_ms_p99"])
+
+        short_p99 = _resume_p99(128)
+        long_p99 = _resume_p99(2048)
+        out["session_resume_ms_p99"] = round(max(short_p99, long_p99), 2)
+        out["session_resume_ms_p99_ctx128"] = round(short_p99, 2)
+        out["session_resume_ms_p99_ctx2048"] = round(long_p99, 2)
+        if short_p99 > 0:
+            out["session_resume_ctx_ratio"] = round(
+                long_p99 / short_p99, 2
+            )
+    except Exception as exc:  # noqa: BLE001 - never cost the headline
+        out["ssd_error"] = f"{type(exc).__name__}: {exc}"[:300]
+    return out
+
+
 def bench_parallel(n_rows_per_file: int = 50_000, n_files: int = 16) -> dict:
     """Measured multi-process scaling of the engine data plane.  On a
     single-core host this honestly reports <= 1x (processes time-slice one
@@ -1929,6 +2049,23 @@ _HISTORY_BESTS = {
         "max",
         lambda p: (p.get("fleet") or {}).get("sessions_resident_at_fixed_hbm"),
     ),
+    # round-16 constant-memory decode rows (SOFT — deliberately NOT in
+    # _GATED_METRICS): the hbm_plan capacity ratio is a computed ledger
+    # row (its >= 4x floor is a test assertion, not a bench gate), and
+    # the throughput/resume rows accumulate self-history like the other
+    # serving rows
+    "ssd.live_sessions_at_fixed_hbm_vs_paged": (
+        "max",
+        lambda p: (p.get("ssd") or {}).get(
+            "live_sessions_at_fixed_hbm_vs_paged"
+        ),
+    ),
+    "ssd.decode_tokens_per_s": (
+        "max", lambda p: (p.get("ssd") or {}).get("decode_tokens_per_s"),
+    ),
+    "ssd.session_resume_ms_p99": (
+        "min", lambda p: (p.get("ssd") or {}).get("session_resume_ms_p99"),
+    ),
 }
 
 
@@ -2519,6 +2656,9 @@ def main() -> None:
     _stage("fleet")
     fleet = bench_fleet()
     _PARTIAL["fleet"] = fleet
+    _stage("ssd")
+    ssd = bench_ssd()
+    _PARTIAL["ssd"] = ssd
 
     # last-chance TPU acquisition: if the tunnel healed since startup,
     # capture real TPU evidence (MFU / Pallas / fused generation) now and
@@ -2597,6 +2737,11 @@ def main() -> None:
         # replica-kill MTTR, session-tier resume p99 and the HBM-ledger
         # residency row (soft self-history gates; see bench_fleet)
         "fleet": fleet,
+        # round-16 constant-memory decode rows: the hbm_plan-computed
+        # live-session capacity ratio vs the paged pool, SSD chained
+        # decode throughput, and context-independent session resume p99
+        # (soft self-history gates; see bench_ssd)
+        "ssd": ssd,
         "n_docs": n_docs,
         "embed_dim": enc.dimensions,
         "backend": backend,

@@ -231,10 +231,25 @@ def main(argv: list[str] | None = None) -> int:
     return 2
 
 
+def _program_family(name: str) -> str:
+    """Family key for the profile rollup: ``pw.<plane>_<op>`` programs
+    group by plane (``pw.ssd_chained_decode`` -> ``pw.ssd``,
+    ``pw.state_suspend`` -> ``pw.state``, ``pw.chained_decode`` ->
+    ``pw.chained``); anything else groups under its leading dotted
+    component."""
+    if name.startswith("pw."):
+        rest = name[3:]
+        head = rest.split("_", 1)[0] if "_" in rest else rest
+        return f"pw.{head}"
+    return name.split(".", 1)[0] if "." in name else name
+
+
 def format_profile_table(data: dict) -> str:
     """The ranked per-program device cost table (Round-14): one row per
     (program, bucket), ordered by total dispatch seconds — the "which
-    kernel to fuse first" view of ``/debug/profile``."""
+    kernel to fuse first" view of ``/debug/profile``.  Round-16 appends
+    a per-family rollup (``pw.ssd``, ``pw.paged``, ...) so a whole
+    decode plane's device share reads off one line."""
     cols = ("program", "disp", "ms p50", "share", "GFLOP", "MB", "AI",
             "MFU", "bound", "compiles", "compile s")
     rows = []
@@ -279,6 +294,29 @@ def format_profile_table(data: dict) -> str:
         f"compile_s_total={data.get('compile_s_total')} "
         f"peak={fmt(data.get('peak_flops_per_s'), 1e9, 1)} GFLOP/s"
     )
+    families: dict[str, dict] = {}
+    for r in progs:
+        fam = families.setdefault(
+            _program_family(r.get("program") or "?"),
+            {"programs": 0, "dispatches": 0, "disp_s": 0.0, "compiles": 0},
+        )
+        fam["programs"] += 1
+        fam["dispatches"] += r.get("dispatches") or 0
+        fam["disp_s"] += r.get("dispatch_s_total") or 0.0
+        fam["compiles"] += r.get("n_compiles") or 0
+    if len(families) > 1:
+        lines.append("")
+        lines.append("by family:")
+        ranked = sorted(
+            families.items(), key=lambda kv: -kv[1]["disp_s"]
+        )
+        for fam_name, f in ranked:
+            lines.append(
+                f"  {fam_name.ljust(12)} programs={f['programs']:<3d} "
+                f"disp={f['dispatches']:<6d} "
+                f"share={f['disp_s'] / total_disp:6.1%} "
+                f"compiles={f['compiles']}"
+            )
     events = data.get("recompile_events") or []
     if events:
         lines.append("")

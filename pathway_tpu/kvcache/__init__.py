@@ -30,24 +30,42 @@ reduction — no replicated [B, vocab] gather ever materializes).
 ``PagedDecodeEngine(tp=...)``; tp=1 degenerates to the exact
 single-device programs.
 
+Round-16 (ARCHITECTURE.md "Round-16: Constant-memory decode and the
+cache-backend contract") extracts the engine<->cache contract into
+backend.py (``CacheBackend`` + ``make_backend``; BlockPool is its paged
+implementation, behavior-identical) and adds a second implementation:
+statecache.py — ``StateCache`` slots hold the SSD/linear-attention
+decoder's fixed-size recurrent states (models/decoder.py ``ssd_*``), so
+per-sequence HBM and session suspend/resume cost are CONSTANT in
+context length; ``StateDecodeEngine`` serves them with the paged
+engine's exact surface (continuous batching, chained decode, watchdog
+restart, tiering, fleet failover).
+
 Kernel shape follows Ragged Paged Attention (arxiv 2604.15464); the
 managed-resource framing follows arxiv 2603.09555.
 """
 
+from .backend import CacheBackend, UnsupportedCacheOp, make_backend
 from .block_pool import BlockPool, PoolExhausted, SequenceState
 from .engine import EngineHungError, PagedDecodeEngine, resolve_tp
 from .paged_attention import paged_attention, paged_attention_reference
 from .prefix_cache import PrefixCache
+from .statecache import StateCache, StateDecodeEngine
 from .tiering import SessionStore
 
 __all__ = [
     "SessionStore",
     "BlockPool",
+    "CacheBackend",
     "EngineHungError",
     "PoolExhausted",
     "SequenceState",
     "PrefixCache",
     "PagedDecodeEngine",
+    "StateCache",
+    "StateDecodeEngine",
+    "UnsupportedCacheOp",
+    "make_backend",
     "resolve_tp",
     "paged_attention",
     "paged_attention_reference",

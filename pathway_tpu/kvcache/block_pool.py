@@ -31,6 +31,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .backend import CacheBackend
+
 
 # live pools by metrics name: a second concurrent pool must not collide
 # with (and corrupt) an existing pool's stats block — it gets a "#n"
@@ -86,8 +88,15 @@ class SequenceState:
         return len(self.block_ids)
 
 
-class BlockPool:
-    """Refcounted block allocator over stacked per-layer K/V pool arrays."""
+class BlockPool(CacheBackend):
+    """Refcounted block allocator over stacked per-layer K/V pool arrays —
+    the PAGED implementation of the Round-16 engine↔cache contract
+    (backend.py)."""
+
+    cache_kind = "paged"
+    supports_fork = True
+    supports_prefix = True
+    supports_preemption = True
 
     def __init__(self, *, num_blocks: int, block_size: int, n_layers: int,
                  n_heads: int, head_dim: int, dtype=jnp.float32,
@@ -179,6 +188,16 @@ class BlockPool:
         """K + V HBM held by EACH shard (the whole pool when tp=1)."""
         total = int(self.k.size) + int(self.v.size)
         return total * self.k.dtype.itemsize // self.tp
+
+    def state_bytes_per_seq(self, n_tokens: int) -> int:
+        """GLOBAL device bytes one ``n_tokens`` sequence occupies: its
+        block span times the per-block K/V bytes summed across shards
+        (a block id means the same head-split block on every shard)."""
+        per_block = (
+            (int(self.k.size) + int(self.v.size))
+            * self.k.dtype.itemsize // self.num_blocks
+        )
+        return self.blocks_for(max(int(n_tokens), 1)) * per_block
 
     @property
     def num_free(self) -> int:
@@ -352,6 +371,46 @@ class BlockPool:
             seq = self._seqs.pop(seq_id)
             for b in seq.block_ids:
                 self.decref(b)
+
+    # -- suspend / resume (backend contract; tiering.SessionStore) ---------
+    def suspend_host(self, seq_id: int, context_tokens) -> tuple[dict | None,
+                                                                 int]:
+        """Gather the sequence's context blocks to host memory and free
+        them from the pool.  The host buffers keep the power-of-two
+        padded gather width (O(log max_blocks) compiled variants), and
+        the returned byte charge is the PADDED buffer size — what the
+        process actually holds, not the logical block span."""
+        from .tiering import _pad_width, _tier_gather
+
+        nb = self.blocks_for(len(context_tokens))
+        if nb == 0:
+            self.free_sequence(seq_id)
+            return None, 0
+        with self._lock:
+            blocks = self._seqs[seq_id].block_ids[:nb]
+        pad = _pad_width(nb)
+        padded = np.zeros(pad, np.int32)
+        padded[:nb] = blocks
+        idx = jnp.asarray(padded)
+        k_host = np.asarray(_tier_gather(self.k, idx))
+        v_host = np.asarray(_tier_gather(self.v, idx))
+        self.free_sequence(seq_id)
+        payload = {"k": k_host, "v": v_host, "nb": nb}
+        return payload, int(k_host.nbytes) + int(v_host.nbytes)
+
+    def resume_host(self, payload: dict, slot_ids) -> None:
+        """Scatter a suspended payload into freshly allocated blocks.
+        Padded lanes target block 0 — the designated garbage sink — so
+        one compiled scatter serves every session length."""
+        from .tiering import _tier_scatter
+
+        nb = int(payload["nb"])
+        pad = int(payload["k"].shape[1])
+        table = np.zeros(pad, np.int32)
+        table[:nb] = list(slot_ids)[:nb]
+        idx = jnp.asarray(table)
+        self.k = _tier_scatter(self.k, idx, jnp.asarray(payload["k"]))
+        self.v = _tier_scatter(self.v, idx, jnp.asarray(payload["v"]))
 
     # -- preemption --------------------------------------------------------
     def preempt(self, *, exclude: set | frozenset = frozenset()

@@ -68,7 +68,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from .. import faults, obs
-from .block_pool import BlockPool, PoolExhausted
+from .backend import make_backend
+from .block_pool import BlockPool, PoolExhausted  # noqa: F401 - re-export
 from .prefix_cache import PrefixCache
 
 
@@ -256,18 +257,22 @@ class _Active:
 
 
 def build_engine(cfg, params, fallback_msg: str, logger_name: str,
-                 **kwargs):
-    """Construct a :class:`PagedDecodeEngine`, or log at INFO and return
-    None when it cannot be built — the shared fallback shape for hosts
-    whose serial tier keeps working (JaxDecoderLM.paged_engine,
-    Int8DecoderHost.paged_engine)."""
+                 engine_cls=None, **kwargs):
+    """Construct a decode engine (:class:`PagedDecodeEngine` by default,
+    or ``engine_cls`` — e.g. kvcache.statecache.StateDecodeEngine), or
+    log at INFO and return None when it cannot be built — the shared
+    fallback shape for hosts whose serial tier keeps working
+    (JaxDecoderLM.paged_engine, Int8DecoderHost.paged_engine)."""
+    cls = engine_cls or PagedDecodeEngine
     try:
-        return PagedDecodeEngine(cfg, params, **kwargs)
+        return cls(cfg, params, **kwargs)
     except Exception as exc:  # noqa: BLE001 - the serial tier works
         import logging
 
         logging.getLogger(logger_name).info(
-            "paged KV decode engine unavailable (%s); %s", exc, fallback_msg
+            "%s decode engine unavailable (%s); %s",
+            "paged KV" if cls is PagedDecodeEngine else cls.__name__,
+            exc, fallback_msg,
         )
         return None
 
@@ -381,14 +386,17 @@ class PagedDecodeEngine:
                 raise ValueError(self.hbm_plan.reject_message())
         # Round-13 failure domain: the pool's constructor args are kept so
         # a supervised restart can rebuild it from scratch (a failed or
-        # hung dispatch may have consumed the donated K/V arrays)
+        # hung dispatch may have consumed the donated K/V arrays).
+        # Round-16: construction goes through the cache-backend factory
+        # (backend.py) — the engine programs against the CacheBackend
+        # contract, with BlockPool as its paged implementation.
         self._pool_kwargs = dict(
             num_blocks=num_blocks, block_size=block_size,
             n_layers=cfg.n_layers, n_heads=cfg.n_heads, head_dim=head_dim,
             dtype=_resolve_dtype(cfg.dtype), name=name, mesh=self.mesh,
         )
         self._prefix_sharing = bool(prefix_sharing)
-        self.pool = BlockPool(**self._pool_kwargs)
+        self.pool = make_backend("paged", **self._pool_kwargs)
         self.prefix = PrefixCache(self.pool) if prefix_sharing else None
         # watchdog + supervised restart (Round-13): a dispatch blocked
         # past watchdog_timeout_s raises EngineHungError; any engine
@@ -954,7 +962,7 @@ class PagedDecodeEngine:
         old_pool.retire()
         try:
             self.pool = None
-            self.pool = BlockPool(**self._pool_kwargs)
+            self.pool = make_backend("paged", **self._pool_kwargs)
         except BaseException:
             # keep a pool object attached: the terminal path still reads
             # .stats (degrade accounting) and frees sequences through it

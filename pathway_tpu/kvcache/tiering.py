@@ -35,9 +35,6 @@ import threading
 import time
 from collections import OrderedDict
 
-import jax.numpy as jnp
-import numpy as np
-
 
 def _make_tier_programs():
     try:
@@ -74,14 +71,19 @@ def _pad_width(nb: int) -> int:
 
 
 class _SessionEntry:
-    __slots__ = ("session_id", "tokens", "k", "v", "nbytes", "t_suspend")
+    __slots__ = ("session_id", "tokens", "payload", "nbytes", "t_suspend")
 
-    def __init__(self, session_id, tokens, k, v):
+    def __init__(self, session_id, tokens, payload, nbytes):
         self.session_id = session_id
-        self.tokens = tokens  # the context tokens the stored K/V covers
-        self.k = k  # host np array [L, nb, bs, H, hd]
-        self.v = v
-        self.nbytes = int(k.nbytes) + int(v.nbytes)
+        self.tokens = tokens  # the context tokens the stored state covers
+        # backend-opaque host state (paged: padded K/V block gathers;
+        # state backend: one fixed-size recurrent-state array)
+        self.payload = payload
+        # the REAL host buffer size, padding included — Round-16 fix:
+        # charging the logical block bytes of a padded gather's view
+        # under-counted the budget by up to 2x (the view's base buffer
+        # holds the power-of-two width either way)
+        self.nbytes = int(nbytes)
         self.t_suspend = time.perf_counter()
 
 
@@ -168,28 +170,19 @@ class SessionStore:
             return None
 
     def suspend(self, session_id, pool, seq_id, context_tokens) -> int:
-        """Copy the sequence's context blocks to host RAM and free them
-        from the pool.  ``context_tokens`` are the tokens whose K/V the
-        allocation actually holds (admitted + fed-back emitted); blocks
-        past their span — chain pre-extension garbage — are NOT copied.
-        Returns the number of context tokens stored (0 = nothing worth
-        storing; the sequence is freed either way)."""
+        """Copy the sequence's decode state to host RAM and free its
+        device allocation, through the backend contract
+        (``CacheBackend.suspend_host``).  ``context_tokens`` are the
+        tokens the state actually covers (admitted + fed-back emitted);
+        for the paged backend blocks past their span — chain
+        pre-extension garbage — are NOT copied.  Returns the number of
+        context tokens stored (0 = nothing worth storing; the sequence
+        is freed either way)."""
         tokens = [int(t) for t in context_tokens]
-        bs = pool.block_size
-        nb = -(-len(tokens) // bs)
-        if nb == 0:
-            pool.free_sequence(seq_id)
+        payload, nbytes = pool.suspend_host(seq_id, tokens)
+        if payload is None:
             return 0
-        seq = pool.sequence(seq_id)
-        blocks = seq.block_ids[:nb]
-        pad = _pad_width(nb)
-        padded = np.zeros(pad, np.int32)
-        padded[:nb] = blocks
-        idx = jnp.asarray(padded)
-        k_host = np.asarray(_tier_gather(pool.k, idx))[:, :nb]
-        v_host = np.asarray(_tier_gather(pool.v, idx))[:, :nb]
-        pool.free_sequence(seq_id)
-        ent = _SessionEntry(session_id, tokens, k_host, v_host)
+        ent = _SessionEntry(session_id, tokens, payload, nbytes)
         with self._lock:
             old = self._sessions.pop(session_id, None)
             if old is not None:
@@ -201,23 +194,13 @@ class SessionStore:
         return len(tokens)
 
     def resume_into(self, pool, entry, block_ids) -> int:
-        """Re-scatter a suspended session's K/V into the freshly
+        """Scatter a suspended session's state into the freshly
         allocated ``block_ids`` (the engine allocated for the FULL new
-        prompt, which the stored context prefixes).  Returns the number
-        of resident tokens — the engine's ``n_diverted``."""
+        prompt, which the stored context prefixes), through
+        ``CacheBackend.resume_host``.  Returns the number of resident
+        tokens — the engine's ``n_diverted``."""
         t0 = time.perf_counter()
-        nb = int(entry.k.shape[1])
-        pad = _pad_width(nb)
-        padded_bt = np.zeros(pad, np.int32)
-        padded_bt[:nb] = list(block_ids)[:nb]
-        shape = entry.k.shape
-        hk = np.zeros((shape[0], pad) + shape[2:], entry.k.dtype)
-        hv = np.zeros_like(hk)
-        hk[:, :nb] = entry.k
-        hv[:, :nb] = entry.v
-        idx = jnp.asarray(padded_bt)
-        pool.k = _tier_scatter(pool.k, idx, jnp.asarray(hk))
-        pool.v = _tier_scatter(pool.v, idx, jnp.asarray(hv))
+        pool.resume_host(entry.payload, block_ids)
         ms = (time.perf_counter() - t0) * 1e3
         with self._lock:
             self.n_resumes += 1

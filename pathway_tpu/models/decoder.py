@@ -920,6 +920,452 @@ def paged_prefill_sampled_tp(params: dict, cfg: DecoderConfig, mesh,
     )
 
 
+# -- SSD / gated linear-attention decoder (Round-16) -------------------------
+#
+# A second model family whose per-sequence decode state is a FIXED-SIZE
+# tensor instead of a growing KV span ("Compiler-First State Space
+# Duality and Portable O(1) Autoregressive Caching", arxiv 2603.09555).
+# Each attention block is replaced by a gated linear-attention / SSD
+# recurrence over per-head matrix states S in R^{hd x hd}:
+#
+#     a_t = exp(-softplus(x_t @ w_a + b_a))        per-head decay in (0,1)
+#     S_t = a_t * S_{t-1} + k_t^T v_t
+#     y_t = (q_t / sqrt(hd)) . S_t
+#
+# The SAME math runs in two forms — the state-space duality:
+#
+# - CHUNK-PARALLEL (prefill): a C-token chunk computes all its outputs
+#   with masked matmuls over cumulative log-decays plus one inter-chunk
+#   term against the carried state, then folds the chunk into the state
+#   in closed form.  Prompts stream through fixed-width chunks exactly
+#   like the paged engine's chunked prefill.
+# - RECURRENT (decode): one token updates the state in O(hd^2) per head
+#   — constant memory, constant latency, no context-length term at all.
+#
+# Everything else — embeddings, layer norms, Megatron column/row
+# projections with one psum, the two-stage argmax vocab head, the
+# (seed, emit-index) sampling key schedule — is shared with the paged
+# path, so tp sharding and token-identity guarantees carry over.  The
+# SSD path uses NO positional embedding: order is encoded by the decay
+# recurrence itself, which is what makes the state a complete,
+# fixed-size summary (suspend/resume copies ONE array per layer).
+#
+# The recurrent state is stored in a stacked per-shard array
+# [n_layers, max_slots, n_heads(/tp), hd, hd] managed by
+# kvcache/statecache.py; slot 0 is the designated garbage sink for
+# padding rows, mirroring the paged pool's null block.
+
+
+def ssd_augment_params(params: dict, cfg: DecoderConfig,
+                       seed: int = 0) -> dict:
+    """Graft per-layer SSD decay projections (``w_a``: (D, H), ``b_a``:
+    (H,)) onto an existing dense decoder pytree — every other weight
+    (embed, QKV, output/FFN projections, layer norms) is reused as-is,
+    so one checkpoint serves both the paged-attention and SSD engines.
+    ``b_a`` spreads head decay rates from slow (~0.95/token) to fast
+    (~0.27/token); ``w_a`` adds small input-dependent gating."""
+    rng = jax.random.PRNGKey(seed)
+    D, H = cfg.d_model, cfg.n_heads
+    out = dict(params)
+    layers = []
+    for layer in params["layers"]:
+        rng, sub = jax.random.split(rng)
+        new = dict(layer)
+        new["w_a"] = (0.02 * jax.random.normal(sub, (D, H))).astype(
+            jnp.float32
+        )
+        new["b_a"] = jnp.linspace(-3.0, 1.0, H, dtype=jnp.float32)
+        layers.append(new)
+    out["layers"] = layers
+    return out
+
+
+def _ssd_decay(layer, h, valid=None):
+    """Per-head log decay ``log a = -softplus(h @ w_a + b_a)`` <= 0.
+    ``valid`` masks padding tokens to log a = 0 (a = 1): an invalid
+    token neither decays nor feeds the state, so a partially filled
+    tail chunk folds exactly like its valid prefix alone."""
+    la = -jax.nn.softplus(
+        h @ layer["w_a"].astype(h.dtype) + layer["b_a"].astype(h.dtype)
+    )
+    if valid is not None:
+        la = la * valid[..., None].astype(la.dtype)
+    return la
+
+
+def _ssd_layer_chunk(layer, h, s0, hd: int, valid):
+    """Chunk-parallel (duality) form over one C-token chunk.
+
+    h: (B, C, D) post-ln stream; s0: (B, H, hd, hd) carried state;
+    valid: (B, C) bool.  Returns ``(y, s1)`` with y (B, C, H, hd).
+
+    Intra-chunk outputs use the masked decay matrix
+    ``W[t, s] = exp(L_t - L_s)`` (s <= t, L the inclusive cumulative
+    log decay); the carried state contributes ``exp(L_t) * q_t . s0``;
+    the chunk folds into ``s1 = exp(L_C) s0 + sum_s exp(L_C - L_s)
+    k_s^T v_s``.  Padding tokens carry log a = 0 and k = 0, so they are
+    exact no-ops on both outputs and state."""
+    from .encoder import _proj
+
+    B, C, _D = h.shape
+    q = _proj(layer, h, "wq", "bq").reshape(B, C, -1, hd) / np.sqrt(hd)
+    k = _proj(layer, h, "wk", "bk").reshape(B, C, -1, hd)
+    v = _proj(layer, h, "wv", "bv").reshape(B, C, -1, hd)
+    k = jnp.where(valid[:, :, None, None], k, 0)
+    la = _ssd_decay(layer, h, valid)           # (B, C, H)
+    lc = jnp.cumsum(la, axis=1)                # inclusive: L_t
+    dec = lc[:, :, None, :] - lc[:, None, :, :]  # (B, t, s, H)
+    causal = jnp.tril(jnp.ones((C, C), bool))
+    w = jnp.where(causal[None, :, :, None], jnp.exp(dec), 0).astype(h.dtype)
+    att = jnp.einsum("bthd,bshd->btsh", q, k)
+    y = jnp.einsum("btsh,bshd->bthd", att * w, v)
+    y = y + jnp.exp(lc)[..., None].astype(h.dtype) * jnp.einsum(
+        "bthd,bhde->bthe", q, s0
+    )
+    w_fold = jnp.exp(lc[:, -1:, :] - lc).astype(h.dtype)  # (B, C, H)
+    s1 = jnp.exp(lc[:, -1])[..., None, None].astype(h.dtype) * s0 \
+        + jnp.einsum("bsh,bshd,bshe->bhde", w_fold, k, v)
+    return y, s1
+
+
+def _ssd_layer_step(layer, h, s0, hd: int):
+    """Recurrent form: one token, O(hd^2) per head, no context term.
+    h: (B, D); s0: (B, H, hd, hd).  Returns ``(y, s1)``, y (B, H, hd).
+    Equals the C=1 chunk form exactly (same einsums, no mask)."""
+    from .encoder import _proj
+
+    B = h.shape[0]
+    q = _proj(layer, h, "wq", "bq").reshape(B, -1, hd) / np.sqrt(hd)
+    k = _proj(layer, h, "wk", "bk").reshape(B, -1, hd)
+    v = _proj(layer, h, "wv", "bv").reshape(B, -1, hd)
+    a = jnp.exp(_ssd_decay(layer, h))          # (B, H)
+    s1 = a[..., None, None].astype(h.dtype) * s0 \
+        + jnp.einsum("bhd,bhe->bhde", k, v)
+    y = jnp.einsum("bhd,bhde->bhe", q, s1)
+    return y, s1
+
+
+def _ssd_forward_step(params: dict, cfg: DecoderConfig, s, token,
+                      tp_axis, head_fn):
+    """One recurrent token through every layer.  ``s``: the gathered
+    per-row state stack (L, B, H[/tp], hd, hd) — device-resident carry
+    in the chained scan.  Returns ``(out, s_new)``."""
+    dtype = _resolve_dtype(cfg.dtype)
+    from .encoder import _proj
+
+    B = token.shape[0]
+    hd = cfg.d_model // cfg.n_heads
+    eps = cfg.ln_eps
+    act = _act_fn(cfg)
+    x = _embed_rows(params["embed"].astype(dtype), token, tp_axis)  # (B, D)
+    new = []
+    for li, layer in enumerate(params["layers"]):
+        h = _layer_norm(x, layer["ln1_scale"], layer["ln1_bias"], eps)
+        y, s1 = _ssd_layer_step(layer, h, s[li], hd)
+        x = x + _row_proj(layer, y.reshape(B, -1), "wo", "bo", tp_axis)
+        h = _layer_norm(x, layer["ln2_scale"], layer["ln2_bias"], eps)
+        ff = act(_proj(layer, h, "w_up", "b_up"))
+        x = x + _row_proj(layer, ff, "w_down", "b_down", tp_axis)
+        new.append(s1)
+    x = _layer_norm(x, params["ln_f_scale"], params["ln_f_bias"], eps)
+    out = (_head_out if head_fn is None else head_fn)(
+        params["embed"], x, tp_axis
+    )
+    return out, jnp.stack(new)
+
+
+def ssd_mixed_step(params: dict, cfg: DecoderConfig, state: jax.Array,
+                   tokens: jax.Array, n_valid: jax.Array,
+                   row_slots: jax.Array, *, tp_axis: str | None = None,
+                   head_fn=None):
+    """One chunk-parallel SSD step over a batch of token RUNS — the
+    state engine's mixed prefill+decode program (chunked prefill
+    streams through the same per-round token budget as the paged
+    engine's ragged step; a decode row is simply a run of one token).
+
+    tokens: (B, C) int32 — each row's next C tokens, zero-padded;
+    n_valid: (B,) int32 — valid tokens per row (0 = idle padding row:
+    an exact no-op on its slot); row_slots: (B,) int32 slot ids in the
+    stacked state array (idle rows point at the null slot 0);
+    state: (L, S, H[/tp], hd, hd), donated.
+    Returns ``(out, state)`` — out is the next-token result at each
+    row's LAST valid token: (B, V) f32 logits single-device, (B,)
+    int32 greedily sampled ids under ``tp_axis`` (:func:`_head_out`),
+    or ``head_fn``'s result."""
+    dtype = _resolve_dtype(cfg.dtype)
+    from .encoder import _proj
+
+    B, C = tokens.shape
+    hd = cfg.d_model // cfg.n_heads
+    eps = cfg.ln_eps
+    act = _act_fn(cfg)
+    valid = jnp.arange(C, dtype=jnp.int32)[None, :] < n_valid[:, None]
+    x = _embed_rows(params["embed"].astype(dtype), tokens, tp_axis)
+    new = []
+    for li, layer in enumerate(params["layers"]):
+        s0 = state[li, row_slots]               # (B, H, hd, hd)
+        h = _layer_norm(x, layer["ln1_scale"], layer["ln1_bias"], eps)
+        y, s1 = _ssd_layer_chunk(layer, h, s0, hd, valid)
+        x = x + _row_proj(layer, y.reshape(B, C, -1), "wo", "bo", tp_axis)
+        h = _layer_norm(x, layer["ln2_scale"], layer["ln2_bias"], eps)
+        ff = act(_proj(layer, h, "w_up", "b_up"))
+        x = x + _row_proj(layer, ff, "w_down", "b_down", tp_axis)
+        new.append(s1)
+    # duplicate null-slot targets among idle rows are a benign race:
+    # slot 0 is the designated garbage sink, like the pool's block 0
+    state = state.at[:, row_slots].set(jnp.stack(new))
+    x = _layer_norm(x, params["ln_f_scale"], params["ln_f_bias"], eps)
+    sel = jnp.take_along_axis(
+        x, jnp.maximum(n_valid - 1, 0)[:, None, None].astype(jnp.int32),
+        axis=1,
+    )[:, 0]
+    out = (_head_out if head_fn is None else head_fn)(
+        params["embed"], sel, tp_axis
+    )
+    return out, state
+
+
+def ssd_decode_step(params: dict, cfg: DecoderConfig, state: jax.Array,
+                    token: jax.Array, row_slots: jax.Array, *,
+                    tp_axis: str | None = None, head_fn=None):
+    """One batched recurrent decode token: gather each row's fixed-size
+    state, update, scatter back.  token/row_slots: (B,) int32; state
+    donated.  Returns ``(out, state)`` (out as in
+    :func:`ssd_mixed_step`)."""
+    s = state[:, row_slots]                     # (L, B, H, hd, hd)
+    out, s = _ssd_forward_step(params, cfg, s, token, tp_axis, head_fn)
+    return out, state.at[:, row_slots].set(s)
+
+
+def ssd_chained_decode(params: dict, cfg: DecoderConfig, state: jax.Array,
+                       token: jax.Array, row_slots: jax.Array,
+                       steps: jax.Array, rem: jax.Array,
+                       stop_tok: jax.Array, *,
+                       tp_axis: str | None = None):
+    """K greedy recurrent steps in ONE device program: the per-row
+    state stack rides the ``lax.scan`` carry next to the sampled ids,
+    gathered once before and scattered once after the chain — zero host
+    round trips in between, and (unlike the paged chain) zero slot
+    bookkeeping: the state neither grows nor moves.
+
+    ``steps``: (K,) int32 arange (its length is the chain length);
+    ``rem``: (B,) int32 per-row step budget; ``stop_tok``: () int32 EOS
+    id (-1 for none).  A row past its budget or EOS FREEZES in-scan:
+    its state stops updating and its id repeats — the paged chain's
+    surplus steps land in the null block, but a recurrent state has no
+    null to absorb them, so the mask is what keeps a finished row's
+    state equal to context + emitted[:-1] (the suspend-coverage rule).
+    Host-side truncation of the returned (B, K) ids is unchanged."""
+    s = state[:, row_slots]
+    B = token.shape[0]
+
+    def body(carry, t):
+        tok, s, nprod, stopped = carry
+        out, s_new = _ssd_forward_step(params, cfg, s, tok, tp_axis, None)
+        ids = out if tp_axis is not None \
+            else jnp.argmax(out, axis=-1).astype(jnp.int32)
+        active = jnp.logical_and(~stopped, nprod < rem)
+        s = jnp.where(active[None, :, None, None, None], s_new, s)
+        ids = jnp.where(active, ids, tok)
+        nprod = nprod + active.astype(jnp.int32)
+        stopped = jnp.logical_or(stopped, active & (ids == stop_tok))
+        return (ids, s, nprod, stopped), ids
+
+    init = (token.astype(jnp.int32), s, jnp.zeros(B, jnp.int32),
+            jnp.zeros(B, bool))
+    (_last, s, _np, _st), ids = jax.lax.scan(body, init, steps)
+    return ids.T, state.at[:, row_slots].set(s)
+
+
+def ssd_mixed_step_sampled(params: dict, cfg: DecoderConfig,
+                           state: jax.Array, tokens: jax.Array,
+                           n_valid: jax.Array, row_slots: jax.Array,
+                           temperature: jax.Array, top_k: jax.Array,
+                           top_p: jax.Array, seed: jax.Array,
+                           emit_idx: jax.Array, *,
+                           tp_axis: str | None = None):
+    """:func:`ssd_mixed_step` with per-row sampling (the same
+    (seed, emit-index) key schedule as the paged programs, so restart /
+    failover replay is bit-identical).  Returns ``(ids, state)``."""
+    head = _sampling_head(temperature, top_k, top_p,
+                          _row_sample_keys(seed, emit_idx))
+    return ssd_mixed_step(
+        params, cfg, state, tokens, n_valid, row_slots,
+        tp_axis=tp_axis, head_fn=head,
+    )
+
+
+def ssd_decode_step_sampled(params: dict, cfg: DecoderConfig,
+                            state: jax.Array, token: jax.Array,
+                            row_slots: jax.Array, temperature: jax.Array,
+                            top_k: jax.Array, top_p: jax.Array,
+                            seed: jax.Array, emit_idx: jax.Array, *,
+                            tp_axis: str | None = None):
+    """:func:`ssd_decode_step` with per-row sampling."""
+    head = _sampling_head(temperature, top_k, top_p,
+                          _row_sample_keys(seed, emit_idx))
+    return ssd_decode_step(
+        params, cfg, state, token, row_slots, tp_axis=tp_axis, head_fn=head,
+    )
+
+
+def ssd_chained_decode_sampled(params: dict, cfg: DecoderConfig,
+                               state: jax.Array, token: jax.Array,
+                               row_slots: jax.Array, steps: jax.Array,
+                               rem: jax.Array, stop_tok: jax.Array,
+                               temperature: jax.Array, top_k: jax.Array,
+                               top_p: jax.Array, seed: jax.Array,
+                               emit0: jax.Array, *,
+                               tp_axis: str | None = None):
+    """:func:`ssd_chained_decode` with sampling carried through the
+    scan — base keys ride the carry, step t folds ``emit0 + t``,
+    exactly the paged chained schedule (a row's active steps are a
+    prefix of the chain, so step index == tokens produced and the key
+    schedule matches K single sampled steps bit-for-bit)."""
+    s = state[:, row_slots]
+    B = token.shape[0]
+    base_keys = jax.vmap(
+        lambda sd: jax.random.fold_in(jax.random.PRNGKey(0), sd)
+    )(seed)
+
+    def body(carry, t):
+        tok, s, keys, nprod, stopped = carry
+        step_keys = jax.vmap(jax.random.fold_in)(keys, emit0 + t)
+        head = _sampling_head(temperature, top_k, top_p, step_keys)
+        ids, s_new = _ssd_forward_step(params, cfg, s, tok, tp_axis, head)
+        active = jnp.logical_and(~stopped, nprod < rem)
+        s = jnp.where(active[None, :, None, None, None], s_new, s)
+        ids = jnp.where(active, ids, tok)
+        nprod = nprod + active.astype(jnp.int32)
+        stopped = jnp.logical_or(stopped, active & (ids == stop_tok))
+        return (ids, s, keys, nprod, stopped), ids
+
+    init = (token.astype(jnp.int32), s, base_keys,
+            jnp.zeros(B, jnp.int32), jnp.zeros(B, bool))
+    (_last, s, _k, _np, _st), ids = jax.lax.scan(body, init, steps)
+    return ids.T, state.at[:, row_slots].set(s)
+
+
+def _tp_shard_map_ssd(fn, mesh, params, n_rep: int):
+    """shard_map an SSD step: params by decoder rules (w_a/b_a shard
+    with the heads), ONE state array on its head axis, ``n_rep``
+    replicated host-built arrays; outputs (replicated ids, sharded
+    state)."""
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel.mesh import SSD_STATE_PSPEC, decoder_param_specs
+
+    return shard_map(
+        fn, mesh=mesh,
+        in_specs=(decoder_param_specs(params), SSD_STATE_PSPEC)
+        + (P(),) * n_rep,
+        out_specs=(P(), SSD_STATE_PSPEC),
+        check_rep=False,
+    )
+
+
+def ssd_mixed_step_tp(params: dict, cfg: DecoderConfig, mesh,
+                      state: jax.Array, tokens: jax.Array,
+                      n_valid: jax.Array, row_slots: jax.Array):
+    """:func:`ssd_mixed_step` sharded over ``mesh``'s tp axis: each
+    shard runs its n_heads/tp heads' recurrence on its slice of the
+    state array; the collective set per layer is identical to the paged
+    path (one psum per row-parallel projection, two-stage argmax
+    head)."""
+
+    def fn(p, state, tokens, n_valid, row_slots):
+        return ssd_mixed_step(
+            p, cfg, state, tokens, n_valid, row_slots, tp_axis="tp"
+        )
+
+    return _tp_shard_map_ssd(fn, mesh, params, 3)(
+        params, state, tokens, n_valid, row_slots
+    )
+
+
+def ssd_decode_step_tp(params: dict, cfg: DecoderConfig, mesh,
+                       state: jax.Array, token: jax.Array,
+                       row_slots: jax.Array):
+    """:func:`ssd_decode_step` over the tp mesh."""
+
+    def fn(p, state, token, row_slots):
+        return ssd_decode_step(p, cfg, state, token, row_slots,
+                               tp_axis="tp")
+
+    return _tp_shard_map_ssd(fn, mesh, params, 2)(
+        params, state, token, row_slots
+    )
+
+
+def ssd_chained_decode_tp(params: dict, cfg: DecoderConfig, mesh,
+                          state: jax.Array, token: jax.Array,
+                          row_slots: jax.Array, steps: jax.Array,
+                          rem: jax.Array, stop_tok: jax.Array):
+    """:func:`ssd_chained_decode` over the tp mesh — the replicated
+    (B,) ids are the scan carry on every shard, like the paged chain."""
+
+    def fn(p, state, *rest):
+        return ssd_chained_decode(p, cfg, state, *rest, tp_axis="tp")
+
+    return _tp_shard_map_ssd(fn, mesh, params, 5)(
+        params, state, token, row_slots, steps, rem, stop_tok
+    )
+
+
+def ssd_mixed_step_sampled_tp(params: dict, cfg: DecoderConfig, mesh,
+                              state: jax.Array, tokens: jax.Array,
+                              n_valid: jax.Array, row_slots: jax.Array,
+                              temperature: jax.Array, top_k: jax.Array,
+                              top_p: jax.Array, seed: jax.Array,
+                              emit_idx: jax.Array):
+    """:func:`ssd_mixed_step_sampled` over the tp mesh."""
+
+    def fn(p, state, *rest):
+        return ssd_mixed_step_sampled(p, cfg, state, *rest, tp_axis="tp")
+
+    return _tp_shard_map_ssd(fn, mesh, params, 8)(
+        params, state, tokens, n_valid, row_slots, temperature, top_k,
+        top_p, seed, emit_idx,
+    )
+
+
+def ssd_decode_step_sampled_tp(params: dict, cfg: DecoderConfig, mesh,
+                               state: jax.Array, token: jax.Array,
+                               row_slots: jax.Array,
+                               temperature: jax.Array, top_k: jax.Array,
+                               top_p: jax.Array, seed: jax.Array,
+                               emit_idx: jax.Array):
+    """:func:`ssd_decode_step_sampled` over the tp mesh."""
+
+    def fn(p, state, *rest):
+        return ssd_decode_step_sampled(p, cfg, state, *rest, tp_axis="tp")
+
+    return _tp_shard_map_ssd(fn, mesh, params, 7)(
+        params, state, token, row_slots, temperature, top_k, top_p, seed,
+        emit_idx,
+    )
+
+
+def ssd_chained_decode_sampled_tp(params: dict, cfg: DecoderConfig, mesh,
+                                  state: jax.Array, token: jax.Array,
+                                  row_slots: jax.Array, steps: jax.Array,
+                                  rem: jax.Array, stop_tok: jax.Array,
+                                  temperature: jax.Array,
+                                  top_k: jax.Array, top_p: jax.Array,
+                                  seed: jax.Array, emit0: jax.Array):
+    """:func:`ssd_chained_decode_sampled` over the tp mesh."""
+
+    def fn(p, state, *rest):
+        return ssd_chained_decode_sampled(p, cfg, state, *rest,
+                                          tp_axis="tp")
+
+    return _tp_shard_map_ssd(fn, mesh, params, 10)(
+        params, state, token, row_slots, steps, rem, stop_tok,
+        temperature, top_k, top_p, seed, emit0,
+    )
+
+
 def generate_tokens_fused(params: dict, cfg: DecoderConfig,
                           token_ids: jax.Array, n_valid: jax.Array,
                           max_new: int, stop_token: int | None):

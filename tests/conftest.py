@@ -32,6 +32,64 @@ def pytest_configure(config):
     )
 
 
+# -- tier-1 skip budget (Round-16) -------------------------------------------
+# The tier-1 seed run skips exactly 12 tests, each for one of the
+# REVIEWED reasons below.  Skips are where coverage quietly erodes: a
+# refactor that starts skipping a suite ("import failed -> skip") reads
+# as green.  This guard fails the run when a skip fires whose reason
+# matches none of the reviewed strings — adding a new skip means adding
+# its reason here, in the same diff, where review sees it.
+_REVIEWED_SKIP_REASONS = (
+    # test_aws_sharepoint_bq: verify-side dependency absent from the image
+    "cryptography not installed",
+    # test_compiled_query: inductor compile is ~20s; opt-in
+    "inductor compile is ~20s",
+    # test_dataplane: the jax tier targets accelerator backends
+    "jax tier declines on this CPU-only build",
+    # test_e2e_rag x2 + test_obs timing guard: wall-clock-paced tests on
+    # oversubscribed container hosts
+    "flaky under container CPU contention",
+    # test_parallel x6: the baked jax build predates top-level shard_map
+    "this jax build has no top-level jax.shard_map",
+)
+_BASELINE_SKIP_COUNT = 12
+_observed_skips: list[tuple[str, str]] = []
+
+
+def pytest_runtest_logreport(report):
+    if not report.skipped or getattr(report, "wasxfail", None):
+        return
+    if isinstance(report.longrepr, tuple):
+        reason = report.longrepr[2]
+    else:  # pragma: no cover - non-tuple skip reprs are rare
+        reason = str(report.longrepr)
+    _observed_skips.append((report.nodeid, reason))
+
+
+def pytest_sessionfinish(session, exitstatus):
+    rogue = [
+        (nodeid, reason) for nodeid, reason in _observed_skips
+        if not any(r in reason for r in _REVIEWED_SKIP_REASONS)
+    ]
+    if rogue:
+        tr = session.config.pluginmanager.getplugin("terminalreporter")
+        lines = [
+            "tier-1 skip guard: %d skip(s) with no reviewed reason "
+            "(baseline: %d reviewed skips).  A new skip must add its "
+            "reason string to _REVIEWED_SKIP_REASONS in tests/conftest.py:"
+            % (len(rogue), _BASELINE_SKIP_COUNT)
+        ] + [f"  {nodeid}: {reason}" for nodeid, reason in rogue]
+        msg = "\n".join(lines)
+        if tr is not None:
+            tr.write_line(msg, red=True)
+        else:  # pragma: no cover - no terminal plugin
+            print(msg)
+        # pytest.exit from sessionfinish is the supported way to force
+        # the process exit code (wrap_session catches it and adopts
+        # returncode; assigning session.exitstatus here is overwritten)
+        pytest.exit("tier-1 skip guard failed", returncode=1)
+
+
 @pytest.fixture(autouse=True)
 def clear_parse_graph():
     """Reference parity: autouse fixture clears the global ParseGraph after

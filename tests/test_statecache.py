@@ -1,0 +1,582 @@
+"""Constant-memory decode (Round-16) — ISSUE 17 acceptance.
+
+Pins the tentpole guarantees of the SSD/linear-attention serving tier
+(`pathway_tpu.kvcache.statecache`) behind the extracted cache-backend
+contract (`pathway_tpu.kvcache.backend`):
+
+- chunk-parallel prefill is IDENTICAL to the token-by-token recurrence —
+  final state and logits at the primitive level, greedy tokens at the
+  engine level — across mixed lengths and partial tail chunks;
+- fixed-seed sampled output is bit-identical across session
+  suspend/resume, supervised engine restart, and cross-replica fleet
+  failover (the SSD tier rides the existing recovery planes unchanged);
+- the slot allocator upholds its bitmap-conservation invariants under
+  randomized allocate/free/suspend/resume traffic, and capacity errors
+  leave no partial side effects;
+- tp=8 on the tier-1 virtual mesh is token-identical to tp=1, with the
+  state array GENUINELY sharded on the head axis;
+- the SSD step-program set compiles once: a second identical workload
+  triggers zero recompiles (CompileWatch, registry + backend counter);
+- the paged backend still passes its identity contract THROUGH
+  ``make_backend`` (the engine builds its pool via the seam), and the
+  SessionStore charges real host buffer bytes for both backends —
+  power-of-two padded for paged gathers, exact constant for state;
+- the constant-memory capacity headline: at one fixed HBM budget the
+  state backend holds >= 4x the live 128-token sessions of the paged
+  pool (the hbm_plan-computed floor bench.py commits as
+  ``ssd.live_sessions_at_fixed_hbm_vs_paged``).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pathway_tpu import faults
+from pathway_tpu.kvcache import (
+    CacheBackend, PagedDecodeEngine, PoolExhausted, SessionStore,
+    StateCache, StateDecodeEngine, UnsupportedCacheOp, make_backend,
+)
+from pathway_tpu.kvcache.block_pool import BlockPool
+from pathway_tpu.models.decoder import (
+    DecoderConfig, _ssd_forward_step, init_decoder_params,
+    ssd_augment_params, ssd_mixed_step,
+)
+
+from .utils import CompileWatch
+
+# 8 KV heads / 64 vocab: tp=8 divides both on the virtual 8-device mesh
+_CFG = DecoderConfig(
+    vocab_size=64, d_model=64, n_layers=2, n_heads=8, d_ff=128, max_len=128
+)
+_HD = _CFG.d_model // _CFG.n_heads
+
+
+@pytest.fixture(scope="module")
+def params():
+    # grafted once: engines detect the ssd mixing params and reuse them,
+    # so the oracle and every engine share one checkpoint
+    return ssd_augment_params(
+        init_decoder_params(_CFG, jax.random.PRNGKey(0)), _CFG
+    )
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+def _engine(params, name, **kw):
+    kw.setdefault("max_slots", 24)
+    kw.setdefault("max_batch_size", 4)
+    kw.setdefault("prefill_chunk", 8)
+    kw.setdefault("chain_steps", 4)
+    return StateDecodeEngine(_CFG, params, name=name, **kw)
+
+
+def _ref_greedy(params, prompt, n_new, cfg=_CFG):
+    """Oracle: the pure token-by-token recurrence, one sequence, no
+    chunking, no engine."""
+    s = jnp.zeros((cfg.n_layers, 1, cfg.n_heads, _HD, _HD), jnp.float32)
+    logits = None
+    for t in prompt:
+        logits, s = _ssd_forward_step(
+            params, cfg, s, jnp.asarray([t], jnp.int32), None, None
+        )
+    out = []
+    for _ in range(n_new):
+        tok = int(np.argmax(np.asarray(logits[0])))
+        out.append(tok)
+        logits, s = _ssd_forward_step(
+            params, cfg, s, jnp.asarray([tok], jnp.int32), None, None
+        )
+    return out
+
+
+# -- chunk ≡ recurrent identity ----------------------------------------------
+
+
+def test_chunk_recurrent_primitive_identity(params):
+    # one prompt through ssd_mixed_step in chunks of 8 (with a partial
+    # tail chunk) vs the token-by-token recurrence: same final state,
+    # same last-token logits
+    rng = np.random.default_rng(3)
+    prompt = [int(t) for t in rng.integers(0, _CFG.vocab_size, size=21)]
+    C = 8
+    state = jnp.zeros(
+        (_CFG.n_layers, 4, _CFG.n_heads, _HD, _HD), jnp.float32
+    )
+    out = None
+    for i in range(0, len(prompt), C):
+        run = prompt[i:i + C]
+        tokens = np.zeros((1, C), np.int32)
+        tokens[0, :len(run)] = run
+        out, state = ssd_mixed_step(
+            params, _CFG, state, jnp.asarray(tokens),
+            jnp.asarray([len(run)], jnp.int32),
+            jnp.asarray([2], jnp.int32),
+        )
+    s = jnp.zeros((_CFG.n_layers, 1, _CFG.n_heads, _HD, _HD), jnp.float32)
+    ref = None
+    for t in prompt:
+        ref, s = _ssd_forward_step(
+            params, _CFG, s, jnp.asarray([t], jnp.int32), None, None
+        )
+    np.testing.assert_allclose(
+        np.asarray(state[:, 2]), np.asarray(s[:, 0]), atol=1e-5
+    )
+    np.testing.assert_allclose(
+        np.asarray(out[0]), np.asarray(ref[0]), atol=1e-4
+    )
+
+
+def test_engine_greedy_identity_mixed_lengths(params):
+    # lengths straddle chunk width 8: shorter-than-chunk, exact
+    # multiples, and partial tail chunks — all must match the pure
+    # recurrence token-for-token
+    rng = np.random.default_rng(7)
+    lengths = [3, 5, 8, 11, 16, 17, 27, 31]
+    prompts = [
+        [int(t) for t in rng.integers(0, _CFG.vocab_size, size=n)]
+        for n in lengths
+    ]
+    eng = _engine(params, "t_ssd_id")
+    got = eng.generate_batch([(list(p), 8) for p in prompts])
+    assert got == [_ref_greedy(params, p, 8) for p in prompts]
+
+
+def test_engine_greedy_identity_beyond_max_len(params):
+    # no per-sequence capacity cap: a prompt past cfg.max_len decodes
+    # fine (the recurrence has no positional table to exhaust)
+    rng = np.random.default_rng(11)
+    prompt = [int(t) for t in rng.integers(0, _CFG.vocab_size, size=200)]
+    eng = _engine(params, "t_ssd_long")
+    assert eng.generate(prompt, 4) == _ref_greedy(params, prompt, 4)
+
+
+# -- fixed-seed sampled identity across recovery planes ----------------------
+
+_SAMPLING = {"sampling": (0.8, 8, 0.95, 1234)}
+
+
+def test_sampled_identity_across_suspend_resume(params):
+    rng = np.random.default_rng(13)
+    prompt = [int(t) for t in rng.integers(0, _CFG.vocab_size, size=12)]
+    # uninterrupted two-turn conversation, no session tier
+    clean = _engine(params, "t_ssd_sess_clean")
+    t1c = clean.generate_batch([(list(prompt), 8, dict(_SAMPLING))])[0]
+    ctx = prompt + t1c + [5]
+    t2c = clean.generate_batch([(list(ctx), 8, dict(_SAMPLING))])[0]
+    # tiered: turn 1 suspends on release, turn 2 resumes the state
+    store = SessionStore()
+    eng = _engine(params, "t_ssd_sess", session_store=store)
+    opts = dict(_SAMPLING, session="s-17")
+    t1 = eng.generate_batch([(list(prompt), 8, dict(opts))])[0]
+    t2 = eng.generate_batch([(list(prompt + t1 + [5]), 8, dict(opts))])[0]
+    assert t1 == t1c
+    assert t2 == t2c
+    st = store.stats()
+    assert st["resumes"] >= 1 and st["suspends"] >= 1
+    # the backend's own counters moved too (pathway_state_* family)
+    snap = eng.pool.state_stats.snapshot()
+    assert snap["suspends"] >= 1 and snap["resumes"] >= 1
+
+
+def test_sampled_identity_across_engine_restart(params):
+    rng = np.random.default_rng(17)
+    reqs = [
+        (
+            [int(t) for t in rng.integers(0, _CFG.vocab_size, size=6)],
+            10, dict(_SAMPLING),
+        )
+        for _ in range(3)
+    ]
+    clean = _engine(params, "t_ssd_restart_clean").generate_batch(
+        [(list(p), n, dict(o)) for p, n, o in reqs]
+    )
+    eng = _engine(params, "t_ssd_restart", max_restarts=1,
+                  watchdog_timeout_s=120.0)
+    faults.install("engine.dispatch.chain", "raise", nth=2)
+    got = eng.generate_batch([(list(p), n, dict(o)) for p, n, o in reqs])
+    faults.clear()
+    assert got == clean
+    assert eng.pool.stats.engine_restarts >= 1
+    eng.pool.check_invariants()
+
+
+def test_sampled_identity_across_fleet_failover(params):
+    import threading
+
+    from pathway_tpu.serve.fleet import ReplicaFleet
+
+    rng = np.random.default_rng(19)
+    prompts = [
+        [int(t) for t in rng.integers(0, _CFG.vocab_size, size=5)]
+        for _ in range(4)
+    ]
+    clean = _engine(params, "t_ssd_fo_clean").generate_batch(
+        [(list(p), 10, dict(_SAMPLING)) for p in prompts]
+    )
+    fleet = ReplicaFleet(
+        _CFG, params, replicas=2, cache="state", name="t_ssd_fleet",
+        max_restarts=0, max_slots=24, max_batch_size=4, prefill_chunk=8,
+        chain_steps=4,
+    )
+    try:
+        assert all(
+            isinstance(r.engine, StateDecodeEngine) for r in fleet.replicas
+        )
+        faults.install("engine.dispatch.chain", "raise", nth=3)
+        results: list = [None] * len(prompts)
+
+        def _run(i):
+            results[i] = fleet.submit(
+                list(prompts[i]), 10, sampling=_SAMPLING["sampling"]
+            )
+
+        threads = [
+            threading.Thread(target=_run, args=(i,))
+            for i in range(len(prompts))
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=240)
+        faults.clear()
+        assert results == clean
+    finally:
+        fleet.shutdown(drain=False)
+
+
+# -- slot allocator fuzz vs invariants ---------------------------------------
+
+
+def test_slot_lifecycle_fuzz_invariants():
+    cache = StateCache(
+        max_slots=9, n_layers=2, n_heads=4, head_dim=8, name="t_fuzz"
+    )
+    rng = np.random.default_rng(23)
+    live: dict[int, list[int]] = {}
+    suspended: list[tuple[dict, int]] = []
+    next_id = 0
+    for step in range(300):
+        op = rng.integers(0, 4)
+        if op == 0:  # allocate
+            try:
+                st = cache.allocate(next_id, int(rng.integers(1, 40)))
+                live[next_id] = [int(t) for t in
+                                 rng.integers(0, 64, size=4)]
+                assert st.block_ids[0] != 0
+                next_id += 1
+            except PoolExhausted:
+                assert cache.num_free == 0
+        elif op == 1 and live:  # free
+            sid = int(rng.choice(list(live)))
+            cache.free_sequence(sid)
+            del live[sid]
+        elif op == 2 and live:  # suspend to host
+            sid = int(rng.choice(list(live)))
+            payload, nbytes = cache.suspend_host(sid, live.pop(sid))
+            assert payload is not None and nbytes == 2 * 4 * 8 * 8 * 4
+            suspended.append((payload, nbytes))
+        elif op == 3 and suspended:  # resume into a fresh slot
+            payload, _ = suspended.pop()
+            try:
+                st = cache.allocate(next_id, 4)
+            except PoolExhausted:
+                suspended.append((payload, 0))
+                continue
+            cache.resume_host(payload, st.block_ids)
+            live[next_id] = [1, 2, 3, 4]
+            next_id += 1
+        if step % 10 == 0:
+            cache.check_invariants()
+    cache.check_invariants()
+    # exhaustion leaves no partial side effects
+    for sid in list(live):
+        cache.free_sequence(sid)
+    for i in range(cache.max_slots - 1):
+        cache.allocate(10_000 + i, 1)
+    before = (cache.num_free, len(cache.sequences()))
+    with pytest.raises(PoolExhausted):
+        cache.allocate(99_999, 1)
+    assert (cache.num_free, len(cache.sequences())) == before
+    cache.check_invariants()
+
+
+def test_backend_contract_flags_and_unsupported_ops():
+    cache = StateCache(
+        max_slots=4, n_layers=2, n_heads=4, head_dim=8, name="t_contract"
+    )
+    assert isinstance(cache, CacheBackend)
+    assert cache.cache_kind == "state"
+    assert not cache.supports_fork
+    assert not cache.supports_prefix
+    assert not cache.supports_preemption
+    with pytest.raises(UnsupportedCacheOp):
+        cache.allocate(0, 4, shared_blocks=[(1, b"x")])
+    with pytest.raises(UnsupportedCacheOp):
+        cache.fork(0, 1)
+    with pytest.raises(UnsupportedCacheOp):
+        cache.preempt()
+    # growth is free: the fixed slot absorbs every decode step
+    st = cache.allocate(0, 4)
+    assert cache.extend_slots(0, 3) == [(st.block_ids[0], 0)] * 3
+    assert cache.sequence(0).n_tokens == 7
+    # per-seq bytes are a constant, independent of context length
+    assert cache.state_bytes_per_seq(1) == cache.state_bytes_per_seq(4096)
+
+
+def test_slot_reuse_starts_from_zero_state(params):
+    # the recurrence ACCUMULATES onto its slot, so a freed slot must be
+    # zeroed on reallocation — back-to-back batches on one engine are
+    # identical to fresh-engine output
+    rng = np.random.default_rng(29)
+    prompts = [
+        [int(t) for t in rng.integers(0, _CFG.vocab_size, size=7)]
+        for _ in range(3)
+    ]
+    eng = _engine(params, "t_ssd_reuse", max_slots=4)
+    first = eng.generate_batch([(list(p), 6) for p in prompts])
+    second = eng.generate_batch([(list(p), 6) for p in prompts])
+    assert first == second
+    assert second == [_ref_greedy(params, p, 6) for p in prompts]
+
+
+# -- tp=8 virtual-mesh identity ----------------------------------------------
+
+
+def test_tp8_identity_and_sharded_state(params):
+    rng = np.random.default_rng(31)
+    prompts = [
+        [int(t) for t in rng.integers(0, _CFG.vocab_size, size=n)]
+        for n in (3, 5, 11, 17)
+    ]
+    eng1 = _engine(params, "t_ssd_tp1")
+    eng8 = _engine(params, "t_ssd_tp8", tp=8)
+    # the state stack is GENUINELY sharded on the head axis
+    spec = tuple(eng8.pool.state.sharding.spec)
+    padded = spec + (None,) * (5 - len(spec))
+    assert padded == (None, None, "tp", None, None)
+    assert len(eng8.pool.state.sharding.device_set) == 8
+    assert (eng8.pool.state.addressable_shards[0].data.shape[2]
+            == _CFG.n_heads // 8)
+    got1 = eng1.generate_batch([(list(p), 8) for p in prompts])
+    got8 = eng8.generate_batch([(list(p), 8) for p in prompts])
+    assert got8 == got1
+    assert got1 == [_ref_greedy(params, p, 8) for p in prompts]
+    # sampled identity across the mesh too
+    s1 = eng1.generate_batch([(list(prompts[0]), 6, dict(_SAMPLING))])
+    s8 = eng8.generate_batch([(list(prompts[0]), 6, dict(_SAMPLING))])
+    assert s1 == s8
+
+
+# -- zero-recompile guard on the SSD step-program set ------------------------
+
+
+def test_ssd_second_pass_triggers_zero_recompiles(params):
+    rng = np.random.default_rng(37)
+    prompts = [
+        [int(t) for t in rng.integers(0, _CFG.vocab_size, size=n)]
+        for n in (3, 9, 14, 20)
+    ]
+
+    store = SessionStore()
+    eng = _engine(params, "t_ssd_watch", session_store=store)
+
+    def workload():
+        eng.generate_batch([(list(p), 6) for p in prompts])
+        eng.generate_batch(
+            [(list(prompts[0]), 6, dict(_SAMPLING))]
+        )
+        opts = {"session": "w-1"}
+        t1 = eng.generate_batch([(list(prompts[1]), 4, dict(opts))])[0]
+        eng.generate_batch(
+            [(list(prompts[1] + t1 + [2]), 4, dict(opts))]
+        )
+
+    watch = CompileWatch()
+    workload()  # cold: compiles the ssd step/sampled/suspend programs
+    assert watch.events(), "capture mechanism saw no compiles at all"
+    workload()  # warm: every program must be reused
+    watch.assert_no_compiles("second pass (ssd step-program set)")
+
+
+# -- the paged suite through the extracted backend seam ----------------------
+
+
+def test_paged_engine_builds_pool_through_make_backend(params):
+    eng = PagedDecodeEngine(
+        _CFG, params, num_blocks=64, block_size=4, max_batch_size=4,
+        seq_buckets=(16, 32, 64), prefill_chunk=8, name="t_seam_engine",
+    )
+    assert isinstance(eng.pool, CacheBackend)
+    assert isinstance(eng.pool, BlockPool)
+    assert eng.pool.cache_kind == "paged"
+
+
+def test_blockpool_parity_through_backend_interface(params):
+    # the SAME behavior whether BlockPool is constructed directly or
+    # through the make_backend seam: allocation layout, suspend payload
+    # bytes, invariants
+    kw = dict(num_blocks=32, block_size=4, n_layers=_CFG.n_layers,
+              n_heads=_CFG.n_heads, head_dim=_HD)
+    direct = BlockPool(name="t_seam_direct", **kw)
+    seamed = make_backend("paged", name="t_seam_made", **kw)
+    assert type(seamed) is BlockPool
+    for pool in (direct, seamed):
+        st = pool.allocate(0, 11)
+        assert len(st.block_ids) == pool.blocks_for(11)
+    assert (direct.sequence(0).block_ids
+            == seamed.sequence(0).block_ids)
+    p_direct, b_direct = direct.suspend_host(0, list(range(11)))
+    p_seamed, b_seamed = seamed.suspend_host(0, list(range(11)))
+    assert b_direct == b_seamed
+    np.testing.assert_array_equal(p_direct["k"], p_seamed["k"])
+    direct.check_invariants()
+    seamed.check_invariants()
+    with pytest.raises(ValueError, match="unknown cache backend"):
+        make_backend("bogus")
+
+
+def test_state_engine_restart_rebuilds_through_seam(params):
+    # supervised restart reconstructs the cache via make_backend("state")
+    # and recomputes survivors token-identically (greedy path)
+    rng = np.random.default_rng(41)
+    prompts = [
+        [int(t) for t in rng.integers(0, _CFG.vocab_size, size=6)]
+        for _ in range(3)
+    ]
+    clean = _engine(params, "t_ssd_seam_clean").generate_batch(
+        [(list(p), 8) for p in prompts]
+    )
+    eng = _engine(params, "t_ssd_seam", max_restarts=1,
+                  watchdog_timeout_s=120.0)
+    old_pool = eng.pool
+    faults.install("engine.dispatch.chain", "raise", nth=2)
+    got = eng.generate_batch([(list(p), 8) for p in prompts])
+    faults.clear()
+    assert got == clean
+    assert eng.pool is not old_pool
+    assert isinstance(eng.pool, StateCache)
+    eng.pool.check_invariants()
+
+
+# -- SessionStore charges real host bytes (both backends) --------------------
+
+
+def test_session_store_charges_real_buffer_bytes():
+    store = SessionStore()
+    # paged: 11 tokens -> 3 blocks, padded gather width 4 — the charge
+    # is the PADDED buffer (k + v), not the logical 3-block span
+    pool = BlockPool(num_blocks=32, block_size=4, n_layers=2, n_heads=4,
+                     head_dim=8, name="t_charge_paged")
+    pool.allocate(0, 11)
+    per_block = 2 * 4 * 4 * 8 * 4  # L * bs * H * hd * itemsize
+    store.suspend("pg", pool, 0, list(range(11)))
+    ent = store.match("pg", list(range(11)))
+    assert ent is not None
+    assert ent.nbytes == 2 * 4 * per_block  # k+v, padded 3 -> 4 blocks
+    assert ent.payload["k"].nbytes == 4 * per_block
+    # state: the charge is the exact constant state size, independent of
+    # context length (128 vs 2048 tokens: same bytes)
+    cache = StateCache(max_slots=8, n_layers=2, n_heads=4, head_dim=8,
+                      name="t_charge_state")
+    expect = 2 * 4 * 8 * 8 * 4  # L * H * hd * hd * itemsize
+    assert cache.state_bytes_per_seq(1) == expect
+    cache.allocate(1, 128)
+    store.suspend("st-short", cache, 1, list(range(128)))
+    cache.allocate(2, 2048)
+    store.suspend("st-long", cache, 2, list(range(2048)))
+    short = store.match("st-short", list(range(128)))
+    long = store.match("st-long", list(range(2048)))
+    assert short.nbytes == expect
+    assert long.nbytes == expect
+    assert short.payload["s"].nbytes == expect
+    assert store.host_bytes >= 2 * expect
+
+
+# -- capacity headline: >= 4x live sessions at fixed HBM ---------------------
+
+
+def test_constant_memory_capacity_floor(params):
+    from pathway_tpu.obs.memory import hbm_plan
+
+    budget = 64 * 1024 * 1024
+    session_tokens, block_size = 128, 4
+    paged_plan = hbm_plan(
+        _CFG, num_blocks=128, block_size=block_size, max_batch_size=8,
+        chain_steps=4, params=params, budget_bytes=budget,
+        reference_attn=False,
+    )
+    cache = StateCache(max_slots=8, n_layers=_CFG.n_layers,
+                       n_heads=_CFG.n_heads, head_dim=_HD, name="t_cap")
+    sbps = cache.state_bytes_per_seq(1)
+    state_plan = hbm_plan(
+        _CFG, num_blocks=8, block_size=block_size, max_batch_size=8,
+        chain_steps=4, params=params, budget_bytes=budget,
+        reference_attn=False, state_bytes_per_seq=sbps,
+    )
+    state_sessions = (
+        budget - state_plan.params_bytes - state_plan.temp_bytes
+    ) // sbps
+    blocks_per_session = -(-session_tokens // block_size)
+    paged_blocks = (
+        budget - paged_plan.params_bytes - paged_plan.temp_bytes
+    ) // max(paged_plan.per_block_bytes, 1)
+    paged_sessions = paged_blocks // blocks_per_session
+    assert paged_sessions > 0
+    ratio = state_sessions / paged_sessions
+    assert ratio >= 4.0, (
+        f"constant-memory headline regressed: {state_sessions} state vs "
+        f"{paged_sessions} paged sessions at {session_tokens} tokens "
+        f"({ratio:.1f}x < 4x floor)"
+    )
+    # the engine's own ledger carries the constant
+    eng = _engine(params, "t_cap_engine")
+    assert eng.hbm_plan.state_bytes_per_seq == sbps
+
+
+# -- metrics surface ---------------------------------------------------------
+
+
+def test_state_metrics_render_prometheus_and_otlp(params):
+    from pathway_tpu.serve import metrics
+
+    store = SessionStore()
+    eng = _engine(params, "t_ssd_metrics", session_store=store)
+    opts = {"session": "m-1"}
+    t1 = eng.generate_batch([([1, 2, 3], 4, dict(opts))])[0]
+    eng.generate_batch([([1, 2, 3] + t1 + [4], 4, dict(opts))])
+    import re
+
+    lines = metrics.render_prometheus_lines()
+    text = "\n".join(lines)
+    lbl = 'cache="t_ssd_metrics"'
+    assert f"pathway_state_slots_total{{{lbl}}}" in text
+    assert f"pathway_state_bytes_per_seq{{{lbl}}}" in text
+
+    def _gauge(name):
+        m = re.search(rf"{name}\{{{re.escape(lbl)}\}} (\d+)", text)
+        assert m, f"{name} line missing for {lbl}"
+        return int(m.group(1))
+
+    # turn 1 suspends on release; turn 2 resumes it, then suspends again
+    assert _gauge("pathway_state_suspends_total") == 2
+    assert _gauge("pathway_state_resumes_total") == 1
+    points = metrics.otlp_points("0")
+    state_points = [
+        p for p in points
+        if any(a["key"] == "cache"
+               and a["value"]["stringValue"] == "t_ssd_metrics"
+               for a in p["attributes"])
+    ]
+    counters = {
+        a["value"]["stringValue"]
+        for p in state_points for a in p["attributes"]
+        if a["key"] == "counter"
+    }
+    assert {"slots_in_use", "slots_total", "state_bytes_per_seq",
+            "suspends", "resumes"} <= counters
