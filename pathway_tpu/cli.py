@@ -197,6 +197,11 @@ def main(argv: list[str] | None = None) -> int:
     pp.add_argument("--json", action="store_true",
                     help="print the raw /debug/profile JSON instead of "
                          "the table")
+    pp.add_argument("--diff", metavar="BEFORE_JSON", default=None,
+                    help="diff the live snapshot against a saved "
+                         "/debug/profile JSON: per-program ms/MFU/share "
+                         "deltas, biggest mover first (the before/after "
+                         "view of a kernel-fusion or quantization change)")
 
     rp = sub.add_parser("run", help="run a YAML app template")
     rp.add_argument("template", help="path to app.yaml")
@@ -224,7 +229,7 @@ def main(argv: list[str] | None = None) -> int:
         return spawn_from_env()
     if args.command == "profile":
         return profile_command(args.url, memory=args.memory,
-                               as_json=args.json)
+                               as_json=args.json, diff=args.diff)
     if args.command == "run":
         return run_template(args.template, host=args.host, port=args.port,
                             timeout_s=args.timeout_s)
@@ -331,23 +336,100 @@ def format_profile_table(data: dict) -> str:
     return "\n".join(lines + ["", totals])
 
 
-def profile_command(url: str, *, memory: bool = False,
-                    as_json: bool = False, out=None) -> int:
-    """``pathway-tpu profile``: fetch ``/debug/profile`` from a running
-    process and print the ranked table."""
+def format_profile_diff(before: dict, after: dict) -> str:
+    """Per-program before→after table for two ``/debug/profile``
+    snapshots (Round-17): dispatch ms p50, MFU and dispatch-share
+    deltas, biggest mover first — the fused-kernel / int8 win as one
+    reviewable table instead of two screenshots."""
+    from .obs.profiler import profile_diff
+
+    rows = profile_diff(before, after)
+    cols = ("program", "bucket", "ms p50", "Δms", "MFU", "ΔMFU",
+            "share", "Δshare")
+
+    def fmt(v, digits=2):
+        return f"{v:.{digits}f}" if v is not None else "-"
+
+    def arrow(b, a, digits=2):
+        if b is None and a is None:
+            return "-"
+        return f"{fmt(b, digits)}→{fmt(a, digits)}"
+
+    table = []
+    for r in rows:
+        mark = {"new": " (new)", "gone": " (gone)"}.get(r["status"], "")
+        table.append((
+            (r["program"] or "?")[:30] + mark,
+            str(r["bucket"] or "-")[:16],
+            arrow(r["ms_p50_before"], r["ms_p50_after"]),
+            fmt(r["ms_p50_delta"]),
+            arrow(r["mfu_before"], r["mfu_after"], 4),
+            fmt(r["mfu_delta"], 4),
+            f"{r['share_before']:.1%}→{r['share_after']:.1%}",
+            f"{r['share_delta']:+.1%}",
+        ))
+    widths = [
+        max(len(cols[i]), *(len(row[i]) for row in table)) if table
+        else len(cols[i])
+        for i in range(len(cols))
+    ]
+    lines = [
+        "  ".join(c.ljust(widths[i]) for i, c in enumerate(cols)),
+        "  ".join("-" * w for w in widths),
+    ]
+    lines += [
+        "  ".join(cell.ljust(widths[i]) for i, cell in enumerate(row))
+        for row in table
+    ]
+    return "\n".join(lines)
+
+
+def _load_profile_snapshot(source: str, *, memory: bool = False):
+    """A ``/debug/profile`` dict from a URL or a saved JSON file path —
+    the diff side of ``profile --diff`` always comes from a file, the
+    live side from the URL (a file path there too makes the whole diff
+    replayable offline)."""
     import json
+    import os
     import urllib.request
 
-    out = out or sys.stdout
-    target = url.rstrip("/") + "/debug/profile" + (
+    if os.path.exists(source):
+        with open(source) as f:
+            return json.load(f)
+    target = source.rstrip("/") + "/debug/profile" + (
         "?memory=1" if memory else ""
     )
+    return json.loads(urllib.request.urlopen(target, timeout=30).read())
+
+
+def profile_command(url: str, *, memory: bool = False,
+                    as_json: bool = False, diff: str | None = None,
+                    out=None) -> int:
+    """``pathway-tpu profile``: fetch ``/debug/profile`` from a running
+    process (or read a saved snapshot file) and print the ranked table;
+    with ``--diff BEFORE_JSON``, the per-program delta table instead."""
+    import json
+
+    out = out or sys.stdout
     try:
-        body = urllib.request.urlopen(target, timeout=30).read()
-        data = json.loads(body)
+        data = _load_profile_snapshot(url, memory=memory)
     except Exception as exc:  # noqa: BLE001 - a CLI prints, not raises
-        print(f"cannot fetch {target}: {exc}", file=sys.stderr)
+        print(f"cannot fetch {url}: {exc}", file=sys.stderr)
         return 1
+    if diff is not None:
+        try:
+            before = _load_profile_snapshot(diff)
+        except Exception as exc:  # noqa: BLE001
+            print(f"cannot load {diff}: {exc}", file=sys.stderr)
+            return 1
+        if as_json:
+            from .obs.profiler import profile_diff
+
+            print(json.dumps(profile_diff(before, data), indent=1,
+                             default=str), file=out)
+        else:
+            print(format_profile_diff(before, data), file=out)
+        return 0
     if as_json:
         print(json.dumps(data, indent=1, default=str), file=out)
     else:

@@ -306,13 +306,17 @@ def resolve_tp(cfg, tp: int | None) -> int:
 class PagedDecodeEngine:
     """Batched greedy decoding through BlockPool + PrefixCache."""
 
-    def __init__(self, cfg, params, *, num_blocks: int = 256,
-                 block_size: int = 16, max_blocks_per_seq: int | None = None,
-                 max_batch_size: int = 8, seq_buckets=(64, 256, 1024),
+    def __init__(self, cfg, params, *, num_blocks: int | None = None,
+                 block_size: int | None = None,
+                 max_blocks_per_seq: int | None = None,
+                 max_batch_size: int | None = None,
+                 seq_buckets=(64, 256, 1024),
                  prefix_sharing: bool = True, stop_token: int | None = None,
                  attn: str | None = None, chunked_prefill: bool = True,
                  prefill_chunk: int | None = None, tp: int | None = None,
-                 chain_steps: int = 8, name: str = "paged_decoder",
+                 chain_steps: int | None = None,
+                 quantize: str | None = None,
+                 name: str = "paged_decoder",
                  watchdog_timeout_s: float | None = None,
                  max_restarts: int | None = None,
                  degrade_fn: Callable | None = None,
@@ -322,7 +326,6 @@ class PagedDecodeEngine:
         from ..models.encoder import _resolve_dtype
 
         self.cfg = cfg
-        self.max_batch_size = int(max_batch_size)
         self.stop_token = stop_token
         if attn is None:
             attn = "pallas" if jax.default_backend() == "tpu" else "reference"
@@ -334,11 +337,28 @@ class PagedDecodeEngine:
         self.tp = resolve_tp(cfg, tp)
         self.mesh = None
         if self.tp > 1:
-            from ..parallel.mesh import shard_decoder_params, tp_mesh
+            from ..parallel.mesh import tp_mesh
 
             self.mesh = tp_mesh(self.tp)
-            params = shard_decoder_params(params, self.mesh)
-        self.params = params
+        # Round-17: the engine dispatches the FUSED DECODE PLAN, not the
+        # raw checkpoint pytree — Q/K/V folded into one gemm per layer,
+        # the vocab head pre-transposed where that wins, and (with
+        # quantize="int8") matmul weights quantized to int8 with
+        # per-output-channel scales (models/decoder.plan_decode_params).
+        # The plan is a pure function of (params, tp, quantize), so a
+        # supervised restart or a fleet replica rebuilding from the same
+        # checkpoint reproduces it — and its tokens — exactly.
+        self.quantize = quantize
+        self.base_params = params
+        from ..models.decoder import plan_decode_params
+
+        plan = plan_decode_params(cfg, params, tp=self.tp,
+                                  quantize=quantize)
+        if self.tp > 1:
+            from ..parallel.mesh import shard_decoder_params
+
+            plan = shard_decoder_params(plan, self.mesh)
+        self.params = plan
         head_dim = cfg.d_model // cfg.n_heads
         # Round-14 pre-flight HBM fit (obs/memory.py): params + KV pool +
         # step-temp watermark must fit the budget BEFORE any allocation —
@@ -348,6 +368,11 @@ class PagedDecodeEngine:
         # instead of OOMing at first dispatch.  With no budget resolvable
         # (the CPU fallback, no env override) the ledger is still
         # computed but nothing is enforced.
+        # Round-17: shapes the caller leaves unset are CHOSEN from the
+        # same ledger's what-ifs (obs/memory.choose_engine_config) — the
+        # ledger sees the decode plan's own leaves, so an int8 plan's
+        # weights are billed at their true byte width and the freed HBM
+        # goes to the pool.  auto_config records what was chosen and why.
         from ..obs import memory as obs_memory
 
         if hbm_fit not in ("reject", "clamp", "off"):
@@ -355,15 +380,43 @@ class PagedDecodeEngine:
                 f"hbm_fit={hbm_fit!r} is not one of 'reject', 'clamp', "
                 "'off'"
             )
+        auto = obs_memory.choose_engine_config(
+            cfg, params=self.params, tp=self.tp,
+            dtype=_resolve_dtype(cfg.dtype),
+            budget_bytes=hbm_budget_bytes,
+            reference_attn=(self.attn != "pallas"),
+            prefill_chunk=prefill_chunk, num_blocks=num_blocks,
+            block_size=block_size, max_batch_size=max_batch_size,
+            chain_steps=chain_steps,
+        )
+        num_blocks = auto["num_blocks"]
+        block_size = auto["block_size"]
+        chain_steps = auto["chain_steps"]
+        self.max_batch_size = int(auto["max_batch_size"])
+        self.auto_config = {
+            "chosen": auto["chosen"], "source": auto["source"],
+            "num_blocks": num_blocks, "block_size": block_size,
+            "max_batch_size": self.max_batch_size,
+            "chain_steps": chain_steps, "quantize": quantize,
+        }
+        # re-constructibility guarantee: the ledger below is built FRESH
+        # from the resolved shapes (not reused from the chooser), so the
+        # fit verdict the engine enforces is exactly what anyone
+        # re-running hbm_plan with these numbers would get
         self.hbm_plan = obs_memory.hbm_plan(
             cfg, num_blocks=int(num_blocks), block_size=int(block_size),
             max_batch_size=self.max_batch_size,
             chain_steps=max(1, int(chain_steps)),
             prefill_chunk=prefill_chunk, tp=self.tp,
-            dtype=_resolve_dtype(cfg.dtype), params=params,
+            dtype=_resolve_dtype(cfg.dtype), params=self.params,
             budget_bytes=hbm_budget_bytes,
             reference_attn=(self.attn != "pallas"),
         )
+        if auto["chosen"] and self.hbm_plan.budget_bytes is not None:
+            assert self.hbm_plan.fits, (
+                "auto-chosen engine config must re-construct as fitting: "
+                + self.hbm_plan.reject_message()
+            )
         if self.hbm_plan.budget_bytes is not None \
                 and not self.hbm_plan.fits and hbm_fit != "off":
             clamped = (
@@ -574,21 +627,29 @@ class PagedDecodeEngine:
         # sync sites below attribute per program (obs/profiler.py)
         from ..obs.profiler import profiled_jit
 
+        # Round-17: int8 engines register under distinct ``_i8`` program
+        # names so the observatory ranks/rooflines the two weight paths
+        # separately and CompileWatch pins each variant's compile count
+        sfx = self._prog_suffix
         self._step = profiled_jit(
-            "pw.decode_step", _step_fn, donate_argnums=(1, 2)
+            f"pw.decode_step{sfx}", _step_fn, donate_argnums=(1, 2)
         )
         self._mixed = profiled_jit(
-            "pw.mixed_step", _mixed_fn, donate_argnums=(1, 2)
+            f"pw.mixed_step{sfx}", _mixed_fn, donate_argnums=(1, 2)
         )
         # the chained program's (B, chain_steps) shape is static, so the
         # whole multi-step hot loop is ONE additional compile on top of
         # the round-8 pair (K=1 rounds reuse the plain step program)
         self._chained = profiled_jit(
-            "pw.chained_decode", _chained_fn, donate_argnums=(1, 2)
+            f"pw.chained_decode{sfx}", _chained_fn, donate_argnums=(1, 2)
         )
         self._prefill = profiled_jit(
-            "pw.prefill", _prefill_fn, donate_argnums=(3, 4)
+            f"pw.prefill{sfx}", _prefill_fn, donate_argnums=(3, 4)
         )
+
+    @property
+    def _prog_suffix(self) -> str:
+        return "_i8" if self.quantize == "int8" else ""
 
     # -- Round-15: device-side temperature/top-k/top-p sampling ------------
     def _sampled_programs(self) -> dict:
@@ -669,19 +730,23 @@ class PagedDecodeEngine:
                 tk, tpp, seed, emit,
             )
 
+        sfx = self._prog_suffix
         self._sampled = {
             "step": profiled_jit(
-                "pw.decode_step_sampled", _step_fn, donate_argnums=(1, 2)
+                f"pw.decode_step_sampled{sfx}", _step_fn,
+                donate_argnums=(1, 2),
             ),
             "mixed": profiled_jit(
-                "pw.mixed_step_sampled", _mixed_fn, donate_argnums=(1, 2)
+                f"pw.mixed_step_sampled{sfx}", _mixed_fn,
+                donate_argnums=(1, 2),
             ),
             "chained": profiled_jit(
-                "pw.chained_decode_sampled", _chained_fn,
+                f"pw.chained_decode_sampled{sfx}", _chained_fn,
                 donate_argnums=(1, 2),
             ),
             "prefill": profiled_jit(
-                "pw.prefill_sampled", _prefill_fn, donate_argnums=(3, 4)
+                f"pw.prefill_sampled{sfx}", _prefill_fn,
+                donate_argnums=(3, 4),
             ),
         }
         return self._sampled

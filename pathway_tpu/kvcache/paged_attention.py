@@ -281,6 +281,206 @@ def _make_paged_ragged():
 _paged_ragged = _make_paged_ragged()
 
 
+def _append_kernel(bt_ref, c0_ref, cl_ref, so_ref, q_ref, k1_ref, v1_ref,
+                   k_ref, v_ref, o_ref, ko_ref, vo_ref, m_ref, l_ref,
+                   acc_ref, *, block_size: int, scale: float):
+    """Round-17 fused append+attend (decode, C=1): the incoming token's
+    K/V rides into the kernel as a (H, Dp) operand, is patched into the
+    tail block IN REGISTER for the attention math, and is flushed back
+    to the pool through the aliased pool outputs — the standalone
+    scatter program the unfused path runs before attention disappears.
+    Pool out-blocks map every grid step of row ``b`` to the row's slot
+    block, so exactly ONE block per pool per row is written (at
+    ``j == jlast``), the same write set as the scatter.  Same grid /
+    online-softmax recurrence as :func:`_paged_kernel`."""
+    b = pl.program_id(0)
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, _NEG)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    c0 = c0_ref[b]
+    ctx = cl_ref[b]
+    so = so_ref[b]
+    jlast = (ctx - 1) // block_size  # the append lands in this block
+
+    def _patched(raw_ref, new_ref, last):
+        # tail block with the new token's row substituted (the HBM copy
+        # the input DMA'd predates the append)
+        sel = (jax.lax.broadcasted_iota(jnp.int32, (block_size, 1, 1), 0)
+               == so) & last
+        return jnp.where(sel, new_ref[:][None], raw_ref[:])
+
+    @pl.when(j <= jlast)
+    def _visible():
+        qb = q_ref[:]  # (C, H, Dp)
+        kb = _patched(k_ref, k1_ref, j == jlast)
+        s = jax.lax.dot_general(
+            qb, kb,
+            dimension_numbers=(((2,), (2,)), ((1,), (1,))),
+            preferred_element_type=jnp.float32,
+        ) * scale
+        k_pos = j * block_size + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, 2
+        )
+        col_ctx = jnp.minimum(
+            c0 + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1), ctx
+        )
+        valid = k_pos < col_ctx
+        s = jnp.where(valid, s, _NEG)
+        m_prev = m_ref[:, :, :1]
+        m_cur = jnp.max(s, axis=2, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new)
+        p = jnp.where(valid, p, 0.0)
+        corr = jnp.exp(m_prev - m_new)
+        l_ref[:] = jnp.broadcast_to(
+            l_ref[:, :, :1] * corr + jnp.sum(p, axis=2, keepdims=True),
+            l_ref.shape,
+        )
+        vb = _patched(v_ref, v1_ref, j == jlast)
+        acc_ref[:] = acc_ref[:] * corr + jax.lax.dot_general(
+            p.astype(vb.dtype), vb,
+            dimension_numbers=(((2,), (0,)), ((0,), (1,))),
+            preferred_element_type=jnp.float32,
+        )
+        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+
+    @pl.when(j == jlast)
+    def _final():
+        # the append itself: full tail block (input content + new row)
+        # through the aliased pool output — flushed once per row
+        ko_ref[:] = _patched(k_ref, k1_ref, True).astype(ko_ref.dtype)
+        vo_ref[:] = _patched(v_ref, v1_ref, True).astype(vo_ref.dtype)
+        denom = jnp.maximum(l_ref[:, :, :1], 1e-20)
+        o_ref[:] = (acc_ref[:] / denom).astype(o_ref.dtype)
+
+
+def _paged_append_fn(q, k_new, v_new, k_pool, v_pool, block_tables, c0,
+                     cl, slot_offsets, *, d_true: int,
+                     interpret: bool = False):
+    """q: (B, 1, H, Dp); k_new/v_new: (B, H, Dp); pools
+    (num_blocks, BS, H, Dp) — returned UPDATED (aliased in place on
+    TPU).  Contract: the slot is the tail of the attended context
+    (``slot_blocks[b] == block_tables[b, (cl[b]-1)//BS]`` and
+    ``slot_offsets[b] == (cl[b]-1) % BS``) — the decode append the
+    engine constructs by definition."""
+    B, C, H, Dp = q.shape
+    BS = k_pool.shape[1]
+    NB = block_tables.shape[1]
+    kernel = functools.partial(
+        _append_kernel, block_size=BS, scale=1.0 / np.sqrt(d_true)
+    )
+
+    def _kv_map(b, j, bt, c0, cl, so):
+        return (bt[b, jnp.minimum(j, (cl[b] - 1) // BS)], 0, 0, 0)
+
+    def _slot_map(b, j, bt, c0, cl, so):
+        # constant per row: the pool out-block IS the row's slot block
+        return (bt[b, (cl[b] - 1) // BS], 0, 0, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=4,  # block_tables, c0, cl, slot_offsets
+        grid=(B, NB),
+        in_specs=[
+            pl.BlockSpec((None, C, H, Dp),
+                         lambda b, j, bt, c0, cl, so: (b, 0, 0, 0)),
+            pl.BlockSpec((None, H, Dp),
+                         lambda b, j, bt, c0, cl, so: (b, 0, 0)),
+            pl.BlockSpec((None, H, Dp),
+                         lambda b, j, bt, c0, cl, so: (b, 0, 0)),
+            pl.BlockSpec((None, BS, H, Dp), _kv_map),
+            pl.BlockSpec((None, BS, H, Dp), _kv_map),
+        ],
+        out_specs=[
+            pl.BlockSpec((None, H, C, Dp),
+                         lambda b, j, bt, c0, cl, so: (b, 0, 0, 0)),
+            pl.BlockSpec((None, BS, H, Dp), _slot_map),
+            pl.BlockSpec((None, BS, H, Dp), _slot_map),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((H, C, 128), jnp.float32),  # m
+            pltpu.VMEM((H, C, 128), jnp.float32),  # l
+            pltpu.VMEM((H, C, Dp), jnp.float32),   # acc
+        ],
+    )
+    # alias indices count the scalar-prefetch operands: pools are
+    # operands 7/8 of (bt, c0, cl, so, q, k_new, v_new, k_pool, v_pool)
+    o, k_pool, v_pool = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((B, H, C, Dp), q.dtype),
+            jax.ShapeDtypeStruct(k_pool.shape, k_pool.dtype),
+            jax.ShapeDtypeStruct(v_pool.shape, v_pool.dtype),
+        ],
+        input_output_aliases={7: 1, 8: 2},
+        interpret=interpret,
+    )(block_tables, c0, cl, slot_offsets, q, k_new, v_new, k_pool, v_pool)
+    return o.transpose(0, 2, 1, 3), k_pool, v_pool
+
+
+def _make_paged_append():
+    kwargs = dict(static_argnames=("d_true", "interpret"),
+                  donate_argnums=(3, 4))
+    try:
+        from ..obs.profiler import profiled_jit
+
+        return profiled_jit("pw.paged_append_attend", _paged_append_fn,
+                            **kwargs)
+    except Exception:  # pragma: no cover - import-order edge
+        return jax.jit(_paged_append_fn, **kwargs)
+
+
+_paged_append = _make_paged_append()
+
+
+def paged_append_attend(q, k_new, v_new, k_pool, v_pool, block_tables,
+                        context_lens, slot_blocks, slot_offsets, *,
+                        use_pallas: bool | None = None,
+                        interpret: bool | None = None):
+    """Fused decode append+attend over ONE layer's pool slices: scatter
+    the incoming token's K/V at ``(slot_blocks, slot_offsets)`` and
+    attend through ``block_tables`` in a single program.
+
+    q: (B, 1, H, hd); k_new/v_new: (B, H, hd); pools
+    (num_blocks, BS, H, hd); block_tables (B, NB);
+    context_lens/slot_blocks/slot_offsets: (B,) int32 with the slot at
+    the context tail (``slot_offsets == (context_lens-1) % BS`` and
+    ``slot_blocks`` the matching table entry — the decode-step layout).
+    Returns ``(attn_out, k_pool, v_pool)`` with the pools updated;
+    bit-identical to scatter-then-:func:`paged_attention_reference` on
+    the reference path (tier-1), one fused Pallas program on TPU (pool
+    blocks aliased in place — the standalone scatter disappears).
+    head_dim must already be a 128-multiple for the kernel path
+    (lane-padding would copy the pools and break the in-place append);
+    other shapes take the reference path."""
+    backend = jax.default_backend()
+    hd = q.shape[-1]
+    if use_pallas is None:
+        use_pallas = _HAVE_PALLAS and backend == "tpu"
+    if not use_pallas or not _HAVE_PALLAS or hd % 128:
+        k_pool = k_pool.at[slot_blocks, slot_offsets].set(k_new)
+        v_pool = v_pool.at[slot_blocks, slot_offsets].set(v_new)
+        a = paged_attention_reference(
+            q, k_pool, v_pool, block_tables, context_lens
+        )
+        return a, k_pool, v_pool
+    _require_positive_context(1, context_lens, None, None)
+    c0, cl_last = _query_context(1, context_lens, None, None)
+    return _paged_append(
+        q, k_new, v_new, k_pool, v_pool,
+        jnp.asarray(block_tables, jnp.int32),
+        c0.astype(jnp.int32), cl_last.astype(jnp.int32),
+        jnp.asarray(slot_offsets, jnp.int32),
+        d_true=hd,
+        interpret=(backend != "tpu") if interpret is None else interpret,
+    )
+
+
 def paged_attention(q, k_pool, v_pool, block_tables, context_lens=None, *,
                     start_pos=None, n_valid=None,
                     use_pallas: bool | None = None,

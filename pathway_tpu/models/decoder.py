@@ -76,12 +76,214 @@ def _embed_rows(embed, tokens, tp_axis):
 def _row_proj(layer, x, w_name: str, b_name: str, tp_axis):
     """Row-parallel projection: the tp contraction is split across shards,
     so partial products are psum'd BEFORE the (replicated) bias is added
-    once.  tp_axis=None is byte-for-byte encoder._proj."""
-    out = _psum_if(x @ layer[w_name].astype(x.dtype), tp_axis)
+    once.  tp_axis=None is byte-for-byte encoder._proj.  An int8 decode
+    plan replaces ``w_name`` with the ``{w}_q``/``{w}_s`` pair — the
+    per-output-channel scale is identical on every shard, so applying it
+    to the shard-local partial product before the psum equals applying
+    it once after (the scale distributes over the sum)."""
+    out = _psum_if(_mm_p(layer, x, w_name), tp_axis)
     b = layer.get(b_name)
     if b is not None:
         out = out + b.astype(x.dtype)
     return out
+
+
+# -- Round-17 fused decode plan ----------------------------------------------
+#
+# ``plan_decode_params`` derives, once at engine build, the pytree the
+# paged step programs actually dispatch with: Q/K/V folded into ONE gemm
+# per layer, the tied-embedding head pre-materialized in its fast [D, V]
+# orientation, and (opt-in) every matmul weight quantized to int8 with
+# per-output-channel scales.  The step functions branch on KEY PRESENCE
+# (``wqkv``/``embed_t``/``{w}_q``), so the raw checkpoint pytree still
+# runs the exact unfused round-8 programs — that unfused path is the
+# token-identity reference the fused plan is tested against.
+
+
+def quantize_weight_int8(w):
+    """Per-output-channel symmetric int8 quantization of a [In, Out]
+    matmul weight: ``s[o] = amax(|w[:, o]|) / 127``, ``q = round(w / s)``.
+    Returns ``(q int8, s f32)``; all-zero columns take s=1 so the
+    round-trip stays exact.  The int8 numerics contract is
+    ``x @ q * s`` with f32 accumulation — dequant happens in the matmul
+    EPILOGUE, so the weight's HBM traffic is its int8 byte width."""
+    w32 = w.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(w32), axis=0)
+    s = jnp.where(amax > 0, amax / 127.0, 1.0).astype(jnp.float32)
+    q = jnp.clip(jnp.round(w32 / s), -127, 127).astype(jnp.int8)
+    return q, s
+
+
+def _mm_p(layer, x, w_name: str):
+    """``x @ layer[w_name]`` with the decode plan's int8 epilogue when the
+    layer carries the quantized ``{w}_q``/``{w}_s`` pair instead of the
+    f32 leaf.  The int8 operand is widened to the compute dtype ON READ
+    (XLA fuses the convert into the gemm's operand load — the weight's
+    HBM footprint and traffic stay int8) and the per-channel scale
+    multiplies the f32-accumulated product as the epilogue."""
+    q = layer.get(w_name + "_q")
+    if q is None:
+        return x @ layer[w_name].astype(x.dtype)
+    y = x @ q.astype(x.dtype)
+    return y * layer[w_name + "_s"].astype(y.dtype)
+
+
+def _proj_p(layer, x, w_name: str, b_name: str):
+    """encoder._proj, decode-plan-aware (int8 ``{w}_q`` pair honored)."""
+    out = _mm_p(layer, x, w_name)
+    b = layer.get(b_name)
+    if b is not None:
+        out = out + b.astype(x.dtype)
+    return out
+
+
+def _qkv_proj(layer, x):
+    """The per-layer Q/K/V projections — ONE fused gemm against the
+    decode plan's ``wqkv`` (or int8 ``wqkv_q``) leaf when present, else
+    the three separate round-8 gemms.  The fused leaf's columns are laid
+    out PER TP SHARD ([q_s | k_s | v_s] for each shard s — see
+    :func:`plan_decode_params`), so under shard_map the local slice
+    splits 3 ways into exactly the columns the unfused sharded gemms
+    produce; each output element is the same length-D contraction either
+    way, which is what keeps the fused plan token-identical."""
+    if "wqkv" in layer or "wqkv_q" in layer:
+        qkv = _proj_p(layer, x, "wqkv", "bqkv")
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        return q, k, v
+    from .encoder import _proj
+
+    return (_proj(layer, x, "wq", "bq"), _proj(layer, x, "wk", "bk"),
+            _proj(layer, x, "wv", "bv"))
+
+
+def _head_weight(params):
+    """The vocab-head operand for a params/plan pytree: the plan's
+    pre-materialized [D, V] ``embed_t`` — as an ``(array, scales|None)``
+    tuple so orientation is explicit, never shape-guessed — or the raw
+    tied [V, D] embedding table."""
+    if "embed_t_q" in params:
+        return (params["embed_t_q"], params["embed_t_s"])
+    if "embed_t" in params:
+        return (params["embed_t"], None)
+    return params["embed"]
+
+
+def _head_logits(head_w, x):
+    """(B, D) -> (B, V[/tp]) f32 logits for the tied-embedding head.
+    ``head_w`` is :func:`_head_weight`'s result: a (w [D, V], scales)
+    tuple from a decode plan, or the raw [V, D] table.  The plan's
+    orientation matters: XLA:CPU's gemm is ~15x slower contracting a
+    transposed operand, so paying the transpose once at plan build is
+    the single largest fused-decode win on the fallback backend (the
+    transpose itself is exact, so logits are bit-identical)."""
+    if isinstance(head_w, tuple):
+        w, s = head_w
+        logits = (x @ w.astype(x.dtype)).astype(jnp.float32)
+        if s is not None:
+            logits = logits * s.astype(jnp.float32)
+        return logits
+    return (x @ head_w.astype(x.dtype).T).astype(jnp.float32)
+
+
+def int8_device_native(native: bool | None = None) -> bool:
+    """Whether the int8 decode plan keeps weights RESIDENT in int8.
+    Auto (None) follows the backend: on TPU the convert-on-read epilogue
+    halves-or-better the weight HBM traffic; on the CPU fallback XLA's
+    int8 gemm is measured 4-6x SLOWER than f32, so the plan keeps
+    int8-faithful numerics (quantize -> scales -> round-trip) but
+    pre-applies the dequant at build time and dispatches f32 — same
+    tokens, BLAS-speed matmuls, honestly-f32 bytes in the HBM ledger."""
+    if native is not None:
+        return bool(native)
+    return jax.default_backend() == "tpu"
+
+
+def _plan_quantize(name: str, w, out: dict, native: bool):
+    q, s = quantize_weight_int8(w)
+    if native:
+        out[name + "_q"] = q
+        out[name + "_s"] = s
+    else:
+        out[name] = (q.astype(jnp.float32) * s).astype(w.dtype)
+
+
+def _fuse_cols(ws, tp: int):
+    """Concatenate column-parallel leaves along the output axis, laid out
+    per tp shard: shard s's contiguous slice is [ws[0]_s | ws[1]_s | ...],
+    so sharding the fused axis with P(None, "tp") (P("tp") for biases)
+    hands each shard exactly the fusion of its unfused slices."""
+    if tp <= 1:
+        return jnp.concatenate(ws, axis=-1)
+    parts = [jnp.split(w, tp, axis=-1) for w in ws]
+    return jnp.concatenate(
+        [p[s] for s in range(tp) for p in parts], axis=-1
+    )
+
+
+def plan_decode_params(cfg: DecoderConfig, params: dict, *, tp: int = 1,
+                       quantize: str | None = None,
+                       native: bool | None = None,
+                       head_t: bool | None = None) -> dict:
+    """Derive the fused decode plan the paged engine dispatches with.
+
+    Fusions (each exact — the plan is token-identical to the raw pytree):
+
+    - ``wqkv``/``bqkv``: the three Q/K/V gemms fold into one [D, 3D]
+      matmul per layer (one wide MXU tile instead of three narrow ones —
+      the same trick encoder._attention plays at trace time, paid once
+      here instead of per step).  Columns are laid out per tp shard
+      (:func:`_fuse_cols`) so the leaf shards column-parallel.
+    - ``embed_t``: the tied-embedding head pre-materialized as [D, V].
+      Default (``head_t=None``): materialized on non-TPU backends, where
+      the transposed-operand gemm is the measured ~80% of the chained
+      step; skipped on TPU, whose MXU contracts either orientation at
+      speed (no point doubling the head's HBM residency).
+
+    ``quantize="int8"`` additionally quantizes every matmul weight
+    (wqkv, wo, w_up, w_down, embed_t) per OUTPUT channel
+    (:func:`quantize_weight_int8`).  ``native`` (default: auto by
+    backend, see :func:`int8_device_native`) picks between int8-resident
+    leaves (``{w}_q``/``{w}_s``) and build-time dequant.  The embedding
+    LOOKUP table stays f32 either way: it is read one row per token, so
+    quantizing it saves no meaningful bandwidth and would perturb the
+    residual stream's inputs for nothing.
+
+    The returned pytree drops wq/wk/wv (and their biases); layer norms,
+    ``pos_embed`` and ``embed`` carry over unchanged."""
+    if quantize not in (None, "int8"):
+        raise ValueError(f"quantize={quantize!r} is not None or 'int8'")
+    int8 = quantize == "int8"
+    native = int8_device_native(native) if int8 else False
+    if head_t is None:
+        head_t = int8 or jax.default_backend() != "tpu"
+    plan = {k: v for k, v in params.items() if k != "layers"}
+    if head_t:
+        et = jnp.transpose(params["embed"]).astype(params["embed"].dtype)
+        if int8:
+            _plan_quantize("embed_t", et, plan, native)
+        else:
+            plan["embed_t"] = et
+    layers = []
+    for layer in params["layers"]:
+        new = {
+            k: v for k, v in layer.items()
+            if k not in ("wq", "wk", "wv", "bq", "bk", "bv")
+        }
+        wqkv = _fuse_cols([layer["wq"], layer["wk"], layer["wv"]], tp)
+        if layer.get("bq") is not None:
+            new["bqkv"] = _fuse_cols(
+                [layer["bq"], layer["bk"], layer["bv"]], tp
+            )
+        if int8:
+            _plan_quantize("wqkv", wqkv, new, native)
+            for w_name in ("wo", "w_up", "w_down"):
+                if w_name in new:
+                    _plan_quantize(w_name, new.pop(w_name), new, native)
+        else:
+            new["wqkv"] = wqkv
+        layers.append(new)
+    plan["layers"] = layers
+    return plan
 
 
 def _head_out(embed, x, tp_axis):
@@ -97,8 +299,11 @@ def _head_out(embed, x, tp_axis):
     and the local logits slices are the same bytes a full-vocab matmul
     would produce (the head contraction is over the unsharded D axis),
     so the result equals ``jnp.argmax`` of the gathered logits exactly.
-    Returns (B,) int32 ids."""
-    logits = (x @ embed.astype(x.dtype).T).astype(jnp.float32)
+    Returns (B,) int32 ids.
+
+    ``embed`` accepts any :func:`_head_weight` form — the raw [V, D]
+    table or a decode plan's pre-transposed (and possibly int8) head."""
+    logits = _head_logits(embed, x)
     if tp_axis is None:
         return logits
     v_loc = logits.shape[-1]
@@ -183,7 +388,7 @@ def _sampling_head(temperature, top_k, top_p, keys):
     tie-break), so greedy rows stay token-identical under tp too."""
 
     def head(embed, x, tp_axis):
-        logits = (x @ embed.astype(x.dtype).T).astype(jnp.float32)
+        logits = _head_logits(embed, x)
         if tp_axis is not None:
             logits = jax.lax.all_gather(logits, tp_axis, axis=1, tiled=True)
         greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
@@ -249,8 +454,6 @@ def prefill(params: dict, cfg: DecoderConfig, token_ids: jax.Array,
     (ops/attention_pallas.py) so scores stay in VMEM instead of a
     (B,H,T,T) HBM tensor; default: on TPU for T >= 256.  Inference-only —
     prefill is never differentiated, so the kernel's missing VJP is moot."""
-    from .encoder import _proj
-
     dtype = _resolve_dtype(cfg.dtype)
     B, T = token_ids.shape
     hd = cfg.d_model // cfg.n_heads
@@ -264,9 +467,10 @@ def prefill(params: dict, cfg: DecoderConfig, token_ids: jax.Array,
     cache = []
     for layer in params["layers"]:
         h = _layer_norm(x, layer["ln1_scale"], layer["ln1_bias"], eps)
-        q = _proj(layer, h, "wq", "bq").reshape(B, T, -1, hd)
-        k = _proj(layer, h, "wk", "bk").reshape(B, T, -1, hd)
-        v = _proj(layer, h, "wv", "bv").reshape(B, T, -1, hd)
+        q, k, v = _qkv_proj(layer, h)
+        q = q.reshape(B, T, -1, hd)
+        k = k.reshape(B, T, -1, hd)
+        v = v.reshape(B, T, -1, hd)
         cache.append({"k": k, "v": v})
         if flash:
             from ..ops.attention_pallas import flash_attention
@@ -281,14 +485,14 @@ def prefill(params: dict, cfg: DecoderConfig, token_ids: jax.Array,
             a = jnp.einsum("bhqk,bkhd->bqhd", probs, v).reshape(B, T, -1)
         x = x + _row_proj(layer, a, "wo", "bo", tp_axis)
         h = _layer_norm(x, layer["ln2_scale"], layer["ln2_bias"], eps)
-        ff = act(_proj(layer, h, "w_up", "b_up"))
+        ff = act(_proj_p(layer, h, "w_up", "b_up"))
         x = x + _row_proj(layer, ff, "w_down", "b_down", tp_axis)
     x = _layer_norm(x, params["ln_f_scale"], params["ln_f_bias"], eps)
     last = jnp.take_along_axis(
         x, (n_valid - 1)[:, None, None].astype(jnp.int32), axis=1
     )[:, 0, :]
     out = (_head_out if head_fn is None else head_fn)(
-        params["embed"], last, tp_axis
+        _head_weight(params), last, tp_axis
     )
     return out, cache
 
@@ -388,8 +592,7 @@ def paged_decode_step(params: dict, cfg: DecoderConfig, k_pool: jax.Array,
     Returns ``(logits, k_pool, v_pool)`` — under ``tp_axis`` the first
     element is the greedily sampled (B,) int32 ids instead (_head_out).
     """
-    from .encoder import _proj
-    from ..kvcache.paged_attention import (paged_attention,
+    from ..kvcache.paged_attention import (paged_append_attend,
                                            paged_attention_reference)
 
     dtype = _resolve_dtype(cfg.dtype)
@@ -402,26 +605,33 @@ def paged_decode_step(params: dict, cfg: DecoderConfig, k_pool: jax.Array,
     context_lens = (positions + 1).astype(jnp.int32)
     for li, layer in enumerate(params["layers"]):
         h = _layer_norm(x, layer["ln1_scale"], layer["ln1_bias"], eps)
-        q = _proj(layer, h, "wq", "bq").reshape(B, 1, -1, hd)
-        k1 = _proj(layer, h, "wk", "bk").reshape(B, 1, -1, hd)
-        v1 = _proj(layer, h, "wv", "bv").reshape(B, 1, -1, hd)
-        k_pool = k_pool.at[li, slot_blocks, slot_offsets].set(k1[:, 0])
-        v_pool = v_pool.at[li, slot_blocks, slot_offsets].set(v1[:, 0])
+        q, k1, v1 = _qkv_proj(layer, h)
+        q = q.reshape(B, 1, -1, hd)
+        k1 = k1.reshape(B, 1, -1, hd)
+        v1 = v1.reshape(B, 1, -1, hd)
         if attn == "pallas":
-            a = paged_attention(
-                q, k_pool[li], v_pool[li], block_tables, context_lens
+            # Round-17 fused append+attend: the scatter rides inside the
+            # attention program (pool tail block aliased in place) — one
+            # Pallas dispatch per layer where round 8 ran scatter + attend
+            a, kl, vl = paged_append_attend(
+                q, k1[:, 0], v1[:, 0], k_pool[li], v_pool[li],
+                block_tables, context_lens, slot_blocks, slot_offsets,
             )
+            k_pool = k_pool.at[li].set(kl)
+            v_pool = v_pool.at[li].set(vl)
         else:
+            k_pool = k_pool.at[li, slot_blocks, slot_offsets].set(k1[:, 0])
+            v_pool = v_pool.at[li, slot_blocks, slot_offsets].set(v1[:, 0])
             a = paged_attention_reference(
                 q, k_pool[li], v_pool[li], block_tables, context_lens
             )
         x = x + _row_proj(layer, a.reshape(B, 1, -1), "wo", "bo", tp_axis)
         h = _layer_norm(x, layer["ln2_scale"], layer["ln2_bias"], eps)
-        ff = act(_proj(layer, h, "w_up", "b_up"))
+        ff = act(_proj_p(layer, h, "w_up", "b_up"))
         x = x + _row_proj(layer, ff, "w_down", "b_down", tp_axis)
     x = _layer_norm(x, params["ln_f_scale"], params["ln_f_bias"], eps)
     out = (_head_out if head_fn is None else head_fn)(
-        params["embed"], x[:, 0, :], tp_axis
+        _head_weight(params), x[:, 0, :], tp_axis
     )
     return out, k_pool, v_pool
 
@@ -479,7 +689,6 @@ def paged_mixed_step(params: dict, cfg: DecoderConfig, k_pool: jax.Array,
     Under ``tp_axis`` the first element is the greedily sampled (B,)
     int32 ids instead (_head_out).
     """
-    from .encoder import _proj
     from ..kvcache.paged_attention import (paged_attention,
                                            paged_attention_reference)
 
@@ -495,9 +704,10 @@ def paged_mixed_step(params: dict, cfg: DecoderConfig, k_pool: jax.Array,
     act = _act_fn(cfg)
     for li, layer in enumerate(params["layers"]):
         h = _layer_norm(x, layer["ln1_scale"], layer["ln1_bias"], eps)
-        q = _proj(layer, h, "wq", "bq").reshape(T, -1, hd)
-        k1 = _proj(layer, h, "wk", "bk").reshape(T, -1, hd)
-        v1 = _proj(layer, h, "wv", "bv").reshape(T, -1, hd)
+        q, k1, v1 = _qkv_proj(layer, h)
+        q = q.reshape(T, -1, hd)
+        k1 = k1.reshape(T, -1, hd)
+        v1 = v1.reshape(T, -1, hd)
         k_pool = k_pool.at[li, slot_blocks, slot_offsets].set(k1)
         v_pool = v_pool.at[li, slot_blocks, slot_offsets].set(v1)
         q_rows = q[row_token_idx]  # (B, C, H[/tp], hd)
@@ -514,12 +724,12 @@ def paged_mixed_step(params: dict, cfg: DecoderConfig, k_pool: jax.Array,
         a = a_rows[tok_row, tok_col]  # back to the packed (T, H[/tp], hd)
         x = x + _row_proj(layer, a.reshape(T, -1), "wo", "bo", tp_axis)
         h = _layer_norm(x, layer["ln2_scale"], layer["ln2_bias"], eps)
-        ff = act(_proj(layer, h, "w_up", "b_up"))
+        ff = act(_proj_p(layer, h, "w_up", "b_up"))
         x = x + _row_proj(layer, ff, "w_down", "b_down", tp_axis)
     x = _layer_norm(x, params["ln_f_scale"], params["ln_f_bias"], eps)
     sel = x[logit_idx]  # (B, D)
     out = (_head_out if head_fn is None else head_fn)(
-        params["embed"], sel, tp_axis
+        _head_weight(params), sel, tp_axis
     )
     return out, k_pool, v_pool
 
@@ -1452,6 +1662,25 @@ def init_opt_state(params):
     return jax.tree_util.tree_map(jnp.zeros_like, params)
 
 
+def measured_tier_prior() -> str | None:
+    """Round-17: the bench's single-stream tier race records its verdict
+    in the cost store (``pw.decode_tier`` / ``single_stream_pick``,
+    scoped to this backend's fingerprint).  Returns the winning tier
+    name — ``"int8_host"``, ``"f32_device"`` or ``"int8_device"`` — or
+    None when no race has been recorded on this backend, in which case
+    generate(fused="auto") keeps its static int8-host prior."""
+    try:
+        from ..obs.costdb import default_db
+
+        entry = default_db().get("pw.decode_tier", "single_stream_pick")
+        if entry is None:
+            return None
+        tier = (entry.get("extra") or {}).get("tier")
+        return tier if isinstance(tier, str) else None
+    except Exception:  # noqa: BLE001 - the prior is advisory
+        return None
+
+
 class JaxDecoderLM:
     """Host-facing text generator with a static-shape KV cache.
 
@@ -1551,18 +1780,45 @@ class JaxDecoderLM:
 
         fused="auto" (default) tier-selects by backend: on TPU the fused
         program wins (it removes the ~50-90 ms per-token dispatch round
-        trip); on the CPU fallback decoding is host-bandwidth-bound, so
-        the weight-int8 host tier (half the bytes per token, measured
-        ~2.8x the stepwise XLA loop) serves, with the stepwise loop as
-        the torch-less fallback."""
+        trip); on the CPU fallback the pick consults the cost store's
+        MEASURED single-stream tier race (bench-recorded under this
+        backend's fingerprint — Round-17 routes to the chained paged
+        engine when a device tier won), falling back to the weight-int8
+        host tier, then the stepwise loop when torch is unavailable."""
         if fused == "auto":
             if jax.default_backend() == "tpu":
                 fused = True
             else:
-                # CPU: decoding is weight-streaming-bound; the int8 host
-                # tier halves bytes/token (models/host_decoder.py,
-                # measured ~2.8x the stepwise XLA loop) — fall back to
-                # stepwise when torch is unavailable
+                # CPU: prefer the costdb-recorded winner of the measured
+                # single-stream race (pw.decode_tier); absent a
+                # measurement, the int8 host tier (half the bytes per
+                # token) is the static prior, stepwise the torch-less
+                # fallback.  int8_host remains the degrade target of the
+                # device tiers either way (paged_engine's degrade_fn).
+                tier = measured_tier_prior()
+                if tier in ("f32_device", "int8_device"):
+                    try:
+                        eng = self.paged_engine(
+                            quantize="int8" if tier == "int8_device" else None
+                        )
+                        if eng is not None:
+                            ids = self.tokenizer.encode(prompt)
+                            keep = self.cfg.max_len - max_new_tokens
+                            ids = ids[-max(keep, 1):] or [4]
+                            toks = eng.generate(ids, max_new_tokens)
+                            out = []
+                            for t in toks:
+                                out.append(int(t))
+                                if stop_token is not None and t == stop_token:
+                                    break
+                            return self._decode_out(out)
+                    except Exception as exc:  # noqa: BLE001 - host tiers work
+                        import logging
+
+                        logging.getLogger(__name__).info(
+                            "measured tier %r unusable (%s); falling back "
+                            "to host tiers", tier, exc,
+                        )
                 fused = "int8" if self._int8_host() is not None else False
         ids = self.tokenizer.encode(prompt)
         keep = self.cfg.max_len - max_new_tokens

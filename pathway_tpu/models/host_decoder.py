@@ -267,7 +267,8 @@ class Int8DecoderHost:
                          paged: bool | None = None,
                          max_batch_size: int | None = None,
                          tp: int | None = None,
-                         chain_steps: int | None = None, **kwargs):
+                         chain_steps: int | None = None,
+                         quantize: str | None = None, **kwargs):
         """Single shared executor for this decode tier (serve/scheduler.py).
 
         ``paged=True`` (default when the kvcache engine is constructible)
@@ -313,6 +314,15 @@ class Int8DecoderHost:
         f32 params (sticky: the paged tier is then unavailable on this
         instance).
 
+        ``quantize="int8"`` (Round-17) runs the paged engine's device
+        matmuls through int8 weights with per-output-channel scales and
+        f32 accumulation (models/decoder.plan_decode_params) — roughly
+        half the weight HBM traffic per decode step on TPU, with the
+        serial int8 host tier unchanged as the degrade target.  Greedy
+        and fixed-seed sampled tokens stay deterministic across engine
+        restarts and fleet failover (the int8 plan is a pure function of
+        the checkpoint).  Default (None): full-precision device weights.
+
         ``cache=`` (Round-16) selects the cache backend behind the
         executor: ``"paged"`` (default) is the block-pool KV tier above;
         ``"state"`` routes through :meth:`state_engine` — the
@@ -328,15 +338,15 @@ class Int8DecoderHost:
         if sched is not None and not sched._closed:
             if paged is not None or max_batch_size is not None \
                     or tp is not None or chain_steps is not None \
-                    or cache != "paged":
+                    or quantize is not None or cache != "paged":
                 import logging
 
                 logging.getLogger(__name__).warning(
                     "serving_executor(cache=%r, paged=%r, max_batch_size=%r,"
-                    " tp=%r, chain_steps=%r) ignored: the shared executor "
-                    "already exists; shut it down first to rebuild with "
-                    "different settings",
-                    cache, paged, max_batch_size, tp, chain_steps,
+                    " tp=%r, chain_steps=%r, quantize=%r) ignored: the "
+                    "shared executor already exists; shut it down first to "
+                    "rebuild with different settings",
+                    cache, paged, max_batch_size, tp, chain_steps, quantize,
                 )
             return sched
         from ..serve.scheduler import RequestScheduler
@@ -357,6 +367,8 @@ class Int8DecoderHost:
                 engine_kwargs["tp"] = tp
             if chain_steps is not None:
                 engine_kwargs["chain_steps"] = chain_steps
+            if quantize is not None:
+                engine_kwargs["quantize"] = quantize
             if cache == "state":
                 engine = self.state_engine(**engine_kwargs)
                 if engine is None:

@@ -68,7 +68,11 @@ def _params_bytes(cfg, params, tp: int, itemsize: int) -> int:
     """Per-shard parameter bytes: exact when the live pytree is given
     (its leaves may already be sharded jax arrays — global sizes divided
     by tp approximate the per-shard slice; replicated biases are noise
-    at this scale), analytic from the config otherwise."""
+    at this scale), analytic from the config otherwise.  Each leaf is
+    billed at its OWN dtype width — an int8 decode plan's quantized
+    weights count one byte each (Round-17), so the ledger the engine
+    builds from its dispatch pytree reflects the true quantized
+    footprint, not the f32 checkpoint's."""
     if params is not None:
         try:
             import jax
@@ -350,3 +354,114 @@ def hbm_plan(cfg, *, num_blocks: int, block_size: int,
     return _build(num_blocks=int(num_blocks),
                   chain_steps=max(1, int(chain_steps)),
                   max_batch_size=int(max_batch_size))
+
+
+# documented fallback shapes for hosts where NO HBM budget resolves (the
+# CPU fallback with no env override): with nothing to fit against, the
+# what-if ladder has no signal, so the choice degrades to these — the
+# same shapes the engine hand-set before Round-17
+ENGINE_DEFAULTS = {
+    "num_blocks": 256, "block_size": 16,
+    "max_batch_size": 8, "chain_steps": 8,
+}
+
+_BATCH_LADDER = (16, 8, 4, 2, 1)
+_CHAIN_LADDER = (16, 8, 4, 1)
+
+
+def choose_engine_config(cfg, *, params=None, tp: int = 1, dtype=None,
+                         budget_bytes: int | None = None,
+                         reference_attn: bool = True,
+                         prefill_chunk: int | None = None,
+                         num_blocks: int | None = None,
+                         block_size: int | None = None,
+                         max_batch_size: int | None = None,
+                         chain_steps: int | None = None) -> dict:
+    """Pick the engine shapes the caller left as ``None`` from HBM-ledger
+    what-ifs (:meth:`HbmPlan.fits_with`) instead of hand-set defaults
+    (Round-17).  Explicit values are honored verbatim — only the Nones
+    are chosen.  The rule, in order:
+
+    - ``block_size``: the pool granularity every kernel/chunk rule is
+      tiled for — not a fit question; 16 unless overridden.
+    - ``max_batch_size``: the widest rung of (16, 8, 4, 2, 1) whose
+      ledger fits with a one-sequence pool (batch width costs step
+      temps and logits rows, not pool blocks).
+    - ``chain_steps``: the longest rung of (16, 8, 4, 1) still fitting
+      at that batch (the chain term is bytes-cheap: a [B, K] ids carry).
+    - ``num_blocks``: full coverage — every batch row able to span
+      ``cfg.max_len`` (plus the null block) — when that fits, else the
+      ledger's ``max_fitting_num_blocks`` at the chosen batch/chain.
+
+    With no budget resolvable the ladder has no signal and the choice
+    falls back to :data:`ENGINE_DEFAULTS` (reported as such).
+
+    Returns a dict of the four resolved ints plus ``plan`` (a FRESH
+    ledger built from the final values — the re-constructibility
+    guarantee: anyone re-running ``hbm_plan`` with these numbers gets
+    the same fitting verdict), ``chosen`` (which names were auto-picked)
+    and ``source``.  Raises ``ValueError`` when a budget resolves but no
+    configuration fits, mirroring the construction rejection path."""
+    chosen = [name for name, v in (
+        ("num_blocks", num_blocks), ("block_size", block_size),
+        ("max_batch_size", max_batch_size), ("chain_steps", chain_steps),
+    ) if v is None]
+    bs = int(block_size) if block_size else ENGINE_DEFAULTS["block_size"]
+    budget, budget_source = resolve_budget(budget_bytes)
+
+    def ledger(nb: int, k: int, b: int) -> HbmPlan:
+        return hbm_plan(
+            cfg, num_blocks=nb, block_size=bs, max_batch_size=b,
+            chain_steps=k, prefill_chunk=prefill_chunk, tp=tp,
+            dtype=dtype, params=params, budget_bytes=budget_bytes,
+            reference_attn=reference_attn,
+        )
+
+    if budget is None:
+        nb = int(num_blocks) if num_blocks else \
+            ENGINE_DEFAULTS["num_blocks"]
+        b = int(max_batch_size) if max_batch_size else \
+            ENGINE_DEFAULTS["max_batch_size"]
+        k = max(1, int(chain_steps) if chain_steps else
+                ENGINE_DEFAULTS["chain_steps"])
+        return {
+            "num_blocks": nb, "block_size": bs, "max_batch_size": b,
+            "chain_steps": k, "plan": ledger(nb, k, b), "chosen": chosen,
+            "source": "defaults (no HBM budget resolved)",
+        }
+
+    blocks_per_seq = -(-cfg.max_len // bs)
+    min_nb = blocks_per_seq + 1  # one full-length sequence + null block
+    if max_batch_size is None:
+        max_batch_size = next(
+            (b for b in _BATCH_LADDER if ledger(min_nb, 1, b).fits), 1
+        )
+    b = int(max_batch_size)
+    if chain_steps is None:
+        chain_steps = next(
+            (k for k in _CHAIN_LADDER if ledger(min_nb, k, b).fits), 1
+        )
+    k = max(1, int(chain_steps))
+    if num_blocks is None:
+        want = b * blocks_per_seq + 1
+        probe = ledger(want, k, b)
+        if probe.fits:
+            num_blocks = want
+        else:
+            num_blocks = probe.max_fitting_num_blocks()
+            if num_blocks is None or num_blocks < 2:
+                raise ValueError(probe.reject_message())
+    nb = int(num_blocks)
+    final = ledger(nb, k, b)
+    if chosen and not final.fits:
+        # an auto-chosen shape must never need the clamp/reject path —
+        # the what-ifs above already proved it against the same ledger
+        raise AssertionError(
+            "auto-chosen engine config failed its own re-constructed "
+            "fit check: " + final.reject_message()
+        )
+    return {
+        "num_blocks": nb, "block_size": bs, "max_batch_size": b,
+        "chain_steps": k, "plan": final, "chosen": chosen,
+        "source": f"hbm_plan.fits_with what-ifs ({budget_source})",
+    }

@@ -870,3 +870,58 @@ def publish_to_costdb(db=None, *, peak_flops=None) -> int:
         )
         n += 1
     return n
+
+
+def profile_diff(before: dict, after: dict) -> list[dict]:
+    """Per-program deltas between two ``/debug/profile`` snapshots (the
+    dicts ``registry().summary()`` returns, or their JSON round-trips).
+
+    One row per (program, bucket) present in EITHER snapshot — a program
+    only in ``after`` is new (a fused/int8 variant that didn't exist
+    before), one only in ``before`` was retired; both read off the same
+    table.  Rows carry before/after/delta for the reviewable movers —
+    p50 dispatch ms, MFU, and share of total dispatch seconds — sorted
+    by |share delta| then |ms delta| so the biggest shift leads."""
+    def _index(snap):
+        progs = (snap or {}).get("programs") or []
+        return {((r.get("program") or "?"), r.get("bucket")): r
+                for r in progs}
+
+    def _share(rows):
+        tot = sum(r.get("dispatch_s_total") or 0.0 for r in rows.values())
+        return tot or 1.0
+
+    b_rows, a_rows = _index(before), _index(after)
+    b_tot, a_tot = _share(b_rows), _share(a_rows)
+    out = []
+    for key in sorted(set(b_rows) | set(a_rows), key=str):
+        b, a = b_rows.get(key), a_rows.get(key)
+
+        def _get(row, field):
+            return row.get(field) if row else None
+
+        def _delta(field):
+            x, y = _get(b, field), _get(a, field)
+            return round(y - x, 5) if x is not None and y is not None \
+                else None
+
+        b_share = ((b or {}).get("dispatch_s_total") or 0.0) / b_tot
+        a_share = ((a or {}).get("dispatch_s_total") or 0.0) / a_tot
+        out.append({
+            "program": key[0],
+            "bucket": key[1],
+            "status": ("new" if b is None
+                       else "gone" if a is None else "both"),
+            "ms_p50_before": _get(b, "dispatch_ms_p50"),
+            "ms_p50_after": _get(a, "dispatch_ms_p50"),
+            "ms_p50_delta": _delta("dispatch_ms_p50"),
+            "mfu_before": _get(b, "mfu"),
+            "mfu_after": _get(a, "mfu"),
+            "mfu_delta": _delta("mfu"),
+            "share_before": round(b_share, 4),
+            "share_after": round(a_share, 4),
+            "share_delta": round(a_share - b_share, 4),
+        })
+    out.sort(key=lambda r: (-abs(r["share_delta"]),
+                            -abs(r["ms_p50_delta"] or 0.0)))
+    return out

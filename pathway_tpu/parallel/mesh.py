@@ -169,6 +169,27 @@ def decoder_param_sharding_rules(path: tuple[str, ...],
     - pos_embed / layer norms / everything else: replicated.
     """
     name = path[-1] if path else ""
+    # Round-17 decode-plan leaves: int8 ``{w}_q`` weights shard exactly
+    # like their f32 base; the per-output-channel ``{w}_s`` scales shard
+    # WITH the output axis — split for column-parallel bases (each shard
+    # scales its own output columns), replicated for row-parallel ones
+    # (every shard applies the full-channel scale to its partial product
+    # before the psum; the scale distributes over the sum)
+    if name.endswith("_q") and name[:-2] in (
+            "wqkv", "wo", "w_up", "w_down", "embed_t"):
+        name = name[:-2]
+    if name.endswith("_s") and name[:-2] in ("wqkv", "w_up", "embed_t"):
+        return P("tp")
+    if name.endswith("_s") and name[:-2] in ("wo", "w_down"):
+        return P()
+    # wqkv/bqkv: the fused QKV gemm (Round-17) — columns laid out per
+    # shard ([q_s | k_s | v_s], decoder.plan_decode_params), so the
+    # plain column-parallel split hands each shard its unfused slices;
+    # embed_t: the pre-transposed [D, V] vocab head, vocab over tp
+    if name in ("wqkv", "embed_t"):
+        return P(None, "tp")
+    if name == "bqkv":
+        return P("tp")
     # w_a/b_a: the SSD decay projection (Round-16) — one scalar gate per
     # HEAD, so it shards column-parallel with the heads like wq
     if name in ("wq", "wk", "wv", "w_up", "w_gate", "w_a"):
