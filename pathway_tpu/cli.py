@@ -241,9 +241,14 @@ def _program_family(name: str) -> str:
     group by plane (``pw.ssd_chained_decode`` -> ``pw.ssd``,
     ``pw.state_suspend`` -> ``pw.state``, ``pw.chained_decode`` ->
     ``pw.chained``); anything else groups under its leading dotted
-    component."""
+    component.  Round-18: ``_draft``-marked drafter programs fold into
+    the family they draft FOR (``pw.prefill_draft`` -> ``pw.prefill``) —
+    the rollup answers "what does this plane cost", and a drafter's
+    dispatches are part of its target plane's speculative cost."""
     if name.startswith("pw."):
         rest = name[3:]
+        stripped = rest.replace("_draft", "").replace("draft_", "")
+        rest = stripped or "draft"
         head = rest.split("_", 1)[0] if "_" in rest else rest
         return f"pw.{head}"
     return name.split(".", 1)[0] if "." in name else name
@@ -358,6 +363,11 @@ def format_profile_diff(before: dict, after: dict) -> str:
     table = []
     for r in rows:
         mark = {"new": " (new)", "gone": " (gone)"}.get(r["status"], "")
+        if mark and "_draft" in (r["program"] or ""):
+            # Round-18: a drafter program appearing or disappearing
+            # between snapshots means speculative decode was turned
+            # on/off or switched drafters — worth its own callout
+            mark = " (+drafter)" if r["status"] == "new" else " (-drafter)"
         table.append((
             (r["program"] or "?")[:30] + mark,
             str(r["bucket"] or "-")[:16],

@@ -114,7 +114,10 @@ class MetricsServer:
                 f"<td>{_ttft_p50_ms(s)}</td>"
                 f"<td>{s.get('chain_count', 0)}</td>"
                 f"<td>{s.get('chain_occupancy', 0.0):.2f}</td>"
-                f"<td>{s.get('host_gap_s', 0.0) * 1e3:.1f}</td></tr>"
+                f"<td>{s.get('host_gap_s', 0.0) * 1e3:.1f}</td>"
+                f"<td>{s.get('spec_accepted', 0)}/"
+                f"{s.get('spec_proposed', 0)}"
+                f" ({s.get('spec_accept_rate', 0.0):.2f})</td></tr>"
                 for s in kv_snaps
             )
             kv_html = (
@@ -124,7 +127,8 @@ class MetricsServer:
                 "<th>preempt</th><th>cow</th><th>evict</th>"
                 "<th>chunks</th><th>mixed occ</th>"
                 "<th>ttft p50 ms</th><th>chains</th>"
-                "<th>chain occ</th><th>host gap ms</th></tr>"
+                "<th>chain occ</th><th>host gap ms</th>"
+                "<th>spec acc/prop (rate)</th></tr>"
                 f"{kv_rows}</table>"
             )
         fabric_html = ""

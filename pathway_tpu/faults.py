@@ -26,6 +26,12 @@ Fault points wired in this round (call sites in parentheses):
                           device program
 ``engine.dispatch.step``  / ``engine.dispatch.mixed`` /
 ``engine.dispatch.prefill``  the other dispatch kinds, same semantics
+``engine.dispatch.verify``  the Round-18 speculative verify dispatch,
+                          same semantics as the other dispatch kinds
+``engine.draft``          the speculative draft phase, BEFORE proposals
+                          are computed (kvcache/engine.py ``_spec_round``);
+                          ``drop`` suppresses drafting for the round (the
+                          engine falls through to the chain/step paths)
 ``engine.sync``           inside the (watchdog-bounded) device->host sync;
                           ``hang`` models a wedged device program
 ``persistence.append``    a journal record is about to be written; ``kill``

@@ -41,6 +41,16 @@ context length; ``StateDecodeEngine`` serves them with the paged
 engine's exact surface (continuous batching, chained decode, watchdog
 restart, tiering, fleet failover).
 
+Round-18 (ARCHITECTURE.md "Round-18: Speculative decoding") breaks the
+step's serial token dependence: a cheap drafter (speculative.py — a
+zero-HBM n-gram/prefix-hash drafter or a separately-planned draft MODEL)
+proposes up to K tokens per row, ONE ragged verify dispatch checks them
+all through the mixed-step kernel (C = k+1 queries/row), and the greedy
+accept rule keeps output TOKEN-IDENTICAL to non-speculative decode.
+Unlike the Round-10 chain, speculative rounds stay multi-token while
+arrivals are pending; ``PagedDecodeEngine(speculative=...)``, with
+``"auto"`` reading the cost store's measured ``pw.spec_tier`` prior.
+
 Kernel shape follows Ragged Paged Attention (arxiv 2604.15464); the
 managed-resource framing follows arxiv 2603.09555.
 """
@@ -50,10 +60,17 @@ from .block_pool import BlockPool, PoolExhausted, SequenceState
 from .engine import EngineHungError, PagedDecodeEngine, resolve_tp
 from .paged_attention import paged_attention, paged_attention_reference
 from .prefix_cache import PrefixCache
+from .speculative import (Drafter, DraftModelDrafter, NGramDrafter,
+                          SpecController, SpecResourceError)
 from .statecache import StateCache, StateDecodeEngine
 from .tiering import SessionStore
 
 __all__ = [
+    "Drafter",
+    "DraftModelDrafter",
+    "NGramDrafter",
+    "SpecController",
+    "SpecResourceError",
     "SessionStore",
     "BlockPool",
     "CacheBackend",

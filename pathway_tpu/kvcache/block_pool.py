@@ -344,6 +344,34 @@ class BlockPool(CacheBackend):
                 slots.append((seq.block_ids[-1], offset))
             return slots
 
+    def truncate_slots(self, seq_id: int, k: int) -> None:
+        """Roll back the sequence's last ``k`` reserved slots — the
+        inverse of :meth:`extend_slots` for slots whose writes turned out
+        to be garbage (Round-18 speculative verify: the rejected tail of
+        a draft run is rolled back so the pool never holds phantom KV).
+
+        ``n_tokens`` shrinks by ``k`` and blocks past the new span are
+        released; the table/token invariant (``check_invariants``) holds
+        on exit.  Stale bytes may linger inside the surviving tail block
+        past the new ``n_tokens`` — harmless, exactly like a freed
+        block's bytes: every read is masked to the live positions and
+        the next ``extend_slots`` overwrites them in place.  Only roll
+        back slots reserved by THIS sequence's own ``extend_slots`` (the
+        engine never truncates into prefix-shared history)."""
+        if k <= 0:
+            return
+        with self._lock:
+            seq = self._seqs[seq_id]
+            if k > seq.n_tokens:
+                raise ValueError(
+                    f"cannot roll back {k} slots: sequence {seq_id} "
+                    f"holds {seq.n_tokens} tokens"
+                )
+            seq.n_tokens -= k
+            keep = self.blocks_for(seq.n_tokens)
+            while len(seq.block_ids) > keep:
+                self.decref(seq.block_ids.pop())
+
     def fork(self, parent_id: int, child_id: int, *,
              priority: int | None = None) -> SequenceState:
         """Child shares every parent block (refcounted); diverging appends

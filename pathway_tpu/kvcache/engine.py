@@ -322,7 +322,8 @@ class PagedDecodeEngine:
                  degrade_fn: Callable | None = None,
                  hbm_budget_bytes: int | None = None,
                  hbm_fit: str = "reject",
-                 session_store=None):
+                 session_store=None,
+                 speculative=None):
         from ..models.encoder import _resolve_dtype
 
         self.cfg = cfg
@@ -646,6 +647,18 @@ class PagedDecodeEngine:
         self._prefill = profiled_jit(
             f"pw.prefill{sfx}", _prefill_fn, donate_argnums=(3, 4)
         )
+        # Round-18 speculative decoding (kvcache/speculative.py): a
+        # drafter proposes up to K tokens per row, ONE ragged verify
+        # dispatch checks them all, and the greedy accept rule keeps the
+        # emitted stream token-identical to non-speculative decode.  The
+        # verify program is built lazily on the first speculative round
+        # (like the sampled variants), so speculative=off engines compile
+        # nothing extra.  Resolution may bill a draft model's HBM against
+        # this engine's ledger and must therefore run AFTER hbm_plan.
+        self._verify = None
+        from .speculative import resolve_speculative
+
+        self._spec = resolve_speculative(speculative, self)
 
     @property
     def _prog_suffix(self) -> str:
@@ -771,6 +784,47 @@ class PagedDecodeEngine:
                 temp[i], top_k[i], top_p[i], seed[i] = t, k, p, s
         return (jnp.asarray(temp), jnp.asarray(top_k), jnp.asarray(top_p),
                 jnp.asarray(seed), jnp.asarray(emit))
+
+    # -- Round-18: speculative verify program ------------------------------
+    def _verify_program(self):
+        """The jitted verify program, built on FIRST speculative use: the
+        EXACT ragged mixed-step math with a FLATTENED ``(B*C,)`` logit
+        head — one argmax per packed query position instead of one per
+        row, so the host can compare every draft token against the
+        target model's own next-token choice.  Shapes are static
+        (``T = B * (k+1)`` tokens, ``C = k+1`` queries/row, ``B =
+        max_batch_size``), so the program compiles exactly once per
+        engine — the zero-recompile pin of the round."""
+        if self._verify is not None:
+            return self._verify
+        from ..obs.profiler import profiled_jit
+
+        _cfg, _attn, _mesh = self.cfg, self.attn, self.mesh
+
+        def _verify_fn(p, k_pool, v_pool, tokens, positions, row_tables,
+                       row_start, row_nvalid, row_token_idx, tok_row,
+                       tok_col, sb, so, logit_idx):
+            from ..models.decoder import paged_mixed_step, paged_mixed_step_tp
+
+            if _mesh is not None:
+                return paged_mixed_step_tp(
+                    p, _cfg, _mesh, k_pool, v_pool, tokens, positions,
+                    row_tables, row_start, row_nvalid, row_token_idx,
+                    tok_row, tok_col, sb, so, logit_idx, attn=_attn,
+                )
+            logits, k_pool, v_pool = paged_mixed_step(
+                p, _cfg, k_pool, v_pool, tokens, positions, row_tables,
+                row_start, row_nvalid, row_token_idx, tok_row, tok_col,
+                sb, so, logit_idx, attn=_attn,
+            )
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32), \
+                k_pool, v_pool
+
+        self._verify = profiled_jit(
+            f"pw.verify_step{self._prog_suffix}", _verify_fn,
+            donate_argnums=(1, 2),
+        )
+        return self._verify
 
     def _record_dispatch(self, prog, t_disp, t_end, items: int) -> None:
         """Attribute one dispatch->sync window to ``prog``'s registry
@@ -921,6 +975,11 @@ class PagedDecodeEngine:
         with self._lock:
             running = self._run_loop(pending, deliver, poll, stop)
             assert not running
+        if self._spec is not None:
+            # batch end: the controller's measured (drafter, K) aggregate
+            # lands in the cost store as a pw.spec_tier row — the prior
+            # speculative="auto" arbitrates from at the next engine build
+            self._spec.flush()
         if errors:
             raise errors[0][1]
         return results
@@ -1560,6 +1619,9 @@ class PagedDecodeEngine:
         queue is quiet, the Round-10 CHAINED program: up to ``chain_steps``
         greedy steps per dispatch with host bookkeeping overlapped against
         device execution (one sync per chain, not per token)."""
+        if self._spec is not None and self._spec_round(running, pending,
+                                                       deliver):
+            return
         if self._can_chain(running, pending):
             if self._chained_rounds(running, pending, deliver, poll, stop):
                 return
@@ -1580,6 +1642,195 @@ class PagedDecodeEngine:
             self._mixed_round(reserved, chunks, running, deliver)
         elif reserved:
             self._decode_round(reserved, running, deliver)
+
+    # -- Round-18: speculative draft + verify rounds -----------------------
+    def _spec_round(self, running, pending, deliver) -> bool:
+        """One speculative round: the drafter proposes up to K tokens per
+        decode row, ONE ragged verify dispatch pushes every row's last
+        emitted token plus its proposals through the mixed-step kernel
+        (C = k+1 queries/row, per-position argmax), and the greedy accept
+        rule emits the longest prefix where draft == target argmax plus
+        the free bonus token — TOKEN-IDENTICAL to non-speculative decode.
+        Unlike the chain, this round stays multi-token while arrivals are
+        PENDING: admission still happens at step boundaries (the loop
+        body polls before every round), so TTFT semantics are unchanged
+        and only this round's bounded latency is added.
+
+        Returns True when a verify dispatch ran; False falls through to
+        the chain/step/mixed paths — no decode rows, chunk rows in
+        flight, sampled rows (they ride K=1 unchanged this round), or no
+        usable proposals (the zero-accept worst case thereby degrades to
+        plain chained throughput, not below it)."""
+        spec = self._spec
+        if any(a.tokens is not None for a in running):
+            return False  # mid-prefill chunks stream through mixed
+        if any(a.req.sampling is not None for a in running):
+            return False
+        acts = list(running)
+        if not acts:
+            return False
+        pool = self.pool
+        # per-row draft budget BEFORE reservation: a row needs k_i + 1
+        # slots (proposals + the bonus token), and never more than its
+        # remaining emit/capacity budget
+        k_of: dict[int, int] = {}
+        ctx_of: dict[int, list[int]] = {}
+        ks = []
+        for a in acts:
+            seq = pool.sequence(a.seq_id)
+            rem = min(a.req.max_new - len(a.req.emitted),
+                      self.max_seq_tokens - seq.n_tokens)
+            k_of[id(a)] = max(0, min(spec.k, rem - 1))
+            base_ctx = (list(a.admitted) if a.admitted is not None
+                        else list(a.req.prompt))
+            ctx_of[id(a)] = base_ctx + [
+                int(t) for t in a.req.emitted[a.emit_base:]
+            ]
+            ks.append(k_of[id(a)])
+        if faults.fire("engine.draft") == "drop":
+            return False  # chaos: drafting suppressed, plain paths serve
+        t_d0 = time.perf_counter()
+        proposals = spec.propose_batch([ctx_of[id(a)] for a in acts], ks)
+        obs.record_span("engine.draft", t_d0, time.perf_counter(),
+                        ctx=self._run_ctx)
+        prop_of = {
+            id(a): [int(t) for t in p][:k_of[id(a)]]
+            for a, p in zip(acts, proposals)
+        }
+        if not any(prop_of.values()):
+            return False  # nothing proposed: fall through (chain/step)
+        victims: list[_Active] = []
+        reserved = self._reserve_slots(
+            running, pending, victims,
+            k_for=lambda a: len(prop_of.get(id(a), ())) + 1,
+        )
+        if victims:
+            self._cascade_preempt(victims, running, pending)
+        if not reserved:
+            return True  # every row preempted into pending; re-admit
+        # token-packed verify arrays: row i owns packed positions
+        # [i*C, i*C + nv_i) — static T = B*C regardless of acceptance,
+        # so the verify program never respecializes.  Pad rows/tokens
+        # follow the mixed-round convention: zeros -> the null block 0
+        # garbage sink, results discarded host-side.
+        C = spec.k + 1
+        B = self.max_batch_size
+        T = B * C
+        NB = self.max_blocks_per_seq
+        tokens = np.zeros(T, np.int32)
+        positions = np.zeros(T, np.int32)
+        sb = np.zeros(T, np.int32)
+        so = np.zeros(T, np.int32)
+        row_tables = np.zeros((B, NB), np.int32)
+        row_start = np.zeros(B, np.int32)
+        row_nvalid = np.ones(B, np.int32)
+        row_token_idx = np.zeros((B, C), np.int32)
+        tok_row = np.zeros(T, np.int32)
+        tok_col = np.zeros(T, np.int32)
+        logit_idx = np.zeros(T, np.int32)
+        rows: list[tuple[_Active, int, int]] = []
+        for i, (act, slots) in enumerate(reserved):
+            nv = len(slots)
+            base = i * C
+            seq = pool.sequence(act.seq_id)
+            prop = prop_of.get(id(act), [])
+            tokens[base:base + nv] = [act.req.emitted[-1]] + prop
+            start = seq.n_tokens - nv  # extend_slots already advanced
+            positions[base:base + nv] = np.arange(start, start + nv)
+            for t, (blk, off) in enumerate(slots):
+                sb[base + t] = blk
+                so[base + t] = off
+            row_tables[i, : len(seq.block_ids)] = seq.block_ids
+            row_start[i] = start
+            row_nvalid[i] = nv
+            cols = np.minimum(np.arange(C), nv - 1)
+            row_token_idx[i, :] = base + cols
+            run = np.arange(base, base + nv)
+            tok_row[run] = i
+            tok_col[run] = np.arange(nv)
+            logit_idx[base:base + C] = base + cols
+            rows.append((act, i, nv))
+        faults.fire("engine.dispatch.verify")
+        self._note_dispatch("verify")
+        t_disp = self._t_dispatch
+        prog = self._verify_program()
+        with _TraceAnnotation("pw.verify_step"):
+            ids, pool.k, pool.v = prog(
+                self.params, pool.k, pool.v, jnp.asarray(tokens),
+                jnp.asarray(positions), jnp.asarray(row_tables),
+                jnp.asarray(row_start), jnp.asarray(row_nvalid),
+                jnp.asarray(row_token_idx), jnp.asarray(tok_row),
+                jnp.asarray(tok_col), jnp.asarray(sb), jnp.asarray(so),
+                jnp.asarray(logit_idx),
+            )
+        t_sync0 = time.perf_counter()
+        ids = self._sync_host(ids)
+        t_sync1 = time.perf_counter()
+        obs.record_span("engine.sync", t_sync0, t_sync1, ctx=self._run_ctx)
+        self._note_sync()
+        # greedy accept scan: packed position base+c holds the target's
+        # argmax AFTER consuming input token c (c=0: the row's last
+        # emitted token — always valid; c>=1: draft c-1).  Output c is
+        # the true greedy token iff every input before it matched, so we
+        # emit until the input feeding the NEXT position diverges; the
+        # first mismatching position still yields one correct token (the
+        # free bonus).  Causality makes later garbage inputs harmless.
+        n_proposed = sum(nv - 1 for _a, _r, nv in rows)
+        n_accepted = 0
+        n_emitted = 0
+        done: list[_Active] = []
+        for act, i, nv in rows:
+            base = i * C
+            req = act.req
+            prop = prop_of.get(id(act), [])
+            emitted_n = 0
+            finished = False
+            for c in range(nv):
+                self._emit(req, int(ids[base + c]))
+                emitted_n += 1
+                n_emitted += 1
+                if len(req.emitted) >= req.max_new or (
+                    req.stop_token is not None
+                    and req.emitted[-1] == req.stop_token
+                ):
+                    finished = True
+                    break
+                if c < nv - 1 and prop[c] != int(ids[base + c]):
+                    break  # draft refuted: later positions are phantom
+            n_accepted += emitted_n - 1
+            # roll back the rejected tail NOW: the pool must never hold
+            # phantom K/V past the round (written coverage stays exactly
+            # "every emitted token but the last", the engine invariant)
+            rollback = nv - emitted_n
+            if rollback:
+                pool.truncate_slots(act.seq_id, rollback)
+            # capacity is judged AFTER rollback — the pre-extended
+            # n_tokens must not close a request its budget keeps open
+            if not finished and pool.sequence(
+                    act.seq_id).n_tokens >= self.max_seq_tokens:
+                finished = True
+            obs.record_span("engine.verify", t_disp, t_sync1, ctx=req.ctx,
+                            k=nv - 1, accepted=emitted_n - 1)
+            if finished:
+                done.append(act)
+        self._record_dispatch(prog, t_disp, t_sync1, items=n_emitted)
+        pool.stats.record_spec(
+            proposed=n_proposed, accepted=n_accepted, emitted=n_emitted,
+        )
+        for act in done:
+            running.remove(act)
+            self._release_seq(act)
+            deliver(act.req)
+            # a finished stream is drafter training data (the n-gram
+            # drafter's cross-request chain-hash table learns from it)
+            base_ctx = (list(act.admitted) if act.admitted is not None
+                        else list(act.req.prompt))
+            spec.note_release(base_ctx + [
+                int(t) for t in act.req.emitted[act.emit_base:]
+            ])
+        spec.note_round(n_proposed, n_accepted, n_emitted,
+                        ms=(t_sync1 - t_disp) * 1000.0)
+        return True
 
     # -- Round-10: device-resident chained decode --------------------------
     def _can_chain(self, running, pending) -> bool:
@@ -1754,7 +2005,11 @@ class PagedDecodeEngine:
                 running.remove(act)
                 self._release_seq(act)
             nxt = None
-            if running and not pending \
+            # with a drafter armed, the chain is the FALLBACK, not the
+            # hot loop: return after one dispatch so _step_round offers
+            # every round to the drafter (emitted tokens between rounds
+            # are exactly what the n-gram drafter learns from)
+            if running and not pending and self._spec is None \
                     and self._chain_headroom(running) >= 2:
                 try:
                     nxt = self._dispatch_chain(running, pending)

@@ -1,0 +1,416 @@
+"""Speculative decoding for the paged engine (Round-18).
+
+The chained scan (Round-10) multiplies tokens-per-dispatch only while the
+queue is QUIET — K adapts back to 1 the moment arrivals are pending — and
+after the Round-17 plan fusions the step's remaining floor is its serial
+token dependence: token t+1 cannot start until token t's argmax is known.
+Speculative decoding breaks that dependence without giving up greedy
+token identity:
+
+- a cheap DRAFTER proposes up to K continuation tokens per row;
+- the TARGET model verifies all K (+ the row's last emitted token) in
+  ONE ragged ``paged_mixed_step`` dispatch — the multi-query form the
+  ragged paged-attention kernel already supports (C >= 1 queries/row);
+- the GREEDY ACCEPT rule emits the longest prefix where the draft equals
+  the target argmax, plus the free "bonus" token from the first
+  mismatching position's logits.  Causal attention means a garbage later
+  draft token can never perturb an earlier position's logits, so the
+  emitted stream is TOKEN-IDENTICAL to non-speculative decode — a bad
+  drafter costs acceptance rate, never correctness.
+
+Two drafters ship behind one contract:
+
+- :class:`NGramDrafter` — host-side, ZERO extra HBM: continuations come
+  from the sequence's own emitted suffix (greedy decode of small models
+  is strongly cyclic, so suffix n-gram matching accepts well) and from a
+  cross-request table keyed by the prefix cache's chain hashes
+  (prefix_cache.chain_hashes), learned from released sequences.
+- :class:`DraftModelDrafter` — a small separately-planned decoder pytree
+  (``plan_decode_params``, so an int8 draft plan dispatches int8 gemms)
+  run through its own ``_draft``-suffixed observatory program.  Its HBM
+  is billed against the engine's ledger via ``hbm_plan.fits_with``
+  BEFORE it is enabled; an unfittable draft model falls back to the
+  n-gram drafter with a warning instead of OOMing at first dispatch.
+
+:class:`SpecController` wraps a drafter with measured arbitration: an
+EWMA accept rate gates proposals (a persistently refuted drafter cools
+off, letting the engine fall back to the plain chained scan — the
+zero-accept worst case degrades to chained throughput), and per-batch
+accept-rate / ms-per-dispatch aggregates flow to the cost store as
+``pw.spec_tier`` rows scoped to the backend fingerprint, which is what
+``speculative="auto"`` reads at engine build (mirroring Round-17's
+``single_stream_pick``).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from collections import OrderedDict
+
+logger = logging.getLogger(__name__)
+
+
+class SpecResourceError(RuntimeError):
+    """A drafter cannot be enabled on this engine (e.g. the draft model's
+    weights do not fit the HBM budget next to the pool)."""
+
+
+class Drafter:
+    """The drafter contract: propose up to ``k`` continuation tokens for
+    a row's context.  Proposals are ADVISORY — the verify step accepts or
+    rejects each against the target model's own argmax, so implementations
+    trade only acceptance rate, never output correctness.  A drafter must
+    be a pure function of the tokens it is shown (plus state learned from
+    tokens), so restart / failover replays propose identically."""
+
+    name = "drafter"
+    k = 4
+
+    def bind(self, engine) -> None:
+        """Attach to an engine (sizes, HBM billing, program build).  May
+        raise :class:`SpecResourceError` to veto enablement."""
+
+    def propose(self, ctx_tokens, k: int) -> list[int]:
+        """Up to ``k`` proposed continuation tokens for one row."""
+        raise NotImplementedError
+
+    def propose_batch(self, ctxs, ks) -> list[list[int]]:
+        """Row-wise proposals; the default loops :meth:`propose` (a
+        device drafter overrides this with one batched dispatch)."""
+        return [self.propose(c, k) if k > 0 else [] for c, k in zip(ctxs, ks)]
+
+    def note_release(self, tokens) -> None:
+        """A sequence finished with this full token stream — a learning
+        hook (the n-gram drafter feeds its chain-hash table here)."""
+
+
+class NGramDrafter(Drafter):
+    """Host-side drafter, zero extra HBM.
+
+    Proposal sources, in order:
+
+    1. SELF-MATCH: the longest suffix n-gram (``max_n`` down to 1) of the
+       row's own recent window that occurred earlier in the window; the
+       tokens that followed that earlier occurrence are the proposal.
+       Greedy decode of small models collapses into cycles, which this
+       matches exactly.
+    2. CHAIN-HASH TABLE: continuations learned from RELEASED sequences,
+       keyed by the prefix cache's chained block hashes — a new request
+       sharing a finished request's prefix drafts that request's
+       continuation (the cross-request analogue of prefix sharing).
+    """
+
+    name = "ngram"
+
+    def __init__(self, k: int = 4, max_n: int = 4, window: int = 256,
+                 table_size: int = 512):
+        self.k = int(k)
+        self.max_n = int(max_n)
+        self.window = int(window)
+        self._table_size = int(table_size)
+        self._table: OrderedDict[bytes, list[int]] = OrderedDict()
+        self._block_size = 16
+        self._lock = threading.Lock()
+
+    def bind(self, engine) -> None:
+        self._block_size = int(engine.pool.block_size)
+
+    def propose(self, ctx_tokens, k: int) -> list[int]:
+        if k <= 0 or len(ctx_tokens) < 2:
+            return []
+        ctx = [int(t) for t in ctx_tokens]
+        out = self._self_match(ctx, k)
+        if out:
+            return out
+        return self._hash_match(ctx, k)
+
+    def _self_match(self, ctx: list[int], k: int) -> list[int]:
+        w = ctx[-self.window:]
+        for n in range(min(self.max_n, len(w) - 1), 0, -1):
+            suffix = w[-n:]
+            # most recent earlier occurrence wins: the continuation the
+            # sequence took LAST time it stood here
+            for i in range(len(w) - n - 1, -1, -1):
+                if w[i:i + n] == suffix:
+                    cont = w[i + n:i + n + k]
+                    if cont:
+                        return cont
+        return []
+
+    def _hash_match(self, ctx: list[int], k: int) -> list[int]:
+        from .prefix_cache import chain_hashes
+
+        bs = self._block_size
+        nb = len(ctx) // bs
+        if nb == 0:
+            return []
+        keys = chain_hashes(ctx[:nb * bs], bs)
+        with self._lock:
+            cont = self._table.get(keys[-1])
+            if cont is not None:
+                self._table.move_to_end(keys[-1])
+        if cont is None:
+            return []
+        r = len(ctx) - nb * bs  # tokens already past the hashed block
+        if cont[:r] != ctx[nb * bs:]:
+            return []
+        return cont[r:r + k]
+
+    def note_release(self, tokens) -> None:
+        toks = [int(t) for t in tokens]
+        from .prefix_cache import chain_hashes
+
+        bs = self._block_size
+        keys = chain_hashes(toks, bs)
+        keep = self.k + self.max_n + 8  # enough for a proposal past the tail
+        with self._lock:
+            for bi, key in enumerate(keys):
+                cont = toks[(bi + 1) * bs:(bi + 1) * bs + keep]
+                if cont:
+                    self._table[key] = cont
+                    self._table.move_to_end(key)
+            while len(self._table) > self._table_size:
+                self._table.popitem(last=False)
+
+
+class DraftModelDrafter(Drafter):
+    """A small draft MODEL run on device through its own separately
+    planned pytree.
+
+    ``bind`` derives the decode plan (``plan_decode_params`` — fused QKV,
+    transposed head, optional int8), bills its bytes against the engine's
+    HBM ledger (``ceil(draft_bytes / per_block_bytes)`` extra pool-block
+    equivalents through ``hbm_plan.fits_with``) and builds ONE jitted
+    proposal program, registered in the observatory under a
+    ``_draft``-suffixed name so the profile rollup and CompileWatch see
+    it next to the target programs.  Proposal shapes are static
+    ``(max_batch_size, window + k)``, so the program compiles exactly
+    once per engine."""
+
+    name = "draft_model"
+
+    def __init__(self, cfg, params, *, k: int = 4, window: int = 32,
+                 quantize: str | None = None):
+        self.cfg = cfg
+        self.base_params = params
+        self.k = int(k)
+        self.window = int(window)
+        self.quantize = quantize
+        self._prog = None
+        self._B = 1
+
+    def bind(self, engine) -> None:
+        import jax
+
+        from ..models.decoder import plan_decode_params
+
+        plan = plan_decode_params(self.cfg, self.base_params, tp=1,
+                                  quantize=self.quantize)
+        hp = engine.hbm_plan
+        draft_bytes = sum(
+            leaf.size * leaf.dtype.itemsize
+            for leaf in jax.tree_util.tree_leaves(plan)
+        )
+        if hp.budget_bytes is not None:
+            # bill the draft weights as pool-block equivalents: the
+            # what-if must fit with the target's pool grown by them
+            extra = -(-int(draft_bytes) // max(hp.per_block_bytes, 1))
+            if not hp.fits_with(num_blocks=hp.num_blocks + extra):
+                raise SpecResourceError(
+                    f"draft model ({draft_bytes / 1048576:.1f}MB ~ "
+                    f"{extra} pool blocks) does not fit the HBM budget "
+                    f"next to the engine"
+                )
+        self.params = plan
+        self._B = int(engine.max_batch_size)
+        _cfg, _k = self.cfg, self.k
+
+        def _fn(p, buf, nv):
+            from ..models.decoder import draft_propose
+
+            return draft_propose(p, _cfg, buf, nv, k=_k)
+
+        from ..obs.profiler import profiled_jit
+
+        sfx = "_i8" if self.quantize == "int8" else ""
+        # `_draft` marks a drafter program: cli.py's profile rollup folds
+        # it into the family it drafts for, and profile --diff flags its
+        # appearance/disappearance across snapshots
+        self._prog = profiled_jit(f"pw.prefill_draft{sfx}", _fn)
+
+    def propose(self, ctx_tokens, k: int) -> list[int]:
+        return self.propose_batch([ctx_tokens], [k])[0]
+
+    def propose_batch(self, ctxs, ks) -> list[list[int]]:
+        if self._prog is None:
+            raise SpecResourceError("draft model drafter is not bound")
+        if not any(k > 0 for k in ks):
+            return [[] for _ in ctxs]
+        import jax.numpy as jnp
+        import numpy as np
+
+        W = self.window + self.k  # context window + proposal headroom
+        B = max(self._B, len(ctxs))
+        buf = np.zeros((B, W), np.int32)
+        nv = np.ones(B, np.int32)
+        for i, ctx in enumerate(ctxs):
+            tail = [int(t) for t in ctx[-self.window:]] or [0]
+            buf[i, :len(tail)] = tail
+            nv[i] = len(tail)
+        ids = np.asarray(self._prog(self.params, jnp.asarray(buf),
+                                    jnp.asarray(nv)))  # (B, k)
+        return [
+            [int(t) for t in ids[i, :k]] if k > 0 else []
+            for i, k in enumerate(ks)
+        ]
+
+
+class SpecController:
+    """Measured arbitration around one drafter.
+
+    Per verify round the engine reports (proposed, accepted, emitted,
+    ms); the controller keeps an EWMA accept rate and COOLS OFF — returns
+    empty proposals for ``cooloff_rounds`` rounds — when it falls under
+    ``accept_floor``, so a workload the drafter cannot predict degrades
+    to the plain chained scan instead of paying draft + verify overhead
+    forever.  After the cooloff it re-probes optimistically.  Aggregates
+    flush to the cost store as ``pw.spec_tier`` rows at batch end."""
+
+    def __init__(self, drafter: Drafter, *, accept_floor: float = 0.15,
+                 cooloff_rounds: int = 32, ewma_alpha: float = 0.2):
+        self.drafter = drafter
+        self.k = int(drafter.k)
+        self.accept_floor = float(accept_floor)
+        self.cooloff_rounds = int(cooloff_rounds)
+        self.ewma_alpha = float(ewma_alpha)
+        self._ewma = 1.0  # optimistic start: probe before judging
+        self._cooloff = 0
+        self._proposed = 0
+        self._accepted = 0
+        self._emitted = 0
+        self._dispatches = 0
+        self._ms_total = 0.0
+        self._lock = threading.Lock()
+
+    def bind(self, engine) -> None:
+        self.drafter.bind(engine)
+
+    def propose_batch(self, ctxs, ks) -> list[list[int]]:
+        with self._lock:
+            if self._cooloff > 0:
+                self._cooloff -= 1
+                if self._cooloff == 0:
+                    # re-probe with a clean slate: the workload may have
+                    # moved into territory the drafter predicts
+                    self._ewma = 1.0
+                return [[] for _ in ctxs]
+        return self.drafter.propose_batch(ctxs, ks)
+
+    def note_round(self, proposed: int, accepted: int, emitted: int,
+                   ms: float) -> None:
+        with self._lock:
+            self._proposed += proposed
+            self._accepted += accepted
+            self._emitted += emitted
+            self._dispatches += 1
+            self._ms_total += ms
+            if proposed > 0:
+                rate = accepted / proposed
+                self._ewma = (
+                    (1.0 - self.ewma_alpha) * self._ewma
+                    + self.ewma_alpha * rate
+                )
+                if self._ewma < self.accept_floor:
+                    self._cooloff = self.cooloff_rounds
+
+    def note_release(self, tokens) -> None:
+        try:
+            self.drafter.note_release(tokens)
+        except Exception:  # noqa: BLE001 - learning is best-effort
+            logger.warning("drafter note_release failed", exc_info=True)
+
+    def flush(self) -> None:
+        """Record this batch's measured (drafter, K) row in the cost
+        store — the substrate ``speculative="auto"`` arbitrates from —
+        then reset the aggregates.  Best-effort: the prior is advisory."""
+        with self._lock:
+            if self._dispatches == 0:
+                return
+            proposed, accepted = self._proposed, self._accepted
+            emitted, dispatches = self._emitted, self._dispatches
+            ms_total = self._ms_total
+            self._proposed = self._accepted = self._emitted = 0
+            self._dispatches = 0
+            self._ms_total = 0.0
+        try:
+            from ..obs.costdb import default_db
+
+            default_db().observe(
+                "pw.spec_tier", f"{self.drafter.name}|k{self.k}",
+                ms=ms_total / dispatches,
+                extra={
+                    "drafter": self.drafter.name, "k": self.k,
+                    "accept_rate": round(accepted / max(proposed, 1), 4),
+                    "accepted_per_dispatch": round(emitted / dispatches, 3),
+                },
+            )
+        except Exception:  # noqa: BLE001 - the cost store is advisory
+            logger.debug("spec_tier flush failed", exc_info=True)
+
+
+def _auto_drafter() -> Drafter:
+    """The ``speculative="auto"`` pick: the cost store's recorded
+    ``pw.spec_tier`` ``pick`` row under THIS backend's fingerprint
+    (bench.py records it, like Round-17's ``single_stream_pick``), else
+    the zero-HBM n-gram drafter at its default K."""
+    try:
+        from ..obs.costdb import default_db
+
+        entry = default_db().get("pw.spec_tier", "pick")
+        if entry is not None:
+            extra = entry.get("extra") or {}
+            k = int(extra.get("k") or 4)
+            if extra.get("drafter", "ngram") == "ngram":
+                return NGramDrafter(k=k)
+    except Exception:  # noqa: BLE001 - the prior is advisory
+        pass
+    return NGramDrafter()
+
+
+def resolve_speculative(value, engine) -> SpecController | None:
+    """Resolve ``PagedDecodeEngine(speculative=...)``:
+
+    - ``None`` / ``False`` / ``"off"`` — disabled;
+    - ``"ngram"`` / ``True`` — the n-gram drafter at default K;
+    - ``"auto"`` — the cost store's measured pick (:func:`_auto_drafter`);
+    - a :class:`Drafter` — wrapped in a :class:`SpecController`;
+    - a :class:`SpecController` — used as given.
+
+    Binding failures (a draft model that does not fit HBM) fall back to
+    the n-gram drafter with a warning rather than failing the engine."""
+    if value is None or value is False or value == "off":
+        return None
+    if isinstance(value, SpecController):
+        ctrl = value
+    elif isinstance(value, Drafter):
+        ctrl = SpecController(value)
+    elif value is True or value == "ngram":
+        ctrl = SpecController(NGramDrafter())
+    elif value == "auto":
+        ctrl = SpecController(_auto_drafter())
+    else:
+        raise ValueError(
+            f"speculative={value!r} is not one of None/'off'/'ngram'/"
+            "'auto'/Drafter/SpecController"
+        )
+    try:
+        ctrl.bind(engine)
+    except SpecResourceError as exc:
+        logger.warning(
+            "speculative drafter %r disabled (%s); falling back to the "
+            "zero-HBM n-gram drafter", ctrl.drafter.name, exc,
+        )
+        ctrl = SpecController(NGramDrafter(k=ctrl.k))
+        ctrl.bind(engine)
+    return ctrl

@@ -495,6 +495,10 @@ class StateDecodeEngine:
         self._t_device_idle: float | None = None
         self._t_dispatch: float | None = None
         self._dispatch_kind = "step"
+        # speculative decoding is a paged-cache feature (proposals need
+        # extend_slots/truncate_slots); the borrowed round driver and
+        # generate_batch flush read this, so it must exist — always off
+        self._spec = None
         self._run_ctx: tuple = (obs.new_trace_id(), 0)
         self._seq_counter = 0
         self._lock = threading.RLock()

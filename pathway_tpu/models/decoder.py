@@ -792,6 +792,47 @@ def paged_chained_decode(params: dict, cfg: DecoderConfig, k_pool: jax.Array,
     return ids.T, k_pool, v_pool  # (B, K)
 
 
+# -- draft-model proposals (Round-18 speculative decoding) -------------------
+
+
+def draft_propose(params: dict, cfg: DecoderConfig, token_ids: jax.Array,
+                  n_valid: jax.Array, *, k: int):
+    """K greedy next-token proposals from a small DRAFT model — the
+    device half of the speculative drafter (kvcache/speculative.py).
+
+    The draft model sees only a short window buffer, not the paged pool:
+    ``token_ids`` is (B, W) int32 whose first ``n_valid[b]`` entries hold
+    row b's most recent context tokens (prompt + emitted suffix), with at
+    least ``k`` free tail slots.  Each of the ``k`` scan steps runs the
+    plan-aware dense forward (:func:`prefill` — so an int8 draft plan
+    dispatches its int8 gemms), argmaxes the next token, and appends it
+    to the window for the following step.  Positions are window-relative,
+    which keeps proposals a pure function of the window contents — the
+    restart/failover determinism the engine's token-identity tests lean
+    on.  W is small (a drafter window, not ``cfg.max_len``), so the
+    O(k * W^2) re-forward stays far below one target-model step.
+
+    Proposal QUALITY is all this buys: the verify step accepts or rejects
+    against the target argmax, so a bad draft costs acceptance rate,
+    never correctness.  Returns (B, k) int32."""
+    W = token_ids.shape[1]
+
+    def body(carry, _t):
+        buf, nv = carry
+        out, _cache = prefill(params, cfg, buf, nv, flash=False)
+        ids = jnp.argmax(out, axis=-1).astype(jnp.int32)
+        col = jnp.minimum(nv, W - 1)  # defensive: a full window clamps
+        buf = buf.at[jnp.arange(buf.shape[0]), col].set(ids)
+        return (buf, jnp.minimum(nv + 1, W)), ids
+
+    (_buf, _nv), ids = jax.lax.scan(
+        body,
+        (token_ids.astype(jnp.int32), n_valid.astype(jnp.int32)),
+        jnp.arange(k, dtype=jnp.int32),
+    )
+    return ids.T  # (B, k)
+
+
 # -- sampled program variants (Round-15) -------------------------------------
 #
 # Each wraps its greedy twin with the sampling head; the step math (and

@@ -268,7 +268,8 @@ class Int8DecoderHost:
                          max_batch_size: int | None = None,
                          tp: int | None = None,
                          chain_steps: int | None = None,
-                         quantize: str | None = None, **kwargs):
+                         quantize: str | None = None,
+                         speculative=None, **kwargs):
         """Single shared executor for this decode tier (serve/scheduler.py).
 
         ``paged=True`` (default when the kvcache engine is constructible)
@@ -323,6 +324,17 @@ class Int8DecoderHost:
         restarts and fleet failover (the int8 plan is a pure function of
         the checkpoint).  Default (None): full-precision device weights.
 
+        ``speculative=`` (Round-18) turns on speculative decoding in the
+        paged engine: a cheap drafter proposes up to K tokens per row
+        and ONE ragged verify dispatch checks them all, so decode stays
+        multi-token even while arrivals are pending — with greedy output
+        TOKEN-IDENTICAL to non-speculative decode.  ``"ngram"`` is the
+        zero-HBM host-side drafter, ``"auto"`` reads the cost store's
+        measured ``pw.spec_tier`` prior for this backend, and a
+        ``Drafter``/``SpecController`` instance (kvcache/speculative.py,
+        e.g. a small draft model) is used directly.  Default (None):
+        off.
+
         ``cache=`` (Round-16) selects the cache backend behind the
         executor: ``"paged"`` (default) is the block-pool KV tier above;
         ``"state"`` routes through :meth:`state_engine` — the
@@ -338,15 +350,17 @@ class Int8DecoderHost:
         if sched is not None and not sched._closed:
             if paged is not None or max_batch_size is not None \
                     or tp is not None or chain_steps is not None \
-                    or quantize is not None or cache != "paged":
+                    or quantize is not None or speculative is not None \
+                    or cache != "paged":
                 import logging
 
                 logging.getLogger(__name__).warning(
                     "serving_executor(cache=%r, paged=%r, max_batch_size=%r,"
-                    " tp=%r, chain_steps=%r, quantize=%r) ignored: the "
-                    "shared executor already exists; shut it down first to "
-                    "rebuild with different settings",
+                    " tp=%r, chain_steps=%r, quantize=%r, speculative=%r) "
+                    "ignored: the shared executor already exists; shut it "
+                    "down first to rebuild with different settings",
                     cache, paged, max_batch_size, tp, chain_steps, quantize,
+                    speculative,
                 )
             return sched
         from ..serve.scheduler import RequestScheduler
@@ -369,6 +383,8 @@ class Int8DecoderHost:
                 engine_kwargs["chain_steps"] = chain_steps
             if quantize is not None:
                 engine_kwargs["quantize"] = quantize
+            if speculative is not None and cache == "paged":
+                engine_kwargs["speculative"] = speculative
             if cache == "state":
                 engine = self.state_engine(**engine_kwargs)
                 if engine is None:

@@ -150,6 +150,18 @@ class KVCacheStats:
       path seconds between a chain's results landing and the next chain
       being queued — the window the device may sit idle; ~0 when the
       double-buffered overlap is working)
+    - ``pathway_kv_spec_proposed_total{pool}``  counter (Round-18: draft
+      tokens proposed into verify dispatches)
+    - ``pathway_kv_spec_accepted_total{pool}``  counter (draft tokens the
+      target's argmax confirmed — emitted as real output)
+    - ``pathway_kv_spec_rejected_total{pool}``  counter (refuted drafts;
+      their pre-extended slots were rolled back)
+    - ``pathway_kv_spec_accept_rate{pool}``     gauge (accepted/proposed)
+    - ``pathway_kv_spec_emitted_total{pool}``   counter (ALL tokens out
+      of verify dispatches, accepts + the per-row bonus token;
+      /spec_rounds = accepted tokens per dispatch, the headline)
+    - ``pathway_kv_spec_rounds_total{pool}``    counter (verify
+      dispatches)
     - ``pathway_kv_shard_hbm_bytes{pool,shard}``     gauge (Round-9: K+V
       HBM held by each tensor-parallel shard)
     - ``pathway_kv_shard_blocks_in_use{pool,shard}`` gauge (block
@@ -182,6 +194,13 @@ class KVCacheStats:
         self.chain_slots = 0
         self.chain_emitted = 0
         self.host_gap_s = 0.0
+        # Round-18 speculative decoding: proposed/accepted/rejected draft
+        # tokens, total verify-emitted tokens and verify dispatches
+        self.spec_proposed = 0
+        self.spec_accepted = 0
+        self.spec_rejected = 0
+        self.spec_emitted = 0
+        self.spec_rounds = 0
         # Round-13 failure domain: supervised engine restarts (pool
         # rebuild + recompute re-admission), their cost, and degraded
         # handoffs when the restart budget ran out
@@ -241,6 +260,19 @@ class KVCacheStats:
             self.chain_slots += slots
             self.chain_emitted += emitted
 
+    def record_spec(self, proposed: int, accepted: int,
+                    emitted: int) -> None:
+        """One speculative verify dispatch (Round-18): ``proposed`` draft
+        tokens went in, ``accepted`` came back confirmed by the target's
+        argmax, ``emitted`` tokens total left the dispatch (accepts plus
+        each row's free bonus token).  rejected = proposed - accepted."""
+        with self._lock:
+            self.spec_rounds += 1
+            self.spec_proposed += proposed
+            self.spec_accepted += accepted
+            self.spec_rejected += proposed - accepted
+            self.spec_emitted += emitted
+
     def record_host_gap(self, seconds: float) -> None:
         """Host-critical-path time between a chain's sync completing and
         the next chain being queued on the device."""
@@ -299,6 +331,21 @@ class KVCacheStats:
         return self.chain_emitted / self.chain_slots \
             if self.chain_slots else 0.0
 
+    @property
+    def spec_accept_rate(self) -> float:
+        """Fraction of proposed draft tokens the target's argmax
+        confirmed (Round-18) — the drafter's quality signal, and what
+        the SpecController's cooloff gate watches per round."""
+        return self.spec_accepted / self.spec_proposed \
+            if self.spec_proposed else 0.0
+
+    @property
+    def spec_emitted_per_round(self) -> float:
+        """Tokens emitted per verify dispatch — the speculative
+        multi-token multiplier (1.0 would mean plain decode)."""
+        return self.spec_emitted / self.spec_rounds \
+            if self.spec_rounds else 0.0
+
     def snapshot(self) -> dict:
         with self._lock:
             return {
@@ -327,6 +374,13 @@ class KVCacheStats:
                 "chain_emitted": self.chain_emitted,
                 "chain_occupancy": self.chain_occupancy,
                 "host_gap_s": self.host_gap_s,
+                "spec_proposed": self.spec_proposed,
+                "spec_accepted": self.spec_accepted,
+                "spec_rejected": self.spec_rejected,
+                "spec_emitted": self.spec_emitted,
+                "spec_rounds": self.spec_rounds,
+                "spec_accept_rate": self.spec_accept_rate,
+                "spec_emitted_per_round": self.spec_emitted_per_round,
                 "engine_restarts": self.engine_restarts,
                 "engine_restart_rebuild_s": self.engine_restart_rebuild_s,
                 "engine_recovery_count": self.engine_recovery_count,
@@ -867,6 +921,12 @@ def _render_kv_lines() -> list[str]:
         "# TYPE pathway_kv_chain_emitted_total counter",
         "# TYPE pathway_kv_chain_occupancy gauge",
         "# TYPE pathway_kv_host_gap_seconds_total counter",
+        "# TYPE pathway_kv_spec_proposed_total counter",
+        "# TYPE pathway_kv_spec_accepted_total counter",
+        "# TYPE pathway_kv_spec_rejected_total counter",
+        "# TYPE pathway_kv_spec_emitted_total counter",
+        "# TYPE pathway_kv_spec_rounds_total counter",
+        "# TYPE pathway_kv_spec_accept_rate gauge",
         "# TYPE pathway_kv_engine_restarts_total counter",
         "# TYPE pathway_kv_engine_restart_seconds_total counter",
         "# TYPE pathway_kv_engine_recovery_seconds_total counter",
@@ -964,6 +1024,29 @@ def _render_kv_lines() -> list[str]:
             f"pathway_kv_host_gap_seconds_total{{{lbl}}} "
             f"{snap['host_gap_s']:.6f}"
         )
+        # Round-18 speculative decoding: draft proposal/acceptance flow
+        lines.append(
+            f"pathway_kv_spec_proposed_total{{{lbl}}} "
+            f"{snap['spec_proposed']}"
+        )
+        lines.append(
+            f"pathway_kv_spec_accepted_total{{{lbl}}} "
+            f"{snap['spec_accepted']}"
+        )
+        lines.append(
+            f"pathway_kv_spec_rejected_total{{{lbl}}} "
+            f"{snap['spec_rejected']}"
+        )
+        lines.append(
+            f"pathway_kv_spec_emitted_total{{{lbl}}} {snap['spec_emitted']}"
+        )
+        lines.append(
+            f"pathway_kv_spec_rounds_total{{{lbl}}} {snap['spec_rounds']}"
+        )
+        lines.append(
+            f"pathway_kv_spec_accept_rate{{{lbl}}} "
+            f"{snap['spec_accept_rate']:.3f}"
+        )
         lines.append(
             f"pathway_kv_engine_restarts_total{{{lbl}}} "
             f"{snap['engine_restarts']}"
@@ -1019,7 +1102,9 @@ def otlp_points(now_ns: str) -> list[dict]:
                     "cow_copies", "prefix_evictions", "blocks_in_use",
                     "prefill_chunks", "mixed_steps", "mixed_step_rows",
                     "ttft_count", "chain_count", "chain_slots",
-                    "chain_emitted", "engine_restarts", "engine_degraded"):
+                    "chain_emitted", "spec_proposed", "spec_accepted",
+                    "spec_rejected", "spec_emitted", "spec_rounds",
+                    "engine_restarts", "engine_degraded"):
             points.append({
                 "asInt": str(snap[key]),
                 "timeUnixNano": now_ns,
@@ -1028,8 +1113,8 @@ def otlp_points(now_ns: str) -> list[dict]:
                     {"key": "counter", "value": {"stringValue": key}},
                 ],
             })
-        for dkey in ("ttft_sum", "host_gap_s", "engine_recovery_s_sum",
-                     "engine_restart_rebuild_s"):
+        for dkey in ("ttft_sum", "host_gap_s", "spec_accept_rate",
+                     "engine_recovery_s_sum", "engine_restart_rebuild_s"):
             points.append({
                 "asDouble": snap[dkey],
                 "timeUnixNano": now_ns,
