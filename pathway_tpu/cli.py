@@ -82,7 +82,7 @@ def _spawn_once(program: list[str], threads: int, processes: int,
 
 def spawn(program: list[str], *, threads: int = 1, processes: int = 1,
           first_port: int = 10000, record: bool = False,
-          restart: int = 0) -> int:
+          restart: int = 0, elastic_plan: bool | None = None) -> int:
     """Supervise the program; honor elastic-rescale exit codes.
 
     ``restart`` (Round-13): how many times a crashed cluster is
@@ -94,6 +94,14 @@ def spawn(program: list[str], *, threads: int = 1, processes: int = 1,
     kill (tests/test_chaos_cluster.py pins the squash-check).  Faults
     armed via ``PW_FAULT`` use ``PW_FAULT_STAMP_DIR`` to fire only once
     across incarnations.
+
+    ``elastic_plan`` (Round-19, or ``PW_ELASTIC_PLAN=1``): before each
+    crash relaunch the supervisor consults the auto-planner's measured
+    ``pw.cluster.epoch`` rows (obs/planner.py choose_process_count) and
+    may relaunch at a DIFFERENT process count — the persistence journal
+    replays the union of all per-pid streams re-filtered by the new
+    membership's ownership, so exactly-once survives the re-partition
+    (tests/test_chaos_cluster.py pins it).
 
     Worker cap (reference: MAX_WORKERS=8, dataflow/config.rs:11-15): total
     threads x processes above 8 needs the 'unlimited-workers' entitlement;
@@ -126,6 +134,26 @@ def spawn(program: list[str], *, threads: int = 1, processes: int = 1,
             continue
         if code != 0 and attempts_left > 0:
             attempts_left -= 1
+            if elastic_plan or (
+                elastic_plan is None
+                and os.environ.get("PW_ELASTIC_PLAN") == "1"
+            ):
+                try:
+                    from .obs.planner import choose_process_count
+
+                    d = choose_process_count(
+                        processes, max_procs=MAX_PROCESSES
+                    )
+                    if d.source != "default" and int(d.value) != processes:
+                        print(
+                            f"[pathway-tpu] elastic membership: "
+                            f"{processes} -> {d.value} processes "
+                            f"({d.why})",
+                            file=sys.stderr,
+                        )
+                        processes = int(d.value)
+                except Exception:  # noqa: BLE001 - planning must never
+                    pass           # block recovery
             print(
                 f"[pathway-tpu] cluster died (exit {code}); relaunching all "
                 f"{processes} worker slot(s) "
@@ -174,9 +202,31 @@ def main(argv: list[str] | None = None) -> int:
                     help="relaunch a crashed cluster up to N times "
                          "(kill-and-recover; resumes from the persistence "
                          "journal)")
+    sp.add_argument("--elastic-plan", action="store_true", default=None,
+                    help="let the auto-planner pick the process count on "
+                         "each crash relaunch from measured epoch costs "
+                         "(also PW_ELASTIC_PLAN=1)")
     sp.add_argument("program", nargs=argparse.REMAINDER)
 
     sub.add_parser("spawn-from-env", help="spawn using PATHWAY_SPAWN_PROGRAM env")
+
+    pl = sub.add_parser(
+        "plan",
+        help="print the auto-planner's choice for every plane knob "
+             "(jit crossovers, process count, tp/dp, engine shapes) with "
+             "its recorded rationale",
+    )
+    pl.add_argument("--json", action="store_true",
+                    help="machine-readable plan instead of the table")
+    pl.add_argument("--calibrate", action="store_true",
+                    help="measure the segment-reduce numpy/jit pair across "
+                         "the size ladder first, so a fresh host plans from "
+                         "ITS costs instead of the documented defaults")
+    pl.add_argument("--processes", type=int, default=None,
+                    help="current cluster process count (default: "
+                         "PATHWAY_PROCESSES or 1)")
+    pl.add_argument("--budget-bytes", type=int, default=None,
+                    help="HBM budget for the engine-shape what-ifs")
 
     sub.add_parser("dashboard", add_help=False,
                    help="serve the web dashboard over recorded metrics")
@@ -224,9 +274,13 @@ def main(argv: list[str] | None = None) -> int:
             parser.error("no program given")
         return spawn(program, threads=args.threads, processes=args.processes,
                      first_port=args.first_port, record=args.record,
-                     restart=args.restart)
+                     restart=args.restart, elastic_plan=args.elastic_plan)
     if args.command == "spawn-from-env":
         return spawn_from_env()
+    if args.command == "plan":
+        return plan_command(as_json=args.json, calibrate=args.calibrate,
+                            processes=args.processes,
+                            budget_bytes=args.budget_bytes)
     if args.command == "profile":
         return profile_command(args.url, memory=args.memory,
                                as_json=args.json, diff=args.diff)
@@ -234,6 +288,34 @@ def main(argv: list[str] | None = None) -> int:
         return run_template(args.template, host=args.host, port=args.port,
                             timeout_s=args.timeout_s)
     return 2
+
+
+def plan_command(*, as_json: bool = False, calibrate: bool = False,
+                 processes: int | None = None,
+                 budget_bytes: int | None = None, out=None) -> int:
+    """``pathway-tpu plan``: every plane knob the auto-planner owns, with
+    the measured (or documented-default) evidence behind each choice —
+    the "why is the system configured this way" table.  ``--calibrate``
+    first measures the segment-reduce numpy/jit pair across the size
+    ladder so a fresh host's crossover comes from ITS backend."""
+    out = out or sys.stdout
+    from .obs import planner
+
+    if calibrate:
+        measured = planner.calibrate_mapreduce()
+        print(
+            f"[pathway-tpu] calibrated segment-reduce dual path: "
+            f"{len(measured)} (side, size) samples recorded",
+            file=sys.stderr,
+        )
+    p = planner.plan(current_processes=processes, budget_bytes=budget_bytes)
+    if as_json:
+        import json
+
+        print(json.dumps(p.as_dict(), indent=1, default=str), file=out)
+    else:
+        print(p.render(), file=out)
+    return 0
 
 
 def _program_family(name: str) -> str:

@@ -400,6 +400,7 @@ class JoinOperator(Operator):
         left_ncols: int,
         right_ncols: int,
         exact_match: bool = False,
+        simple_on: tuple | None = None,
         name: str = "",
     ):
         super().__init__(name)
@@ -408,6 +409,9 @@ class JoinOperator(Operator):
         self.how = how
         self.id_policy = id_policy
         self.left_ncols, self.right_ncols = left_ncols, right_ncols
+        # (left_positions, right_positions) when every on-expr is a plain
+        # column of its side — enables the columnar bulk path
+        self.simple_on = simple_on
         # durable arrangement state (operator snapshots)
         # jk -> {row_key: (row, count)}
         self.left: dict[Any, dict[Key, tuple[Row, int]]] = defaultdict(dict)
@@ -461,13 +465,99 @@ class JoinOperator(Operator):
         if totals[jk] == 0:
             del totals[jk]
 
+    def _bulk_jks(self, side: str, updates):
+        """Columnar join-key extraction for plain-column on-exprs: key
+        tuples come straight off the batch columns — no per-row env dict,
+        no compiled-closure dispatch — with the serial path's exact
+        Error/hashability rules.  Validated columns (np_col) provably hold
+        no Error/None and only hashable scalars, so their rows skip the
+        per-row checks entirely; the tuples still hold the ORIGINAL column
+        objects (list_col), so value/identity semantics — NaN keys
+        included — match the serial `_jk` walk bit for bit.  Returns
+        (jks, codes): jks[i] is row i's join key (None = error row), codes
+        the validated int64 key column for single-int-column joins (feeds
+        the membership pre-filter), else None."""
+        pos = self.simple_on[0] if side == "l" else self.simple_on[1]
+        arrs = [updates.np_col(ci) for ci in pos]
+        cols = [updates.list_col(ci) for ci in pos]
+        if all(a is not None for a in arrs):
+            codes = None
+            if len(pos) == 1:
+                import numpy as np
+
+                if arrs[0].dtype == np.int64:
+                    codes = arrs[0]
+            return list(zip(*cols)), codes
+        jks: list = []
+        for vals in zip(*cols):
+            if any(isinstance(v, Error) for v in vals):
+                jks.append(None)
+                continue
+            try:
+                hash(vals)
+            except TypeError:
+                from ..internals.value import hash_values
+
+                vals = ("#h", hash_values(vals))
+            jks.append(vals)
+        return jks, None
+
+    @staticmethod
+    def _bulk_membership(codes, build: dict):
+        """Inner-join pre-filter: bool mask over the batch marking join
+        keys present in the opposite arrangement (mapreduce's vectorized
+        ``pw.join.member`` primitive), or None when the arrangement's key
+        shapes make int-array equality unsound (a float or bool key can
+        equal an int: ``(1.0,) == (1,)``).  A masked-out row provably joins
+        nothing AND needs no outer padding (inner mode), so only its own
+        arrangement update remains."""
+        ks = []
+        for k in build:
+            if type(k) is tuple and len(k) == 1 and type(k[0]) is int:
+                ks.append(k[0])
+            else:
+                return None
+        import numpy as np
+
+        from ..parallel.mapreduce import hash_join_membership
+
+        try:
+            barr = np.array(ks, np.int64)
+        except OverflowError:
+            return None
+        return hash_join_membership(codes, barr)
+
     def process(self, port, updates, time):
+        jks = member = None
+        if self.simple_on is not None and len(updates) >= 64:
+            from .columnar import ColumnarBatch
+
+            if isinstance(updates, ColumnarBatch):
+                jks, codes = self._bulk_jks("l" if port == 0 else "r", updates)
+                # the opposite arrangement is static for this whole batch
+                # (port 0 mutates only left state and vice versa), so one
+                # mask is valid for every row
+                if self.how == "inner" and codes is not None and len(updates) >= 1024:
+                    member = self._bulk_membership(
+                        codes, self.right if port == 0 else self.left
+                    )
         out: list[Update] = []
         pad_r = (None,) * self.right_ncols
         pad_l = (None,) * self.left_ncols
-        for key, row, diff in updates:
+        for i, (key, row, diff) in enumerate(updates):
+            if jks is not None:
+                jk = jks[i]
+                if jk is None:
+                    continue
+                if member is not None and not member[i]:
+                    if port == 0:
+                        self._apply(self.left, self.left_total, jk, key, row, diff)
+                    else:
+                        self._apply(self.right, self.right_total, jk, key, row, diff)
+                    continue
             if port == 0:
-                jk = self._jk("l", key, row)
+                if jks is None:
+                    jk = self._jk("l", key, row)
                 if jk is None:
                     continue
                 # join against current right state
@@ -493,7 +583,8 @@ class JoinOperator(Operator):
                                 (self._pad_key_right(rk), pad_l + rrow + (None, rk), rc)
                             )
             else:
-                jk = self._jk("r", key, row)
+                if jks is None:
+                    jk = self._jk("r", key, row)
                 if jk is None:
                     continue
                 old_total = self.right_total.get(jk, 0)

@@ -117,6 +117,33 @@ def _groupby_simple_spec(src: Table, p: dict):
     return (gb_pos, red_plan)
 
 
+def _join_simple_spec(lt: Table, rt: Table, p: dict):
+    """Columnar join-key plan: per-side column positions when every on-expr
+    is a plain column of its own side; None when anything needs the row
+    interpreter (the bulk path then never engages)."""
+    from ..internals.expression import ColumnReference
+
+    def side_positions(src, exprs):
+        positions = {n: i for i, n in enumerate(src._colnames)}
+        out = []
+        for e in exprs:
+            if (
+                isinstance(e, ColumnReference)
+                and e._table is src
+                and e._name in positions
+            ):
+                out.append(positions[e._name])
+            else:
+                return None
+        return tuple(out)
+
+    lp = side_positions(lt, p["left_on"])
+    rp = side_positions(rt, p["right_on"])
+    if lp is None or rp is None:
+        return None
+    return (lp, rp)
+
+
 def _use_static_batches(source) -> bool:
     """The columnar fast path is only sound when static_events has not been
     instance-wrapped (persistence journaling/replay overrides it on the
@@ -239,6 +266,7 @@ def _make_operator(node: pg.OpNode, lg: LoweredGraph) -> Operator:
             p["id_policy"],
             len(lt._colnames),
             len(rt._colnames),
+            simple_on=_join_simple_spec(lt, rt, p),
             name=f"join:{p['how']}",
         )
 

@@ -34,7 +34,27 @@ from ..internals.value import Error
 from .columnar import ColumnarBatch, _INT_LEAF_BOUND
 
 VEC_THRESHOLD = 32
-JAX_THRESHOLD = 65536
+# fresh-host default; a module-level override (env / test monkeypatch)
+# pins it, otherwise the planner's measured pw.map.vecplan crossover
+# applies — see _jax_threshold()
+_JAX_THRESHOLD_DEFAULT = 65536
+JAX_THRESHOLD = _JAX_THRESHOLD_DEFAULT
+
+
+def _jax_threshold() -> int:
+    """Active jax-tier row threshold: an explicit pin (tests monkeypatch
+    :data:`JAX_THRESHOLD` directly) wins; otherwise the auto-planner's
+    measured jit/numpy crossover for the vectorized plan programs."""
+    if JAX_THRESHOLD != _JAX_THRESHOLD_DEFAULT:
+        return JAX_THRESHOLD
+    try:
+        from ..obs import planner
+
+        return planner.cached_crossover(
+            "pw.map.vecplan", default=_JAX_THRESHOLD_DEFAULT
+        )
+    except Exception:  # noqa: BLE001 - planning never takes the plane down
+        return _JAX_THRESHOLD_DEFAULT
 # the column magnitude bound is enforced at extraction time in columnar.py;
 # 2**44 admits millisecond epoch timestamps while keeping sums analyzable
 _INT_LEAF_EXP = _INT_LEAF_BOUND.bit_length() - 1
@@ -93,7 +113,7 @@ class Plan:
         return self._jax_cache[idx]
 
     def __call__(self, cols: list, n: int | None = None):
-        if n is not None and n >= JAX_THRESHOLD and self._jax_static:
+        if n is not None and n >= _jax_threshold() and self._jax_static:
             numeric = {
                 ci
                 for ci in self.used_columns
@@ -217,7 +237,16 @@ def _build_jax(exprs, positions):
             cols[ci] = arrs[j]
         return [node.fn(cols) for node in nodes]
 
-    jitted = jax.jit(raw)
+    try:
+        from ..obs.profiler import profiled_jit
+
+        jitted = profiled_jit("pw.map.vecplan", raw)
+    except Exception:  # pragma: no cover - import-order edge
+        jitted = jax.jit(raw)
+    # context-manager x64 moved to jax.experimental in current jax; the
+    # bare jax.enable_x64 spelling raised AttributeError here, which the
+    # tier fallback swallowed — silently disabling the jax tier everywhere
+    from jax.experimental import enable_x64
 
     def call(all_cols):
         arrs = [all_cols[ci] for ci in used]
@@ -226,7 +255,7 @@ def _build_jax(exprs, positions):
             for a in arrs
         ):
             raise Unsupported("non-numeric column in jax tier")
-        with jax.enable_x64():
+        with enable_x64():
             return jitted(arrs)
 
     return call

@@ -778,6 +778,19 @@ class ClusterRunner:
                         if supervised and not at_min and not at_max:
                             rescale_code = code
         self._end_phase()
+        if self.pid == 0:
+            # one measured epoch row per completed run: the planner's
+            # elastic-membership evidence (choose_process_count argmins
+            # over these p<n> buckets on the next supervised restart)
+            try:
+                from ..obs import costdb
+
+                costdb.default_db().observe(
+                    "pw.cluster.epoch", f"p{self.nprocs}",
+                    ms=(_time.monotonic() - start) * 1e3,
+                )
+            except Exception:  # noqa: BLE001 - read-only cache dirs etc.
+                pass
         if self.fabric is not None:
             self.fabric.shutdown_barrier()
             _dump_fabric_stats(self.fabric, self.pid)

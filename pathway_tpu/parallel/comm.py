@@ -883,8 +883,18 @@ class Fabric:
         ann = self._announced.get((peer, time))
         if not ann:
             return True
-        need = ann.get(pos, 0)
-        return self._recv_pos_counts[(peer, time, pos)] >= need
+        # the mark count-proves every position AT OR BELOW the marked
+        # one: announced counts for pos' <= pos are final when the mark
+        # posts (frames targeting pos' are produced strictly before the
+        # peer crosses pos), so a control-lane mark that overtook its
+        # bulk data still blocks here until those frames land — checking
+        # only ann[pos] let a mark posted past the data's position open
+        # the barrier with the frames still in flight
+        for p, need in ann.items():
+            if p <= pos and self._recv_pos_counts.get(
+                    (peer, time, p), 0) < need:
+                return False
+        return True
 
     def wait_marks(self, time: int, pos: int,
                    timeout_s: float | None = None) -> None:

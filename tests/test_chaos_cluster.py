@@ -225,6 +225,86 @@ def test_kill_and_recover_exactly_once_2proc(tmp_path, point, nth, label):
     )
 
 
+def test_elastic_restart_replans_process_count_exactly_once(tmp_path):
+    """Round-19 elastic membership: a crash relaunch under
+    PW_ELASTIC_PLAN=1 consults the planner's measured epoch rows and
+    relaunches at a DIFFERENT process count — here 2 -> 1, because the
+    seeded costdb says 1-proc epochs are faster on this backend.  The
+    persistence journal written by the 2-proc incarnation re-partitions
+    across the new membership (union of per-pid streams re-filtered by
+    the new ownership) and the squashed output stays exactly-once."""
+    import subprocess
+    import sys
+
+    data = tmp_path / "data"
+    data.mkdir()
+    words = ["red", "green", "blue", "cyan", "plum"]
+    for f in range(4):
+        (data / f"part{f:02d}.txt").write_text(
+            "\n".join(words[(f + i) % len(words)] for i in range(20)) + "\n"
+        )
+    out = tmp_path / "out_elastic.jsonl"
+    pdir = tmp_path / "pstore_elastic"
+    stamp = tmp_path / "stamps_elastic"
+    script = tmp_path / "app_elastic.py"
+    script.write_text(textwrap.dedent(f"""
+        import pathway_tpu as pw
+
+        t = pw.io.plaintext.read({str(data)!r} + "/*.txt", mode="streaming")
+        counts = t.groupby(t.data).reduce(
+            word=t.data, count=pw.reducers.count()
+        )
+        pw.io.jsonlines.write(counts, {str(out)!r})
+        pw.run(persistence_config=pw.persistence.Config(
+            pw.persistence.Backend.filesystem({str(pdir)!r})),
+            idle_stop_s=1.5)
+    """))
+    # seed measured epochs under the SAME fingerprint the spawned
+    # supervisor will compute (it runs with JAX_PLATFORMS=cpu): 1-proc
+    # epochs recorded faster, so the planner must pick 1 on relaunch
+    repo = Path(__file__).resolve().parent.parent
+    probe = subprocess.run(
+        [sys.executable, "-c",
+         "from pathway_tpu.obs.costdb import backend_fingerprint;"
+         "print(backend_fingerprint())"],
+        env={**os.environ, "JAX_PLATFORMS": "cpu",
+             "PYTHONPATH": str(repo)},
+        capture_output=True, text=True, timeout=60,
+    )
+    fp = probe.stdout.strip()
+    assert fp, probe.stderr[-1000:]
+    dbpath = tmp_path / "costdb.json"
+    entries = {}
+    for bucket, ms in (("p1", 900.0), ("p2", 5000.0)):
+        entries[f"pw.cluster.epoch|{bucket}|{fp}"] = {
+            "program": "pw.cluster.epoch", "bucket": bucket,
+            "fingerprint": fp, "n": 3, "ms_best": ms, "ms_avg": ms,
+            "ms_last": ms,
+        }
+    dbpath.write_text(json.dumps({"version": 1, "entries": entries}))
+    env = dict(_CHAOS_ENV)
+    env["PW_FAULT"] = "persistence.commit:kill:1:0:1"
+    env["PW_FAULT_STAMP_DIR"] = str(stamp)
+    env["PW_COSTDB_PATH"] = str(dbpath)
+    env["PW_ELASTIC_PLAN"] = "1"
+    res = spawn_cluster(script, processes=2, timeout=110, extra_env=env,
+                        restart=2)
+    assert list(stamp.glob("*.fired")), (
+        "kill fault never fired — the elastic path was not exercised"
+    )
+    assert "elastic membership: 2 -> 1" in res.stderr, res.stderr[-3000:]
+    final = _squash_jsonl_words(out)
+    expect: dict = {}
+    for f in range(4):
+        for i in range(20):
+            w = words[(f + i) % len(words)]
+            expect[w] = expect.get(w, 0) + 1
+    assert final == expect, (
+        f"exactly-once violated across elastic re-partition: "
+        f"{final} != {expect}"
+    )
+
+
 # -- faults registry units -------------------------------------------------
 
 

@@ -94,11 +94,6 @@ def test_columnar_batch_flows_between_operators():
     assert seen["type"] == "ColumnarBatch"
 
 
-@pytest.mark.skip(
-    reason="jax tier declines on this CPU-only build even under "
-    "PW_FORCE_JAX_TIER (batches fall back to the numpy tier); the tier "
-    "targets accelerator backends"
-)
 def test_jax_tier_runs_when_forced(monkeypatch):
     monkeypatch.setenv("PW_FORCE_JAX_TIER", "1")
     monkeypatch.setattr(vectorize, "_JAX_HEALTHY", None)

@@ -1,0 +1,290 @@
+"""Round-19 auto-planner + columnar primitive family.
+
+Three tiers:
+
+  - PLANNER DECISIONS — a seeded costdb yields a deterministic plan
+    (crossovers, process count) with ``costdb``-sourced rationale; a
+    fresh/foreign-fingerprint store falls back to the documented
+    defaults and SAYS so; env pins always win and are reported as env;
+  - PRIMITIVE PARITY — segment_reduce (sum/count/min/max/avg) and
+    hash_join_membership agree bit-for-bit between the numpy and jitted
+    paths on the dtypes the jit path admits (the byte-identity contract
+    the cluster pins end-to-end);
+  - EXCHANGE CONSOLIDATION — per-ROW eligibility: a mixed batch's exact
+    rows consolidate while float/unhashable rows pass through raw in
+    place, and a batch that compresses nothing is sent raw (None).
+"""
+
+import numpy as np
+import pytest
+
+from pathway_tpu.obs import planner
+from pathway_tpu.obs.costdb import CostDB
+from pathway_tpu.parallel import mapreduce as mr
+
+
+@pytest.fixture
+def db(tmp_path):
+    d = CostDB(str(tmp_path / "costdb.json"), flush_interval_s=3600.0)
+    yield d
+    d.shutdown()
+
+
+def _seed_pair(d: CostDB, program: str, pairs: dict) -> None:
+    for n, (jit_ms, np_ms) in pairs.items():
+        d.observe(f"{program}.jit", f"n{n}", ms=jit_ms)
+        d.observe(f"{program}.numpy", f"n{n}", ms=np_ms)
+
+
+# -- planner decisions ------------------------------------------------------
+
+
+def test_jit_crossover_seeded_deterministic(db):
+    """The crossover is the smallest size where jit wins AND keeps
+    winning at every larger measured bucket — one lucky small window
+    must not drag it down."""
+    _seed_pair(db, "pw.reduce.segment_sum", {
+        4096: (0.5, 1.0),      # lucky small win, not sustained
+        16384: (4.0, 2.0),
+        65536: (3.0, 5.0),
+        262144: (2.0, 9.0),
+    })
+    d = planner.jit_crossover("pw.reduce.segment_sum", db=db)
+    assert d.value == 65536
+    assert d.source == "costdb"
+    assert "n65536" in d.why
+    # deterministic: same store, same decision
+    assert planner.jit_crossover("pw.reduce.segment_sum", db=db).value == 65536
+
+
+def test_jit_crossover_never_wins_pins_numpy(db):
+    _seed_pair(db, "pw.reduce.segment_sum",
+               {4096: (2.0, 1.0), 65536: (9.0, 3.0)})
+    d = planner.jit_crossover("pw.reduce.segment_sum", db=db)
+    assert d.value == planner.NEVER
+    assert d.source == "costdb"
+    assert "numpy path pinned" in d.why
+
+
+def test_jit_crossover_fresh_host_documented_default(db):
+    d = planner.jit_crossover("pw.reduce.segment_sum", default=65536, db=db)
+    assert (d.value, d.source) == (65536, "default")
+    assert "--calibrate" in d.why  # the fix is named, not implied
+
+
+def test_jit_crossover_ignores_foreign_fingerprint(db):
+    """A cost measured on another backend must not steer planning on
+    this one."""
+    _seed_pair(db, "pw.reduce.segment_sum", {65536: (1.0, 9.0)})
+    db.fingerprint = "other-backend:tpu-v9:jax99"
+    d = planner.jit_crossover("pw.reduce.segment_sum", db=db)
+    assert d.source == "default"
+
+
+def test_choose_process_count_argmin_ties_to_fewer(db):
+    db.observe("pw.cluster.epoch", "p1", ms=5000.0)
+    db.observe("pw.cluster.epoch", "p2", ms=2000.0)
+    db.observe("pw.cluster.epoch", "p4", ms=2000.0)
+    d = planner.choose_process_count(1, db=db, max_procs=8)
+    assert d.value == 2  # tie with p4: fewer procs wins
+    assert d.source == "costdb"
+    assert "p2" in d.why
+
+
+def test_choose_process_count_respects_cap_and_default(db):
+    db.observe("pw.cluster.epoch", "p8", ms=100.0)
+    db.observe("pw.cluster.epoch", "p2", ms=900.0)
+    d = planner.choose_process_count(2, db=db, max_procs=4)
+    assert d.value == 2  # p8 fastest but over the cap
+    empty = CostDB(db.path + ".empty", flush_interval_s=3600.0)
+    try:
+        d0 = planner.choose_process_count(3, db=empty)
+        assert (d0.value, d0.source) == (3, "default")
+        assert "no recorded cluster epochs" in d0.why
+    finally:
+        empty.shutdown()
+
+
+def test_plan_fresh_host_reports_documented_defaults(db, monkeypatch):
+    monkeypatch.delenv("PW_MAPREDUCE_JIT_MIN", raising=False)
+    monkeypatch.delenv("PW_VECTORIZE_JIT_MIN", raising=False)
+    p = planner.plan(db=db, current_processes=1)
+    knobs = {d.knob for d in p.decisions}
+    for expected in ("pw.reduce.segment_sum.jit_min",
+                     "pw.map.vecplan.jit_min", "processes", "tp", "dp",
+                     "num_blocks", "block_size", "max_batch_size",
+                     "chain_steps", "prefill_chunk"):
+        assert expected in knobs, f"planner dropped {expected}"
+    # a fresh host is visibly untuned, never silently mistuned
+    assert all(d.source == "default" for d in p.decisions), [
+        (d.knob, d.source) for d in p.decisions
+    ]
+    rendered = p.render()
+    assert "pw.reduce.segment_sum.jit_min" in rendered
+    assert db.fingerprint in rendered
+
+
+def test_plan_env_pin_wins_and_is_reported(db, monkeypatch):
+    monkeypatch.setenv("PW_MAPREDUCE_JIT_MIN", "123")
+    p = planner.plan(db=db, current_processes=1)
+    d = p.get("pw.reduce.segment_sum.jit_min")
+    assert (d.value, d.source) == (123, "env")
+    assert "PW_MAPREDUCE_JIT_MIN" in d.why
+
+
+def test_plan_seeded_costdb_is_deterministic(db, monkeypatch):
+    monkeypatch.delenv("PW_MAPREDUCE_JIT_MIN", raising=False)
+    _seed_pair(db, "pw.reduce.segment_sum", {4096: (9.0, 1.0),
+                                             65536: (1.0, 9.0)})
+    db.observe("pw.cluster.epoch", "p2", ms=700.0)
+    db.observe("pw.cluster.epoch", "p1", ms=2000.0)
+    p1 = planner.plan(db=db, current_processes=1, max_procs=4)
+    p2 = planner.plan(db=db, current_processes=1, max_procs=4)
+    assert p1.as_dict() == p2.as_dict()
+    assert p1.value("pw.reduce.segment_sum.jit_min") == 65536
+    assert p1.value("processes") == 2
+    assert p1.get("processes").source == "costdb"
+
+
+def test_calibrate_records_both_sides_and_flips_source(db):
+    out = planner.calibrate_mapreduce(db, sizes=(4096, 16384), repeats=1)
+    assert "numpy.n4096" in out
+    d = planner.jit_crossover("pw.reduce.segment_sum", db=db)
+    # measured now — whatever the verdict, it is evidence, not a default
+    assert d.source == "costdb"
+
+
+def test_cached_crossover_consults_once(db, monkeypatch):
+    calls = []
+    real = planner.jit_crossover
+
+    def counting(program, **kw):
+        calls.append(program)
+        return real(program, db=db)
+
+    monkeypatch.setattr(planner, "jit_crossover", counting)
+    planner.invalidate_cache()
+    v1 = planner.cached_crossover("pw.reduce.segment_sum")
+    v2 = planner.cached_crossover("pw.reduce.segment_sum")
+    assert v1 == v2 and len(calls) == 1
+    planner.invalidate_cache()
+
+
+# -- crossover plumbing into the dual-path consumers ------------------------
+
+
+def test_mapreduce_jit_min_pin_beats_planner(monkeypatch):
+    monkeypatch.setattr(mr, "_JIT_MIN_ELEMENTS", 777)
+    assert mr.jit_min_elements() == 777
+    monkeypatch.setattr(mr, "_JIT_MIN_ELEMENTS", None)
+    monkeypatch.setitem(planner._CROSSOVER_CACHE,
+                        "pw.reduce.segment_sum", 888)
+    assert mr.jit_min_elements() == 888
+
+
+def test_vectorize_threshold_pin_beats_planner(monkeypatch):
+    from pathway_tpu.engine import vectorize
+
+    monkeypatch.setattr(vectorize, "JAX_THRESHOLD", 256)
+    assert vectorize._jax_threshold() == 256
+    monkeypatch.setattr(vectorize, "JAX_THRESHOLD",
+                        vectorize._JAX_THRESHOLD_DEFAULT)
+    monkeypatch.setitem(planner._CROSSOVER_CACHE, "pw.map.vecplan", 4321)
+    assert vectorize._jax_threshold() == 4321
+
+
+# -- primitive parity (sizes < 4096 so tests never write the real costdb) --
+
+
+@pytest.mark.parametrize("kind", ["sum", "count", "min", "max"])
+@pytest.mark.parametrize("dtype", [np.int32, np.float32])
+def test_segment_reduce_numpy_jit_parity(monkeypatch, kind, dtype):
+    rng = np.random.default_rng(3)
+    n, g = 3000, 41
+    codes = rng.integers(0, g, n).astype(np.int32)
+    if dtype is np.int32:
+        values = rng.integers(-50, 50, n).astype(dtype)
+    else:
+        values = rng.standard_normal(n).astype(dtype)
+    monkeypatch.setattr(mr, "_JIT_MIN_ELEMENTS", 1 << 30)
+    a = mr.segment_reduce(values, codes, g, kind)
+    monkeypatch.setattr(mr, "_JIT_MIN_ELEMENTS", 1)
+    b = mr.segment_reduce(values, codes, g, kind)
+    if kind in ("min", "max") or dtype is np.int32:
+        # no arithmetic (extrema) / exact int addition: bit-identical
+        assert np.array_equal(a, b)
+    else:
+        np.testing.assert_allclose(a, b, rtol=1e-6)
+
+
+def test_segment_reduce_avg_returns_sums_and_counts(monkeypatch):
+    monkeypatch.setattr(mr, "_JIT_MIN_ELEMENTS", 1 << 30)
+    values = np.array([10, 20, 30, 40], np.int64)
+    codes = np.array([0, 1, 0, 1], np.int32)
+    diffs = np.array([1, 1, 2, -1], np.int64)
+    sums, counts = mr.segment_reduce(values, codes, 2, "avg", weights=diffs)
+    assert sums.tolist() == [70, -20]
+    assert counts.tolist() == [3, 0]
+
+
+def test_segment_reduce_empty_group_identity(monkeypatch):
+    monkeypatch.setattr(mr, "_JIT_MIN_ELEMENTS", 1 << 30)
+    values = np.array([5, 7], np.int32)
+    codes = np.array([0, 0], np.int32)
+    out_min = mr.segment_reduce(values, codes, 3, "min")
+    out_max = mr.segment_reduce(values, codes, 3, "max")
+    info = np.iinfo(np.int32)
+    assert out_min.tolist() == [5, info.max, info.max]
+    assert out_max.tolist() == [7, info.min, info.min]
+
+
+def test_segment_reduce_unknown_kind_raises():
+    with pytest.raises(ValueError, match="unknown segment_reduce kind"):
+        mr.segment_reduce(np.zeros(2, np.int32),
+                          np.zeros(2, np.int32), 1, "median")
+
+
+def test_hash_join_membership_parity(monkeypatch):
+    rng = np.random.default_rng(11)
+    probe = rng.integers(0, 500, 2000).astype(np.int64)
+    build = rng.integers(0, 500, 120).astype(np.int64)
+    monkeypatch.setattr(mr, "_JIT_MIN_ELEMENTS", 1 << 30)
+    a = mr.hash_join_membership(probe, build)
+    monkeypatch.setattr(mr, "_JIT_MIN_ELEMENTS", 1)
+    b = mr.hash_join_membership(probe, build)
+    assert np.array_equal(a, b)
+    assert np.array_equal(a, np.isin(probe, build))
+    assert a.sum() > 0  # the fixture actually exercises membership
+    assert not mr.hash_join_membership(probe, np.array([], np.int64)).any()
+
+
+# -- per-row exchange consolidation ----------------------------------------
+
+
+def test_combine_mixed_batch_consolidates_exact_rows_in_place():
+    """A float row in a sum column no longer forces the whole batch onto
+    the wire raw: int rows merge, the float/unhashable rows pass through
+    unmerged in their original relative position."""
+    ups = [(i, (f"w{i % 4}", 1), 1) for i in range(40)]
+    ups.insert(7, (999, ("fl", 1.5), 1))     # float sum value: raw
+    ups.insert(20, (998, ("un", [1]), 1))    # unhashable: raw
+    out = mr.combine_for_exchange(ups, ((1,),))
+    assert out is not None
+    assert len(out) == 6  # 4 merged int rows + 2 raw passthroughs
+    raw = [u for u in out if u[0] in (998, 999)]
+    assert [u[0] for u in raw] == [999, 998]  # original relative order
+    assert raw[0][1] == ("fl", 1.5) and raw[1][1] == ("un", [1])
+    merged = {r: d for k, r, d in out if k not in (998, 999)}
+    assert merged == {(f"w{i}", 1): 10 for i in range(4)}
+
+
+def test_combine_without_compression_sends_raw():
+    # 40 distinct eligible rows: merging buys no wire bytes -> None
+    ups = [(i, (f"w{i}", i), 1) for i in range(40)]
+    assert mr.combine_for_exchange(ups, ((1,),)) is None
+
+
+def test_combine_cancelled_rows_vanish():
+    ups = [(i, ("w", 1), 1) for i in range(20)]
+    ups += [(100 + i, ("w", 1), -1) for i in range(20)]
+    assert mr.combine_for_exchange(ups, ((),)) == []
